@@ -1,0 +1,20 @@
+"""MGProto-TPU: a TPU-native (JAX/Flax/Pallas) framework for Mixture-of-Gaussian
+prototype image recognition, with the capabilities of cwangrun/MGProto.
+
+Brand-new design, not a port: the reference's mutable-module design
+(/root/reference/model.py) becomes pure functions over an explicit functional
+train state; per-patch Gaussian scoring runs as a single MXU matmul in log
+domain; EM is vmapped over classes; distribution is expressed as GSPMD
+shardings over a (data, model) mesh instead of torch DataParallel.
+
+Subpackages:
+  ops       — pure math kernels (gaussian density, pooling, RF arithmetic, Pallas)
+  models    — Flax backbone zoo (ResNet / VGG / DenseNet) + torch weight converter
+  core      — MGProto head, functional memory bank, EM, losses, train state
+  engine    — train/eval/push/prune/OoD/interpretability drivers
+  parallel  — mesh + sharding specs, multi-chip entry points
+  data      — host-side input pipelines and dataset helpers
+  utils     — logging, checkpointing, config
+"""
+
+__version__ = "0.1.0"

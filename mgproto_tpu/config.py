@@ -1,0 +1,165 @@
+"""Configuration for MGProto-TPU.
+
+One typed, side-effect-free config tree replacing the reference's two-tier
+module-constant + argparse system (reference settings.py:1-52, main.py:19-27).
+No import-time I/O (cf. reference utils/local_parts.py:14-81).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model architecture config (reference model.py:78-174, settings.py:1-5)."""
+
+    arch: str = "resnet34"
+    img_size: int = 224
+    num_classes: int = 200
+    # reference prototype_shape = (num_classes*K, d, 1, 1) (settings.py:3)
+    prototypes_per_class: int = 10
+    proto_dim: int = 64
+    add_on_type: str = "regular"  # 'regular' | 'bottleneck' (model.py:117-143)
+    sz_embedding: int = 32  # aux DML embedding width (model.py:146)
+    mine_T: int = 20  # top-T mining levels (main.py:26 -mine_level)
+    mem_capacity: int = 800  # per-class memory capacity (main.py:25 -mem_sz)
+    # Gaussian prototype init std sigma = 1/sqrt(2*pi) (model.py:151)
+    init_sigma: float = 1.0 / math.sqrt(2.0 * math.pi)
+    pretrained: bool = False
+    # dtype policy: params/activations compute dtype. Density math is always f32
+    # (OoD thresholds depend on p(x) scale; see SURVEY.md §7.3.5).
+    compute_dtype: str = "float32"
+
+    @property
+    def num_prototypes(self) -> int:
+        return self.num_classes * self.prototypes_per_class
+
+
+@dataclasses.dataclass(frozen=True)
+class EMConfig:
+    """EM-over-memory config (reference model.py:171-174, main.py:223-229)."""
+
+    num_em_loop: int = 3
+    alpha: float = 0.1  # responsibility additive smoothing (model.py:353)
+    tau: float = 0.990  # prior momentum (model.py:174)
+    diversity_lambda: float = 1.0  # diversity cost weight (model.py:367)
+    mean_lr: float = 3e-3  # Adam on means (settings.py:29 'prototype_vectors')
+    update_interval: int = 1  # EM every N train iterations (model.py:171)
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    """Optimizer groups (reference main.py:205-229, settings.py:27-35)."""
+
+    features_lr: float = 1e-4
+    add_on_lr: float = 3e-3
+    aux_proxies_lr: float = 1e-2  # features_lr * 100 (main.py:209)
+    weight_decay: float = 1e-4  # torch-Adam style L2-in-grad
+    lr_decay_gamma: float = 0.4  # StepLR gamma (main.py:212)
+    lr_decay_epochs: Tuple[int, ...] = (30, 45, 60, 75, 90)  # main.py:248
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleConfig:
+    """Training schedule (reference settings.py:45-52)."""
+
+    num_train_epochs: int = 120
+    num_warm_epochs: int = 0
+    mine_start: int = 40
+    update_gmm_start: int = 35
+    push_start: int = 100
+    push_every: int = 10
+    prune_top_m: int = 8  # main.py:285
+
+    def push_epochs(self) -> Sequence[int]:
+        return [
+            e
+            for e in range(self.num_train_epochs)
+            if e % self.push_every == 0 and e >= self.push_start
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    """Loss coefficients (reference settings.py:38-42) + aux loss choice."""
+
+    crs_ent: float = 1.0
+    mine: float = 0.2
+    aux: float = 0.5
+    aux_loss: str = "proxy_anchor"  # proxy_anchor|proxy_nca|ms|contrastive|triplet|npair
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset paths + batch sizes (reference settings.py:8-24)."""
+
+    dataset: str = "CUB"
+    train_dir: str = ""
+    test_dir: str = ""
+    train_push_dir: str = ""
+    ood_dirs: Tuple[str, ...] = ()
+    train_batch_size: int = 80
+    test_batch_size: int = 80
+    train_push_batch_size: int = 80
+    num_workers: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout. data = batch sharding; model = class-axis sharding of
+    the GMM head / memory / EM (the TP analogue for this model family)."""
+
+    data: int = -1  # -1: all devices on the data axis
+    model: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    em: EMConfig = dataclasses.field(default_factory=EMConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    schedule: ScheduleConfig = dataclasses.field(default_factory=ScheduleConfig)
+    loss: LossConfig = dataclasses.field(default_factory=LossConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    seed: int = 0
+    model_dir: str = "./saved_models"
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def tiny_test_config(
+    num_classes: int = 4,
+    prototypes_per_class: int = 3,
+    proto_dim: int = 8,
+    img_size: int = 32,
+    mem_capacity: int = 16,
+    mine_T: int = 4,
+    arch: str = "tiny",
+) -> Config:
+    """Small config for unit/integration tests and multi-chip dry runs."""
+    return Config(
+        model=ModelConfig(
+            arch=arch,
+            img_size=img_size,
+            num_classes=num_classes,
+            prototypes_per_class=prototypes_per_class,
+            proto_dim=proto_dim,
+            sz_embedding=8,
+            mine_T=mine_T,
+            mem_capacity=mem_capacity,
+            pretrained=False,
+        ),
+        schedule=ScheduleConfig(
+            num_train_epochs=2,
+            mine_start=0,
+            update_gmm_start=0,
+            push_start=1,
+            push_every=1,
+            prune_top_m=2,
+        ),
+    )

@@ -1,0 +1,3 @@
+from mgproto_tpu.core.memory import Memory, init_memory, memory_push, memory_pull_all
+
+__all__ = ["Memory", "init_memory", "memory_push", "memory_pull_all"]

@@ -1,0 +1,108 @@
+"""Functional per-class FIFO feature memory.
+
+Reference: /root/reference/utils/memory.py — an nn.Module holding one mutable
+`cls%d` buffer per class, pushed to from inside `forward` (a replica-lost-write
+hazard under DataParallel, SURVEY.md §2.3). TPU-native design: the memory is a
+fixed-shape pytree threaded through the jitted train step; the push is a single
+masked scatter (no per-class python loop), so it is safe under any sharding —
+candidates are globally visible after an all_gather over the data axis.
+
+FIFO semantics: a circular buffer per class. The reference keeps buffers
+left-compacted and shifts on eviction (memory.py:56-67); since the only
+consumer is EM, which treats the bank as a *set* (model.py:279-291), a cursor-
+based circular write preserves the exact same retained-set semantics (oldest
+evicted first) with O(1) work.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Memory(NamedTuple):
+    """feats: [C, cap, d]; length/cursor: [C] int32; updated: [C] bool
+    (`updated` mirrors reference model.py:167 `memory_updated_cls`)."""
+
+    feats: jax.Array
+    length: jax.Array
+    cursor: jax.Array
+    updated: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.feats.shape[1]
+
+    @property
+    def num_classes(self) -> int:
+        return self.feats.shape[0]
+
+
+def init_memory(num_classes: int, capacity: int, dim: int) -> Memory:
+    return Memory(
+        feats=jnp.zeros((num_classes, capacity, dim), jnp.float32),
+        length=jnp.zeros((num_classes,), jnp.int32),
+        cursor=jnp.zeros((num_classes,), jnp.int32),
+        updated=jnp.zeros((num_classes,), bool),
+    )
+
+
+def memory_push(
+    mem: Memory, feats: jax.Array, classes: jax.Array, valid: jax.Array
+) -> Memory:
+    """Enqueue a flat batch of candidates (reference memory.py:31-73 semantics).
+
+    Args:
+      mem:     current memory state.
+      feats:   [N, d] candidate feature vectors.
+      classes: [N] int class ids.
+      valid:   [N] bool; invalid rows are dropped.
+
+    Jit-safe: everything is fixed-shape; invalid rows scatter out-of-bounds
+    and are dropped by XLA. If a single push holds more than `capacity` valid
+    rows of one class, the first `capacity` are kept (the reference random-
+    samples `capacity` of them, memory.py:51-53 — deterministic-first is the
+    jit-friendly equivalent; a batch never realistically exceeds capacity).
+    """
+    c, cap, _ = mem.feats.shape
+    sentinel = jnp.int32(c)
+    cls = jnp.where(valid, classes.astype(jnp.int32), sentinel)  # [N]
+
+    one_hot = jax.nn.one_hot(cls, c, dtype=jnp.int32)  # [N, C] (sentinel -> 0s)
+    csum = jnp.cumsum(one_hot, axis=0)  # inclusive
+    rank = (
+        jnp.take_along_axis(csum, jnp.clip(cls, 0, c - 1)[:, None], axis=1)[:, 0]
+        - 1
+    )  # [N] 0-based rank within class, in batch order
+    keep = valid & (rank < cap)
+    cls = jnp.where(keep, cls, sentinel)
+
+    cursor_ext = jnp.concatenate([mem.cursor, jnp.zeros((1,), jnp.int32)])
+    pos = (cursor_ext[jnp.clip(cls, 0, c)] + rank) % cap
+
+    new_feats = mem.feats.at[cls, pos].set(
+        feats.astype(mem.feats.dtype), mode="drop"
+    )
+    counts = jnp.sum(one_hot * keep[:, None], axis=0)  # [C]
+    return Memory(
+        feats=new_feats,
+        length=jnp.minimum(mem.length + counts, cap),
+        cursor=(mem.cursor + counts) % cap,
+        updated=mem.updated | (counts > 0),
+    )
+
+
+def memory_pull_all(mem: Memory) -> Tuple[jax.Array, jax.Array]:
+    """All stored features with a validity mask (reference memory.py:135-151,
+    kept fixed-shape: [C, cap, d] feats + [C, cap] bool instead of a ragged
+    concat — EM consumes them per class anyway)."""
+    mask = jnp.arange(mem.capacity)[None, :] < mem.length[:, None]
+    return mem.feats, mask
+
+
+def clear_updated(mem: Memory) -> Memory:
+    """Reset the per-class updated flags after an EM pass
+    (reference model.py:287)."""
+    return mem._replace(updated=jnp.zeros_like(mem.updated))

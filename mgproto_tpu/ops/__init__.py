@@ -1,0 +1,16 @@
+from mgproto_tpu.ops.gaussian import (
+    diag_gaussian_log_prob,
+    mixture_log_likelihood,
+    e_step,
+)
+from mgproto_tpu.ops.pooling import top_t_pool, mine_mask_activations
+from mgproto_tpu.ops import receptive_field
+
+__all__ = [
+    "diag_gaussian_log_prob",
+    "mixture_log_likelihood",
+    "e_step",
+    "top_t_pool",
+    "mine_mask_activations",
+    "receptive_field",
+]

@@ -1,0 +1,126 @@
+"""Diagonal-Gaussian prototype scoring — the hot op of MGProto.
+
+Reference semantics: /root/reference/model.py:256-275 (`compute_log_prob`) and
+model.py:323-336 (`_estimate_log_prob`): for features x in R^d and per-prototype
+(mean mu, std sigma),
+
+    log N(x; mu, sigma) = -d/2 log(2 pi) - sum_d log sigma_d
+                          - 1/2 sum_d ((x_d - mu_d) / sigma_d)^2
+
+The reference evaluates this with python-blocked broadcast/pow loops
+(model.py:263-274, n_block=4) to bound GPU memory. TPU-native design: expand
+the quadratic so the cross term is ONE [N, d] x [d, P] matmul on the MXU and
+the rest are rank-1 broadcasts — no blocking, no python loops; XLA fuses the
+elementwise epilogue. Density math stays in float32 regardless of the model's
+compute dtype (OoD p(x) thresholds depend on its scale, SURVEY.md §7.3.5).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+def diag_gaussian_log_prob(
+    x: jax.Array,
+    means: jax.Array,
+    sigmas: jax.Array,
+    eps: float = 1e-10,
+) -> jax.Array:
+    """Per-sample log-density under every diagonal Gaussian prototype.
+
+    Args:
+      x:      [N, d] feature vectors.
+      means:  [..., d] prototype means (any leading shape, e.g. [C, K]).
+      sigmas: [..., d] prototype stds (same leading shape as means).
+      eps:    added to sigma before dividing (reference model.py:272 uses
+              sigma + 0 in compute_log_prob and sigma + 1e-10 in the EM path;
+              both are the identity at f32 for sigma ~ 0.4).
+
+    Returns:
+      [N, *leading] log-densities in float32.
+
+    Quadratic expansion: with s = 1/sigma^2,
+      sum_d ((x-mu)/sigma)^2 = (x*x) @ s - 2 * x @ (mu*s) + sum_d mu^2 s
+    The middle term is the MXU matmul; everything else is O(N) or O(P).
+    """
+    x = x.astype(jnp.float32)
+    lead = means.shape[:-1]
+    d = x.shape[-1]
+    m = means.astype(jnp.float32).reshape(-1, d)  # [P, d]
+    s = (sigmas.astype(jnp.float32) + eps).reshape(-1, d)  # [P, d]
+
+    inv_var = 1.0 / (s * s)  # [P, d]
+    log_det = jnp.sum(jnp.log(s), axis=-1)  # [P]
+    m_scaled = m * inv_var  # [P, d]
+    m_quad = jnp.sum(m * m_scaled, axis=-1)  # [P]
+
+    # Precision.HIGHEST: keep the MXU passes at full f32 — default TPU matmul
+    # precision truncates inputs to bf16, and the quadratic expansion is
+    # cancellation-prone; OoD p(x) thresholds ride on this scale.
+    x_quad = jnp.matmul(
+        x * x, inv_var.T, precision=jax.lax.Precision.HIGHEST
+    )  # [N, P]
+    cross = jnp.matmul(
+        x, m_scaled.T, precision=jax.lax.Precision.HIGHEST
+    )  # [N, P]  <- MXU
+    sq_maha = x_quad - 2.0 * cross + m_quad[None, :]
+
+    out = -0.5 * d * _LOG_2PI - log_det[None, :] - 0.5 * sq_maha
+    return out.reshape(x.shape[0], *lead)
+
+
+def mixture_log_likelihood(
+    log_prob: jax.Array, log_priors: jax.Array
+) -> jax.Array:
+    """log p(x|c) = logsumexp_k [ log pi_{c,k} + log N(x; mu_{c,k}) ].
+
+    Log-domain equivalent of the reference's priors-as-weights NonNegLinear
+    over exponentiated densities (model.py:222 + model.py:54-74): because the
+    last-layer row for class c holds exactly pi_c on class-c prototypes and 0
+    elsewhere, the linear layer IS a per-class mixture sum; we never build the
+    [P, C] masked weight matrix.
+
+    Args:
+      log_prob:   [..., C, K] per-component log-densities.
+      log_priors: [C, K] log mixture priors (may be -inf for pruned slots).
+    Returns:
+      [..., C] class log-likelihoods.
+    """
+    return jax.nn.logsumexp(log_prob + log_priors, axis=-1)
+
+
+def e_step(
+    x: jax.Array,
+    means: jax.Array,
+    sigmas: jax.Array,
+    priors: jax.Array,
+    eps: float = 1e-10,
+):
+    """EM E-step for one class mixture (reference model.py:303-321).
+
+    Args:
+      x:      [N, d] memory features of the class.
+      means:  [K, d], sigmas: [K, d], priors: [K].
+    Returns:
+      (mean log-likelihood scalar, log-responsibilities [N, K])
+    """
+    weighted = diag_gaussian_log_prob(x, means, sigmas) + jnp.log(priors + eps)
+    log_norm = jax.nn.logsumexp(weighted, axis=-1, keepdims=True)  # [N, 1]
+    log_resp = weighted - log_norm
+    return jnp.mean(log_norm), log_resp
+
+
+def pairwise_sq_dists(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[N, d] x [M, d] -> [N, M] squared euclidean distances
+    (reference utils/helpers.py:13-14 `list_of_distances`)."""
+    return jnp.sum((a[:, None, :] - b[None, :, :]) ** 2, axis=-1)
+
+
+def momentum_update(old: jax.Array, new: jax.Array, momentum: float) -> jax.Array:
+    """EMA update (reference model.py:44-50)."""
+    return momentum * old + (1.0 - momentum) * new

@@ -1,0 +1,98 @@
+"""Top-T spatial mining pool, in log domain.
+
+Reference: /root/reference/model.py:188-206 (`global_max_pooling_gmm_topT`)
+takes top-T of exp(log_prob) over the H*W grid per prototype, then gathers the
+feature vector at each selected location with a T-iteration python gather loop.
+
+TPU-native design: log is monotonic, so top-T over log-densities selects the
+same locations/ordering as top-T over densities — we stay in log domain (no
+overflow, no exp) and use a single `lax.top_k` + one `take_along_axis` for the
+top-1 features (only the top-1 features are ever consumed downstream — the
+reference computes all T and drops T-1 of them, model.py:225-226).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PooledActivations(NamedTuple):
+    """Result of the mining pool.
+
+    log_act:   [B, C, K, T] top-T per-prototype log-densities (sorted desc).
+    top1_idx:  [B, C, K] flat spatial index (h * W + w) of each prototype's
+               best patch.
+    top1_feat: [B, C, K, d] feature vector at that patch.
+    """
+
+    log_act: jax.Array
+    top1_idx: jax.Array
+    top1_feat: jax.Array
+
+
+def top_t_pool(log_prob: jax.Array, features: jax.Array, mine_T: int) -> PooledActivations:
+    """Args:
+      log_prob: [B, C, K, H, W] per-patch log-densities.
+      features: [B, H, W, d] L2-normalized feature map (NHWC).
+      mine_T:   number of mining levels T.
+    """
+    b, c, k, h, w = log_prob.shape
+    flat = log_prob.reshape(b, c, k, h * w)
+    vals, idx = jax.lax.top_k(flat, mine_T)  # [B, C, K, T]
+
+    top1 = idx[..., 0]  # [B, C, K]
+    feats_flat = features.reshape(b, h * w, -1)  # [B, HW, d]
+    gathered = jnp.take_along_axis(
+        feats_flat, top1.reshape(b, c * k, 1), axis=1
+    )  # [B, C*K, d]
+    top1_feat = gathered.reshape(b, c, k, -1)
+    return PooledActivations(log_act=vals, top1_idx=top1, top1_feat=top1_feat)
+
+
+def mine_mask_activations(
+    log_act: jax.Array, labels: jax.Array | None
+) -> jax.Array:
+    """Hard-mining mask (reference model.py:218-221).
+
+    For mining level t >= 1, prototypes NOT belonging to the ground-truth class
+    keep their top-1 activation, while ground-truth prototypes use their t-th
+    strongest patch (weaker evidence) — so the mine CE pits the target class's
+    t-th-best evidence against every other class's best evidence.
+
+    Args:
+      log_act: [B, C, K, T]; labels: [B] int or None (eval: no masking).
+    Returns:
+      [B, C, K, T] masked activations.
+    """
+    if labels is None:
+        return log_act
+    b, c, k, t = log_act.shape
+    is_gt = jax.nn.one_hot(labels, c, dtype=bool)  # [B, C]
+    top1 = log_act[..., :1]  # [B, C, K, 1]
+    keep = is_gt[:, :, None, None]  # [B, C, 1, 1]
+    masked = jnp.where(keep, log_act, jnp.broadcast_to(top1, log_act.shape))
+    # level 0 is always the true top-1 for every prototype
+    return masked.at[..., 0].set(log_act[..., 0]) if t > 0 else masked
+
+
+def dedup_first_occurrence(idx: jax.Array) -> jax.Array:
+    """Mask keeping only the first occurrence of each value along the last axis.
+
+    Functional replacement for the reference's per-sample python dedup of
+    enqueue candidates by spatial index (model.py:238-246): several prototypes
+    of the same class often peak at the same patch; only one copy of that
+    feature vector may enter the memory bank.
+
+    Args:
+      idx: [..., K] integer spatial indices.
+    Returns:
+      [..., K] bool mask, True where idx[i] != idx[j] for all j < i.
+    """
+    k = idx.shape[-1]
+    eq = idx[..., :, None] == idx[..., None, :]  # [..., K, K]
+    earlier = jnp.tril(jnp.ones((k, k), dtype=bool), k=-1)
+    dup_of_earlier = jnp.any(eq & earlier, axis=-1)
+    return ~dup_of_earlier

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Run the test suite on a virtual 8-device CPU mesh, bypassing the TPU tunnel.
+# Env must be set BEFORE python starts: the axon sitecustomize dials the TPU
+# relay at interpreter startup and hangs every process when the relay is down.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+exec python -m pytest tests/ "$@"

@@ -1,0 +1,28 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh so distributed
+paths are exercised without a TPU pod (SURVEY.md §4).
+
+IMPORTANT — run the suite via `scripts/test.sh` (or export these yourself):
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m pytest tests/
+
+The axon sitecustomize on PYTHONPATH imports jax and dials the remote TPU
+relay at *interpreter startup*, before pytest loads this file; when the relay
+is wedged that handshake hangs every python process, and nothing conftest does
+can run. The settings below are belt-and-braces for when the relay is healthy:
+they steer an already-imported jax to CPU before the first backend init.
+"""
+
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,105 @@
+"""Density + E-step math vs scipy (SURVEY.md §4 'GMM log-density vs scipy')."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from scipy.stats import multivariate_normal
+
+from mgproto_tpu.ops.gaussian import (
+    diag_gaussian_log_prob,
+    e_step,
+    mixture_log_likelihood,
+    momentum_update,
+    pairwise_sq_dists,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_log_prob_matches_scipy(rng):
+    n, c, k, d = 7, 3, 2, 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    means = rng.normal(size=(c, k, d)).astype(np.float32)
+    sigmas = rng.uniform(0.3, 1.5, size=(c, k, d)).astype(np.float32)
+
+    got = np.asarray(diag_gaussian_log_prob(jnp.array(x), jnp.array(means), jnp.array(sigmas)))
+    assert got.shape == (n, c, k)
+    for ci in range(c):
+        for ki in range(k):
+            want = multivariate_normal.logpdf(
+                x, mean=means[ci, ki], cov=np.diag(sigmas[ci, ki] ** 2)
+            )
+            np.testing.assert_allclose(got[:, ci, ki], want, rtol=2e-4, atol=2e-4)
+
+
+def test_log_prob_reference_formula_sigma_form(rng):
+    """Reference model.py:272 uses std-parameterized covs (sigma, not var)."""
+    n, d = 4, 6
+    x = rng.normal(size=(n, d)).astype(np.float64)
+    mu = rng.normal(size=(1, 1, d)).astype(np.float64)
+    sigma = np.full((1, 1, d), 1 / np.sqrt(2 * np.pi))
+    want = (
+        -0.5 * d * np.log(2 * np.pi)
+        - np.log(sigma[0, 0]).sum()
+        - 0.5 * (((x - mu[0, 0]) / sigma[0, 0]) ** 2).sum(-1)
+    )
+    got = np.asarray(diag_gaussian_log_prob(jnp.array(x), jnp.array(mu), jnp.array(sigma)))
+    # f32 quadratic-expansion evaluation vs f64 direct formula
+    np.testing.assert_allclose(got[:, 0, 0], want, rtol=1e-4, atol=1e-3)
+
+
+def test_mixture_log_likelihood_equals_log_weighted_sum(rng):
+    n, c, k = 5, 4, 3
+    log_prob = rng.normal(size=(n, c, k)).astype(np.float64)
+    priors = rng.dirichlet(np.ones(k), size=c)
+    got = np.asarray(
+        mixture_log_likelihood(jnp.array(log_prob), jnp.log(jnp.array(priors)))
+    )
+    want = np.log(np.sum(np.exp(log_prob) * priors[None], axis=-1))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)  # f32 vs f64
+
+
+def test_mixture_handles_zero_priors(rng):
+    """Pruned slots carry prior 0 -> log prior -inf; logsumexp must ignore."""
+    log_prob = jnp.zeros((2, 1, 3))
+    log_priors = jnp.log(jnp.array([[0.5, 0.5, 0.0]]))
+    out = np.asarray(mixture_log_likelihood(log_prob, log_priors))
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+    assert np.all(np.isfinite(out))
+
+
+def test_e_step_responsibilities_sum_to_one(rng):
+    n, k, d = 50, 4, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    means = rng.normal(size=(k, d)).astype(np.float32)
+    sigmas = np.full((k, d), 0.7, np.float32)
+    priors = np.full((k,), 1 / k, np.float32)
+    _, log_resp = e_step(jnp.array(x), jnp.array(means), jnp.array(sigmas), jnp.array(priors))
+    np.testing.assert_allclose(np.exp(np.asarray(log_resp)).sum(-1), 1.0, rtol=1e-3)
+
+
+def test_e_step_prefers_nearest_component():
+    x = jnp.array([[5.0, 5.0]])
+    means = jnp.array([[5.0, 5.0], [-5.0, -5.0]])
+    sigmas = jnp.ones((2, 2))
+    priors = jnp.array([0.5, 0.5])
+    _, log_resp = e_step(x, means, sigmas, priors)
+    resp = np.exp(np.asarray(log_resp))[0]
+    assert resp[0] > 0.999
+
+
+def test_pairwise_sq_dists(rng):
+    a = rng.normal(size=(4, 3))
+    b = rng.normal(size=(5, 3))
+    got = np.asarray(pairwise_sq_dists(jnp.array(a), jnp.array(b)))
+    want = ((a[:, None] - b[None]) ** 2).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_momentum_update():
+    np.testing.assert_allclose(
+        np.asarray(momentum_update(jnp.array(1.0), jnp.array(0.0), 0.99)), 0.99
+    )

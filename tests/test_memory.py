@@ -1,0 +1,80 @@
+"""Functional memory-bank FIFO semantics (reference utils/memory.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mgproto_tpu.core.memory import (
+    clear_updated,
+    init_memory,
+    memory_pull_all,
+    memory_push,
+)
+
+
+def _push_np(mem, feats, classes, valid=None):
+    n = len(classes)
+    if valid is None:
+        valid = np.ones(n, bool)
+    return memory_push(
+        mem,
+        jnp.array(np.asarray(feats, np.float32)),
+        jnp.array(np.asarray(classes, np.int32)),
+        jnp.array(valid),
+    )
+
+
+def _stored_set(mem, c):
+    feats, mask = memory_pull_all(mem)
+    return {tuple(np.round(v, 4)) for v in np.asarray(feats[c])[np.asarray(mask[c])]}
+
+
+def test_push_appends_and_counts():
+    mem = init_memory(num_classes=3, capacity=4, dim=2)
+    mem = _push_np(mem, [[1, 1], [2, 2], [3, 3]], [0, 1, 0])
+    assert np.asarray(mem.length).tolist() == [2, 1, 0]
+    assert np.asarray(mem.updated).tolist() == [True, True, False]
+    assert _stored_set(mem, 0) == {(1.0, 1.0), (3.0, 3.0)}
+    assert _stored_set(mem, 1) == {(2.0, 2.0)}
+
+
+def test_fifo_eviction_keeps_newest():
+    mem = init_memory(num_classes=1, capacity=3, dim=1)
+    for v in range(5):
+        mem = _push_np(mem, [[float(v)]], [0])
+    assert np.asarray(mem.length).tolist() == [3]
+    # oldest (0, 1) evicted; {2, 3, 4} retained — same retained-set as the
+    # reference's shift-left eviction (memory.py:56-67)
+    assert _stored_set(mem, 0) == {(2.0,), (3.0,), (4.0,)}
+
+
+def test_invalid_rows_dropped():
+    mem = init_memory(num_classes=2, capacity=4, dim=1)
+    mem = _push_np(mem, [[1.0], [2.0], [3.0]], [0, 0, 1], valid=[True, False, True])
+    assert np.asarray(mem.length).tolist() == [1, 1]
+    assert _stored_set(mem, 0) == {(1.0,)}
+
+
+def test_oversized_push_keeps_first_capacity():
+    mem = init_memory(num_classes=1, capacity=3, dim=1)
+    mem = _push_np(mem, [[float(v)] for v in range(6)], [0] * 6)
+    assert np.asarray(mem.length).tolist() == [3]
+    assert _stored_set(mem, 0) == {(0.0,), (1.0,), (2.0,)}
+
+
+def test_push_is_jittable_and_mixed_classes_wrap():
+    mem = init_memory(num_classes=2, capacity=2, dim=1)
+    push = jax.jit(memory_push)
+    for step in range(3):
+        feats = jnp.array([[float(step)], [10.0 + step]])
+        mem = push(mem, feats, jnp.array([0, 1], jnp.int32), jnp.array([True, True]))
+    assert np.asarray(mem.length).tolist() == [2, 2]
+    assert _stored_set(mem, 0) == {(1.0,), (2.0,)}
+    assert _stored_set(mem, 1) == {(11.0,), (12.0,)}
+
+
+def test_clear_updated():
+    mem = init_memory(2, 2, 1)
+    mem = _push_np(mem, [[1.0]], [0])
+    mem = clear_updated(mem)
+    assert not np.asarray(mem.updated).any()

@@ -1,0 +1,74 @@
+"""Mining pool + mask + dedup semantics (reference model.py:188-254)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mgproto_tpu.ops.pooling import (
+    dedup_first_occurrence,
+    mine_mask_activations,
+    top_t_pool,
+)
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_top_t_pool_selects_spatial_max():
+    b, c, k, h, w, d, t = 2, 3, 2, 4, 4, 5, 3
+    log_prob = _rand((b, c, k, h, w))
+    feats = _rand((b, h, w, d), seed=1)
+    out = top_t_pool(jnp.array(log_prob), jnp.array(feats), t)
+
+    flat = log_prob.reshape(b, c, k, h * w)
+    want_vals = -np.sort(-flat, axis=-1)[..., :t]
+    np.testing.assert_allclose(np.asarray(out.log_act), want_vals, rtol=1e-6)
+
+    want_idx = np.argmax(flat, axis=-1)
+    np.testing.assert_array_equal(np.asarray(out.top1_idx), want_idx)
+
+    feats_flat = feats.reshape(b, h * w, d)
+    for bi in range(b):
+        for ci in range(c):
+            for ki in range(k):
+                np.testing.assert_allclose(
+                    np.asarray(out.top1_feat)[bi, ci, ki],
+                    feats_flat[bi, want_idx[bi, ci, ki]],
+                )
+
+
+def test_top_t_log_domain_matches_prob_domain_selection():
+    """log is monotonic: top-T of log p selects the same patches/ordering as
+    top-T of p (the reference pools exp'd densities, model.py:215-217)."""
+    b, c, k, h, w, t = 1, 2, 2, 3, 3, 4
+    log_prob = _rand((b, c, k, h, w), seed=2) * 10
+    feats = _rand((b, h, w, 3), seed=3)
+    out = top_t_pool(jnp.array(log_prob), jnp.array(feats), t)
+    prob_flat = np.exp(log_prob).reshape(b, c, k, h * w)
+    want = -np.sort(-prob_flat, axis=-1)[..., :t]
+    np.testing.assert_allclose(np.exp(np.asarray(out.log_act)), want, rtol=1e-5)
+
+
+def test_mine_mask_keeps_gt_levels_and_pins_wrong_class_to_top1():
+    b, c, k, t = 2, 3, 1, 4
+    act = jnp.array(_rand((b, c, k, t), seed=4))
+    labels = jnp.array([0, 2])
+    out = np.asarray(mine_mask_activations(act, labels))
+    a = np.asarray(act)
+    for bi, gt in enumerate([0, 2]):
+        for ci in range(c):
+            np.testing.assert_allclose(out[bi, ci, :, 0], a[bi, ci, :, 0])
+            for ti in range(1, t):
+                want = a[bi, ci, :, ti] if ci == gt else a[bi, ci, :, 0]
+                np.testing.assert_allclose(out[bi, ci, :, ti], want)
+
+
+def test_mine_mask_none_labels_is_identity():
+    act = jnp.array(_rand((2, 3, 2, 4), seed=5))
+    np.testing.assert_array_equal(np.asarray(mine_mask_activations(act, None)), np.asarray(act))
+
+
+def test_dedup_first_occurrence():
+    idx = jnp.array([[3, 3, 1, 3, 1, 2]])
+    mask = np.asarray(dedup_first_occurrence(idx))
+    np.testing.assert_array_equal(mask[0], [True, False, True, False, False, True])

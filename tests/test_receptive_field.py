@@ -1,0 +1,62 @@
+"""RF arithmetic golden values (generated from the reference's closed-form
+math in utils/receptive_field.py:111-141 on the stacks the backbones emit)."""
+
+from mgproto_tpu.ops.receptive_field import (
+    RFInfo,
+    propagate,
+    proto_layer_rf_info,
+    rf_box_at,
+)
+
+
+def _resnet34_stack(include_stem_pool=False):
+    ks, ss, ps = [7], [2], [3]
+    if include_stem_pool:
+        ks += [3]
+        ss += [2]
+        ps += [1]
+    for n, s0 in [(3, 1), (4, 2), (6, 2), (3, 2)]:
+        for i in range(n):
+            ks += [3, 3]
+            ss += [s0 if i == 0 else 1, 1]
+            ps += [1, 1]
+    return ks, ss, ps
+
+
+def _vgg19_stack():
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+           512, 512, 512, 512, "M", 512, 512, 512, 512]
+    ks, ss, ps = [], [], []
+    for v in cfg:
+        if v == "M":
+            ks += [2]; ss += [2]; ps += [0]
+        else:
+            ks += [3]; ss += [1]; ps += [1]
+    return ks, ss, ps
+
+
+def test_resnet34_no_stem_pool_golden():
+    rf = proto_layer_rf_info(224, *_resnet34_stack(False), proto_kernel_size=1)
+    assert (rf.grid_size, rf.jump, rf.rf_size, rf.start) == (14, 16, 451, 0.5)
+
+
+def test_resnet34_with_stem_pool_golden():
+    rf = proto_layer_rf_info(224, *_resnet34_stack(True), proto_kernel_size=1)
+    assert (rf.grid_size, rf.jump, rf.rf_size, rf.start) == (7, 32, 899, 0.5)
+
+
+def test_vgg19_golden():
+    rf = proto_layer_rf_info(224, *_vgg19_stack(), proto_kernel_size=1)
+    assert (rf.grid_size, rf.jump, rf.rf_size, rf.start) == (14, 16, 252, 8.0)
+
+
+def test_same_padding_matches_int_padding_for_stride1():
+    a = propagate(RFInfo(224, 1, 1, 0.5), 3, 1, 1)
+    b = propagate(RFInfo(224, 1, 1, 0.5), 3, 1, "SAME")
+    assert a == b
+
+
+def test_rf_box_clipped_to_image():
+    rf = proto_layer_rf_info(224, *_resnet34_stack(False), proto_kernel_size=1)
+    h0, h1, w0, w1 = rf_box_at(rf, 224, 0, 13)
+    assert h0 == 0 and h1 <= 224 and 0 <= w0 < w1 <= 224
