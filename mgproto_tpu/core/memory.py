@@ -68,7 +68,10 @@ def memory_push(
     """
     c, cap, _ = mem.feats.shape
     sentinel = jnp.int32(c)
-    cls = jnp.where(valid, classes.astype(jnp.int32), sentinel)  # [N]
+    # negative ids must also hit the sentinel: .at[] with mode='drop' drops
+    # out-of-bounds but *wraps* negative indices
+    ok = valid & (classes >= 0) & (classes < c)
+    cls = jnp.where(ok, classes.astype(jnp.int32), sentinel)  # [N]
 
     one_hot = jax.nn.one_hot(cls, c, dtype=jnp.int32)  # [N, C] (sentinel -> 0s)
     csum = jnp.cumsum(one_hot, axis=0)  # inclusive
@@ -76,7 +79,7 @@ def memory_push(
         jnp.take_along_axis(csum, jnp.clip(cls, 0, c - 1)[:, None], axis=1)[:, 0]
         - 1
     )  # [N] 0-based rank within class, in batch order
-    keep = valid & (rank < cap)
+    keep = ok & (rank < cap)
     cls = jnp.where(keep, cls, sentinel)
 
     cursor_ext = jnp.concatenate([mem.cursor, jnp.zeros((1,), jnp.int32)])

@@ -1,0 +1,3 @@
+from mgproto_tpu.models.registry import build_backbone, BACKBONES, BackboneSpec
+
+__all__ = ["build_backbone", "BACKBONES", "BackboneSpec"]

@@ -1,0 +1,60 @@
+"""Shared building blocks for the Flax backbone zoo.
+
+Conventions:
+  * NHWC layout (TPU-native; XLA tiles NHWC convs onto the MXU directly).
+  * BatchNorm state lives in the `batch_stats` collection; `train` toggles
+    use_running_average — cross-chip stats come from `axis_name='data'` when
+    a mesh is active.
+  * Module/parameter names deliberately mirror the torch module paths of the
+    reference backbones (conv1, bn1, layer1/0/conv2, ...) so the
+    torch->flax checkpoint converter (models/convert.py) is a mechanical
+    key/layout transform rather than a lookup table.
+  * Each backbone exposes `conv_info()` -> (kernels, strides, paddings) for
+    the receptive-field arithmetic, describing the ops the forward pass
+    ACTUALLY runs (the reference includes stem pools it skips — see
+    ops/receptive_field.py docstring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ConvInfo = Tuple[List[int], List[int], List[Any]]
+
+# torch BatchNorm2d defaults: momentum=0.1 (flax momentum = 1 - 0.1), eps=1e-5
+BatchNorm = partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5)
+
+
+def conv(
+    features: int,
+    kernel: int,
+    stride: int = 1,
+    padding: int = 0,
+    use_bias: bool = False,
+    name: str | None = None,
+) -> nn.Conv:
+    return nn.Conv(
+        features=features,
+        kernel_size=(kernel, kernel),
+        strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        use_bias=use_bias,
+        name=name,
+    )
+
+
+def max_pool(x: jnp.ndarray, kernel: int, stride: int, padding: int) -> jnp.ndarray:
+    return nn.max_pool(
+        x,
+        window_shape=(kernel, kernel),
+        strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+    )
+
+
+def avg_pool(x: jnp.ndarray, kernel: int, stride: int) -> jnp.ndarray:
+    return nn.avg_pool(x, window_shape=(kernel, kernel), strides=(stride, stride))

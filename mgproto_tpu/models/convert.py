@@ -1,0 +1,186 @@
+"""Torch-checkpoint -> Flax-variables converter for the backbone zoo.
+
+Keeps the reference's "pretrained=True" capability (resnet_features.py:228-317,
+densenet_features.py:178-328, vgg_features.py:127-293) without torch at train
+time: the torchvision / BBN-iNaturalist state_dicts are converted once, on
+host, to a flax {params, batch_stats} tree and saved as an orbax/npz
+checkpoint. Handles the reference's checkpoint-key quirks:
+
+  * BBN iNat R50: 'module.backbone.' prefix strip + cb_block/rb_block ->
+    layer4.2/layer4.3 renames (resnet_features.py:283-287);
+  * legacy DenseNet 'norm.1' -> 'norm1' key regex (densenet_features.py:192-207)
+    — normalized here by simply dropping dots inside layer-local names;
+  * classifier/fc heads dropped (trunks only).
+
+Layout transforms: conv [O,I,kh,kw] -> [kh,kw,I,O]; linear [O,I] -> [I,O];
+BatchNorm weight/bias -> scale/bias (params), running_mean/var -> mean/var
+(batch_stats).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+
+def _set(tree: Dict, path: Tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def normalize_torch_keys(state: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Strip wrapper prefixes and legacy dot-names so every key looks like the
+    modern torchvision layout."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in state.items():
+        k = re.sub(r"^module\.", "", k)
+        k = re.sub(r"^backbone\.", "", k)
+        # BBN iNaturalist R50 (resnet_features.py:286)
+        k = k.replace("cb_block", "layer4.2").replace("rb_block", "layer4.3")
+        # legacy densenet 'norm.1.weight' -> 'norm1.weight'
+        k = re.sub(r"\.(norm|relu|conv)\.(\d)\.", r".\1\2.", k)
+        # densenet checkpoints nest under 'features.'
+        k = re.sub(r"^features\.", "", k)
+        if k.startswith(("classifier.", "fc.")):
+            continue
+        out[k] = np.asarray(v)
+    return out
+
+
+def _convert_bn(
+    params: Dict, stats: Dict, flax_path: Tuple[str, ...],
+    state: Mapping[str, np.ndarray], torch_prefix: str,
+) -> None:
+    _set(params, flax_path + ("scale",), state[torch_prefix + ".weight"])
+    _set(params, flax_path + ("bias",), state[torch_prefix + ".bias"])
+    _set(stats, flax_path + ("mean",), state[torch_prefix + ".running_mean"])
+    _set(stats, flax_path + ("var",), state[torch_prefix + ".running_var"])
+
+
+def _convert_conv(
+    params: Dict, flax_path: Tuple[str, ...],
+    state: Mapping[str, np.ndarray], torch_prefix: str,
+) -> None:
+    _set(params, flax_path + ("kernel",), _conv_kernel(state[torch_prefix + ".weight"]))
+    if torch_prefix + ".bias" in state:
+        _set(params, flax_path + ("bias",), state[torch_prefix + ".bias"])
+
+
+def convert_resnet(
+    state: Mapping[str, np.ndarray], layers: Tuple[int, ...], bottleneck: bool
+) -> Dict[str, Any]:
+    state = normalize_torch_keys(state)
+    params: Dict = {}
+    stats: Dict = {}
+    _convert_conv(params, ("conv1",), state, "conv1")
+    _convert_bn(params, stats, ("bn1",), state, "bn1")
+    n_convs = 3 if bottleneck else 2
+    for li, blocks in enumerate(layers, start=1):
+        for bi in range(blocks):
+            t = f"layer{li}.{bi}"
+            f = f"layer{li}_{bi}"
+            for ci in range(1, n_convs + 1):
+                _convert_conv(params, (f, f"conv{ci}"), state, f"{t}.conv{ci}")
+                _convert_bn(params, stats, (f, f"bn{ci}"), state, f"{t}.bn{ci}")
+            if f"{t}.downsample.0.weight" in state:
+                _convert_conv(params, (f, "downsample_conv"), state, f"{t}.downsample.0")
+                _convert_bn(params, stats, (f, "downsample_bn"), state, f"{t}.downsample.1")
+    return {"params": params, "batch_stats": stats}
+
+
+def convert_vgg(
+    state: Mapping[str, np.ndarray], cfg: Tuple, batch_norm: bool
+) -> Dict[str, Any]:
+    """Torch VGG `features.{seq_idx}` -> our `conv{j}`/`bn{j}` naming: walk the
+    cfg the same way _make_layers does, tracking the torch sequential index."""
+    state = normalize_torch_keys(state)
+    params: Dict = {}
+    stats: Dict = {}
+    seq = 0
+    conv_idx = 0
+    for v in cfg:
+        if v == "M":
+            seq += 1  # pool (present in torch checkpoints' indexing)
+            continue
+        _convert_conv(params, (f"conv{conv_idx}",), state, f"{seq}")
+        seq += 1
+        if batch_norm:
+            _convert_bn(params, stats, (f"bn{conv_idx}",), state, f"{seq}")
+            seq += 1
+        seq += 1  # relu
+        conv_idx += 1
+    return {"params": params, "batch_stats": stats}
+
+
+def convert_densenet(
+    state: Mapping[str, np.ndarray], block_config: Tuple[int, ...]
+) -> Dict[str, Any]:
+    state = normalize_torch_keys(state)
+    params: Dict = {}
+    stats: Dict = {}
+    _convert_conv(params, ("conv0",), state, "conv0")
+    _convert_bn(params, stats, ("norm0",), state, "norm0")
+    for bi, num_layers in enumerate(block_config, start=1):
+        for li in range(1, num_layers + 1):
+            t = f"denseblock{bi}.denselayer{li}"
+            f = f"denseblock{bi}_denselayer{li}"
+            _convert_bn(params, stats, (f, "norm1"), state, f"{t}.norm1")
+            _convert_conv(params, (f, "conv1"), state, f"{t}.conv1")
+            _convert_bn(params, stats, (f, "norm2"), state, f"{t}.norm2")
+            _convert_conv(params, (f, "conv2"), state, f"{t}.conv2")
+        if bi != len(block_config):
+            t = f"transition{bi}"
+            _convert_bn(params, stats, (t, "norm"), state, f"{t}.norm")
+            _convert_conv(params, (t, "conv"), state, f"{t}.conv")
+    _convert_bn(params, stats, ("norm5",), state, "norm5")
+    return {"params": params, "batch_stats": stats}
+
+
+def convert_backbone(arch: str, state: Mapping[str, np.ndarray]) -> Dict[str, Any]:
+    """Dispatch on architecture name (registry names)."""
+    from mgproto_tpu.models import vgg as vgg_mod
+
+    if arch.startswith("resnet"):
+        layers = {
+            "resnet18": ((2, 2, 2, 2), False),
+            "resnet34": ((3, 4, 6, 3), False),
+            "resnet50": ((3, 4, 6, 4), True),
+            "resnet101": ((3, 4, 23, 3), True),
+            "resnet152": ((3, 8, 36, 3), True),
+        }[arch]
+        return convert_resnet(state, *layers)
+    if arch.startswith("vgg"):
+        bn = arch.endswith("_bn")
+        cfg_key = {"vgg11": "A", "vgg13": "B", "vgg16": "D", "vgg19": "E"}[
+            arch.replace("_bn", "")
+        ]
+        return convert_vgg(state, tuple(vgg_mod.CFGS[cfg_key]), bn)
+    if arch.startswith("densenet"):
+        cfgs = {
+            "densenet121": (6, 12, 24, 16),
+            "densenet169": (6, 12, 32, 32),
+            "densenet201": (6, 12, 48, 32),
+            "densenet161": (6, 12, 36, 24),
+        }
+        return convert_densenet(state, cfgs[arch])
+    raise ValueError(f"no converter for {arch!r}")
+
+
+def load_torch_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Load a .pth state_dict to numpy (torch is a host-side tool only)."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    if "state_dict" in obj and isinstance(obj["state_dict"], dict):
+        obj = obj["state_dict"]
+    return {k: v.numpy() for k, v in obj.items() if hasattr(v, "numpy")}

@@ -1,0 +1,126 @@
+"""DenseNet feature trunks (Flax), reference parity with
+models/densenet_features.py.
+
+Reference quirks reproduced: the stem pool0 is removed
+(densenet_features.py:116) — `stem_pool=False` default — and a final BN+ReLU
+caps the trunk (densenet_features.py:151-152). conv_info() reports executed
+ops only (the reference counts the removed pool0, densenet_features.py:119).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mgproto_tpu.models.common import BatchNorm, ConvInfo, avg_pool, conv, max_pool
+
+
+class DenseLayer(nn.Module):
+    """BN-ReLU-1x1 -> BN-ReLU-3x3, output concatenated to input
+    (reference densenet_features.py:18-47)."""
+
+    growth_rate: int
+    bn_size: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        y = BatchNorm(name="norm1")(x, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.bn_size * self.growth_rate, 1, 1, 0, name="conv1")(y)
+        y = BatchNorm(name="norm2")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.growth_rate, 3, 1, 1, name="conv2")(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    """BN-ReLU-1x1 + 2x2 avgpool (reference densenet_features.py:71-84)."""
+
+    out_features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = BatchNorm(name="norm")(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = conv(self.out_features, 1, 1, 0, name="conv")(x)
+        return avg_pool(x, 2, 2)
+
+
+class DenseNetFeatures(nn.Module):
+    growth_rate: int = 32
+    block_config: Sequence[int] = (6, 12, 24, 16)
+    num_init_features: int = 64
+    bn_size: int = 4
+    stem_pool: bool = False  # reference removes pool0 (densenet_features.py:116)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv(self.num_init_features, 7, 2, 3, name="conv0")(x)
+        x = BatchNorm(name="norm0")(x, use_running_average=not train)
+        x = nn.relu(x)
+        if self.stem_pool:
+            x = max_pool(x, 3, 2, 1)
+
+        num_features = self.num_init_features
+        for bi, num_layers in enumerate(self.block_config):
+            for li in range(num_layers):
+                x = DenseLayer(
+                    growth_rate=self.growth_rate,
+                    bn_size=self.bn_size,
+                    name=f"denseblock{bi + 1}_denselayer{li + 1}",
+                )(x, train)
+            num_features += num_layers * self.growth_rate
+            if bi != len(self.block_config) - 1:
+                num_features //= 2
+                x = Transition(
+                    out_features=num_features, name=f"transition{bi + 1}"
+                )(x, train)
+
+        x = BatchNorm(name="norm5")(x, use_running_average=not train)
+        return nn.relu(x)
+
+    @property
+    def out_channels(self) -> int:
+        n = self.num_init_features
+        for bi, num_layers in enumerate(self.block_config):
+            n += num_layers * self.growth_rate
+            if bi != len(self.block_config) - 1:
+                n //= 2
+        return n
+
+    def conv_info(self) -> ConvInfo:
+        ks: List[int] = [7]
+        ss: List[int] = [2]
+        ps: List[int] = [3]
+        if self.stem_pool:
+            ks += [3]
+            ss += [2]
+            ps += [1]
+        for bi, num_layers in enumerate(self.block_config):
+            for _ in range(num_layers):
+                ks += [1, 3]
+                ss += [1, 1]
+                ps += [0, 1]
+            if bi != len(self.block_config) - 1:
+                ks += [1, 2]
+                ss += [1, 2]
+                ps += [0, 0]
+        return ks, ss, ps
+
+
+def densenet121(**kw):
+    return DenseNetFeatures(32, (6, 12, 24, 16), 64, **kw)
+
+
+def densenet169(**kw):
+    return DenseNetFeatures(32, (6, 12, 32, 32), 64, **kw)
+
+
+def densenet201(**kw):
+    return DenseNetFeatures(32, (6, 12, 48, 32), 64, **kw)
+
+
+def densenet161(**kw):
+    return DenseNetFeatures(48, (6, 12, 36, 24), 96, **kw)

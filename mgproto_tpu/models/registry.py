@@ -1,0 +1,71 @@
+"""Backbone registry (reference model.py:21-37 `base_architecture_to_features`)
+plus a tiny CNN for tests/dry-runs."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict
+
+import flax.linen as nn
+
+from mgproto_tpu.models import densenet, resnet, vgg
+from mgproto_tpu.models.common import BatchNorm, ConvInfo, conv
+
+
+class TinyFeatures(nn.Module):
+    """A 3-conv trunk used by unit tests and the multi-chip dry run; same
+    structural contract (NHWC in/out, conv_info, out_channels) as the zoo."""
+
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv(self.width, 3, 2, 1, name="conv0")(x)
+        x = BatchNorm(name="bn0")(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = conv(self.width, 3, 2, 1, name="conv1")(x)
+        x = BatchNorm(name="bn1")(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = conv(self.width, 3, 1, 1, name="conv2")(x)
+        return nn.relu(x)
+
+    @property
+    def out_channels(self) -> int:
+        return self.width
+
+    def conv_info(self) -> ConvInfo:
+        return [3, 3, 3], [2, 2, 1], [1, 1, 1]
+
+
+@dataclasses.dataclass(frozen=True)
+class BackboneSpec:
+    factory: Callable[..., nn.Module]
+    family: str  # resnet | vgg | densenet | tiny
+
+
+BACKBONES: Dict[str, BackboneSpec] = {
+    "resnet18": BackboneSpec(resnet.resnet18, "resnet"),
+    "resnet34": BackboneSpec(resnet.resnet34, "resnet"),
+    "resnet50": BackboneSpec(resnet.resnet50, "resnet"),
+    "resnet101": BackboneSpec(resnet.resnet101, "resnet"),
+    "resnet152": BackboneSpec(resnet.resnet152, "resnet"),
+    "vgg11": BackboneSpec(vgg.vgg11, "vgg"),
+    "vgg11_bn": BackboneSpec(vgg.vgg11_bn, "vgg"),
+    "vgg13": BackboneSpec(vgg.vgg13, "vgg"),
+    "vgg13_bn": BackboneSpec(vgg.vgg13_bn, "vgg"),
+    "vgg16": BackboneSpec(vgg.vgg16, "vgg"),
+    "vgg16_bn": BackboneSpec(vgg.vgg16_bn, "vgg"),
+    "vgg19": BackboneSpec(vgg.vgg19, "vgg"),
+    "vgg19_bn": BackboneSpec(vgg.vgg19_bn, "vgg"),
+    "densenet121": BackboneSpec(densenet.densenet121, "densenet"),
+    "densenet161": BackboneSpec(densenet.densenet161, "densenet"),
+    "densenet169": BackboneSpec(densenet.densenet169, "densenet"),
+    "densenet201": BackboneSpec(densenet.densenet201, "densenet"),
+    "tiny": BackboneSpec(TinyFeatures, "tiny"),
+}
+
+
+def build_backbone(arch: str, **kw) -> nn.Module:
+    if arch not in BACKBONES:
+        raise ValueError(f"unknown backbone {arch!r}; options: {sorted(BACKBONES)}")
+    return BACKBONES[arch].factory(**kw)

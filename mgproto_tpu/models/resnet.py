@@ -1,0 +1,156 @@
+"""ResNet feature trunks (Flax), reference parity with models/resnet_features.py.
+
+Reference quirks reproduced:
+  * the stem maxpool is SKIPPED in the forward pass (resnet_features.py:199),
+    doubling the latent grid (14x14 -> 28x28 for R50-style stacks at 224);
+    controlled by `stem_pool` (default False = reference behavior);
+  * resnet50 uses layers [3, 4, 6, 4] — an extra layer4 block so the BBN
+    iNaturalist checkpoint's cb/rb blocks map to layer4.2/layer4.3
+    (resnet_features.py:276-287).
+
+conv_info() reports only ops the forward actually executes (unlike the
+reference, which always counts the skipped maxpool, resnet_features.py:140).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import flax.linen as nn
+
+from mgproto_tpu.models.common import BatchNorm, ConvInfo, conv, max_pool
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity shortcut (reference resnet_features.py:27-69)."""
+
+    planes: int
+    stride: int = 1
+    has_downsample: bool = False
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        identity = x
+        out = conv(self.planes, 3, self.stride, 1, name="conv1")(x)
+        out = BatchNorm(name="bn1")(out, use_running_average=not train)
+        out = nn.relu(out)
+        out = conv(self.planes, 3, 1, 1, name="conv2")(out)
+        out = BatchNorm(name="bn2")(out, use_running_average=not train)
+        if self.has_downsample:
+            identity = conv(self.planes, 1, self.stride, 0, name="downsample_conv")(x)
+            identity = BatchNorm(name="downsample_bn")(
+                identity, use_running_average=not train
+            )
+        return nn.relu(out + identity)
+
+    @staticmethod
+    def block_conv_info(stride: int) -> ConvInfo:
+        return [3, 3], [stride, 1], [1, 1]
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 with 4x expansion (reference resnet_features.py:72-119)."""
+
+    planes: int
+    stride: int = 1
+    has_downsample: bool = False
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        identity = x
+        out = conv(self.planes, 1, 1, 0, name="conv1")(x)
+        out = BatchNorm(name="bn1")(out, use_running_average=not train)
+        out = nn.relu(out)
+        out = conv(self.planes, 3, self.stride, 1, name="conv2")(out)
+        out = BatchNorm(name="bn2")(out, use_running_average=not train)
+        out = nn.relu(out)
+        out = conv(self.planes * 4, 1, 1, 0, name="conv3")(out)
+        out = BatchNorm(name="bn3")(out, use_running_average=not train)
+        if self.has_downsample:
+            identity = conv(self.planes * 4, 1, self.stride, 0, name="downsample_conv")(x)
+            identity = BatchNorm(name="downsample_bn")(
+                identity, use_running_average=not train
+            )
+        return nn.relu(out + identity)
+
+    @staticmethod
+    def block_conv_info(stride: int) -> ConvInfo:
+        return [1, 3, 1], [1, stride, 1], [0, 1, 0]
+
+
+class ResNetFeatures(nn.Module):
+    """Conv trunk of ResNet; avgpool/fc removed (reference :122-226)."""
+
+    block_cls: type
+    layers: Sequence[int]
+    stem_pool: bool = False  # reference skips it (resnet_features.py:199)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = conv(64, 7, 2, 3, name="conv1")(x)
+        x = BatchNorm(name="bn1")(x, use_running_average=not train)
+        x = nn.relu(x)
+        if self.stem_pool:
+            x = max_pool(x, 3, 2, 1)
+
+        inplanes = 64
+        for li, (planes, blocks) in enumerate(
+            zip((64, 128, 256, 512), self.layers)
+        ):
+            stride = 1 if li == 0 else 2
+            for bi in range(blocks):
+                s = stride if bi == 0 else 1
+                needs_ds = s != 1 or inplanes != planes * self.block_cls.expansion
+                x = self.block_cls(
+                    planes=planes,
+                    stride=s,
+                    has_downsample=needs_ds and bi == 0,
+                    name=f"layer{li + 1}_{bi}",
+                )(x, train)
+                inplanes = planes * self.block_cls.expansion
+        return x
+
+    @property
+    def out_channels(self) -> int:
+        return 512 * self.block_cls.expansion
+
+    def conv_info(self) -> ConvInfo:
+        ks: List[int] = [7]
+        ss: List[int] = [2]
+        ps: List[int] = [3]
+        if self.stem_pool:
+            ks += [3]
+            ss += [2]
+            ps += [1]
+        for li, blocks in enumerate(self.layers):
+            stride = 1 if li == 0 else 2
+            for bi in range(blocks):
+                k, s, p = self.block_cls.block_conv_info(stride if bi == 0 else 1)
+                ks += k
+                ss += s
+                ps += p
+        return ks, ss, ps
+
+
+def resnet18(**kw) -> ResNetFeatures:
+    return ResNetFeatures(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+def resnet34(**kw) -> ResNetFeatures:
+    return ResNetFeatures(BasicBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet50(**kw) -> ResNetFeatures:
+    # [3,4,6,4]: extra layer4 block for the BBN iNaturalist checkpoint
+    # (reference resnet_features.py:276)
+    return ResNetFeatures(Bottleneck, [3, 4, 6, 4], **kw)
+
+
+def resnet101(**kw) -> ResNetFeatures:
+    return ResNetFeatures(Bottleneck, [3, 4, 23, 3], **kw)
+
+
+def resnet152(**kw) -> ResNetFeatures:
+    return ResNetFeatures(Bottleneck, [3, 8, 36, 3], **kw)

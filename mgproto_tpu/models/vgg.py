@@ -1,0 +1,115 @@
+"""VGG feature trunks (Flax), reference parity with models/vgg_features.py.
+
+Reference quirks reproduced (defaults): the FINAL maxpool of the standard cfg
+is removed (vgg_features.py:64-68), so the latent grid is 14x14 at 224 input;
+`final_relu=False` drops the ReLU after the final conv of non-BN variants
+(vgg_features.py:80-84 — the `i >= n-2` test only ever matches the last conv,
+since the last cfg entry is always 'M'; default True = ReLU kept).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import flax.linen as nn
+
+from mgproto_tpu.models.common import BatchNorm, ConvInfo, conv, max_pool
+
+CFGS = {
+    # reference vgg_features.py:18-23
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512,
+          "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGGFeatures(nn.Module):
+    cfg: Tuple[Union[int, str], ...]
+    batch_norm: bool = False
+    final_maxpool: bool = False  # reference default: final pool removed
+    final_relu: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv_idx = 0
+        n = len(self.cfg)
+        for i, v in enumerate(self.cfg):
+            if v == "M":
+                if i == n - 1 and not self.final_maxpool:
+                    continue
+                x = max_pool(x, 2, 2, 0)
+            else:
+                # torch VGG convs have bias (nn.Conv2d default)
+                x = conv(int(v), 3, 1, 1, use_bias=True, name=f"conv{conv_idx}")(x)
+                if self.batch_norm:
+                    x = BatchNorm(name=f"bn{conv_idx}")(
+                        x, use_running_average=not train
+                    )
+                    x = nn.relu(x)
+                elif i >= n - 2 and not self.final_relu:
+                    pass  # reference vgg_features.py:80-82
+                else:
+                    x = nn.relu(x)
+                conv_idx += 1
+        return x
+
+    @property
+    def out_channels(self) -> int:
+        return int([v for v in self.cfg if v != "M"][-1])
+
+    def conv_info(self) -> ConvInfo:
+        ks: List[int] = []
+        ss: List[int] = []
+        ps: List[int] = []
+        n = len(self.cfg)
+        for i, v in enumerate(self.cfg):
+            if v == "M":
+                if i == n - 1 and not self.final_maxpool:
+                    continue
+                ks += [2]
+                ss += [2]
+                ps += [0]
+            else:
+                ks += [3]
+                ss += [1]
+                ps += [1]
+        return ks, ss, ps
+
+
+def _vgg(cfg_key: str, batch_norm: bool, **kw) -> VGGFeatures:
+    return VGGFeatures(cfg=tuple(CFGS[cfg_key]), batch_norm=batch_norm, **kw)
+
+
+def vgg11(**kw):
+    return _vgg("A", False, **kw)
+
+
+def vgg11_bn(**kw):
+    return _vgg("A", True, **kw)
+
+
+def vgg13(**kw):
+    return _vgg("B", False, **kw)
+
+
+def vgg13_bn(**kw):
+    return _vgg("B", True, **kw)
+
+
+def vgg16(**kw):
+    return _vgg("D", False, **kw)
+
+
+def vgg16_bn(**kw):
+    return _vgg("D", True, **kw)
+
+
+def vgg19(**kw):
+    return _vgg("E", False, **kw)
+
+
+def vgg19_bn(**kw):
+    return _vgg("E", True, **kw)

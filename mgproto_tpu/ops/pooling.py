@@ -69,13 +69,12 @@ def mine_mask_activations(
     """
     if labels is None:
         return log_act
-    b, c, k, t = log_act.shape
+    c = log_act.shape[1]
     is_gt = jax.nn.one_hot(labels, c, dtype=bool)  # [B, C]
     top1 = log_act[..., :1]  # [B, C, K, 1]
     keep = is_gt[:, :, None, None]  # [B, C, 1, 1]
-    masked = jnp.where(keep, log_act, jnp.broadcast_to(top1, log_act.shape))
-    # level 0 is always the true top-1 for every prototype
-    return masked.at[..., 0].set(log_act[..., 0]) if t > 0 else masked
+    # level 0 is untouched either way: top1 IS log_act[..., 0]
+    return jnp.where(keep, log_act, jnp.broadcast_to(top1, log_act.shape))
 
 
 def dedup_first_occurrence(idx: jax.Array) -> jax.Array:

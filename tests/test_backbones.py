@@ -1,0 +1,123 @@
+"""Backbone zoo: shape/grid contracts + numerical parity with the reference
+torch trunks through the weight converter (SURVEY.md §7.2.2)."""
+
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mgproto_tpu.models import build_backbone
+from mgproto_tpu.ops.receptive_field import proto_layer_rf_info
+
+REFERENCE = "/root/reference"
+HAS_REFERENCE = os.path.isdir(os.path.join(REFERENCE, "models"))
+
+
+def _init_and_run(model, x, train=False):
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    if train:
+        out, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    else:
+        out = model.apply(variables, x, train=False)
+    return variables, out
+
+
+@pytest.mark.parametrize(
+    "arch,expect_ch",
+    [
+        ("resnet18", 512),
+        ("resnet50", 2048),
+        ("vgg11_bn", 512),
+        ("densenet121", 1024),
+        ("tiny", 32),
+    ],
+)
+def test_backbone_output_channels_and_grid(arch, expect_ch):
+    model = build_backbone(arch)
+    assert model.out_channels == expect_ch
+    x = jnp.zeros((1, 64, 64, 3))
+    _, out = _init_and_run(model, x)
+    rf = proto_layer_rf_info(64, *model.conv_info())
+    assert out.shape == (1, rf.grid_size, rf.grid_size, expect_ch)
+
+
+def test_resnet34_grid_matches_reference_quirk():
+    """With the stem maxpool skipped (reference resnet_features.py:199), R34
+    at 224 yields a 14x14 latent grid: stem /2 + three stride-2 stages. The
+    reference's own conv_info wrongly counts the skipped pool and reports 7."""
+    model = build_backbone("resnet34")
+    rf = proto_layer_rf_info(224, *model.conv_info())
+    assert rf.grid_size == 14
+
+
+def test_stem_pool_flag_halves_grid():
+    a = build_backbone("resnet18")
+    b = build_backbone("resnet18", stem_pool=True)
+    ra = proto_layer_rf_info(224, *a.conv_info())
+    rb = proto_layer_rf_info(224, *b.conv_info())
+    assert ra.grid_size == 2 * rb.grid_size
+
+
+def _torch_state_to_numpy(module):
+    return {k: v.detach().numpy() for k, v in module.state_dict().items()}
+
+
+@pytest.mark.skipif(not HAS_REFERENCE, reason="reference repo not mounted")
+@pytest.mark.parametrize(
+    "arch,ref_factory",
+    [
+        ("resnet18", "resnet_features.resnet18_features"),
+        ("vgg11_bn", "vgg_features.vgg11_bn_features"),
+        ("vgg11", "vgg_features.vgg11_features"),
+        ("densenet121", "densenet_features.densenet121_features"),
+    ],
+)
+def test_parity_with_reference_torch_trunk(arch, ref_factory):
+    """Random-init reference torch trunk -> convert weights -> identical
+    feature maps (eval mode / running stats)."""
+    torch = pytest.importorskip("torch")
+    sys.path.insert(0, REFERENCE)
+    try:
+        mod_name, fn_name = ref_factory.split(".")
+        ref_mod = __import__(f"models.{mod_name}", fromlist=[fn_name])
+        torch.manual_seed(0)
+        ref = getattr(ref_mod, fn_name)(pretrained=False)
+    finally:
+        sys.path.remove(REFERENCE)
+    ref.eval()
+
+    from mgproto_tpu.models.convert import convert_backbone
+
+    variables = convert_backbone(arch, _torch_state_to_numpy(ref))
+    model = build_backbone(arch)
+
+    x = np.random.default_rng(0).normal(size=(2, 3, 64, 64)).astype(np.float32)
+    with torch.no_grad():
+        want = ref(torch.from_numpy(x)).numpy()  # NCHW
+
+    got = model.apply(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        jnp.array(np.transpose(x, (0, 2, 3, 1))),
+        train=False,
+    )
+    got = np.transpose(np.asarray(got), (0, 3, 1, 2))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.skipif(not HAS_REFERENCE, reason="reference repo not mounted")
+def test_converter_handles_bbn_inat_key_renames():
+    from mgproto_tpu.models.convert import normalize_torch_keys
+
+    state = {
+        "module.backbone.cb_block.conv1.weight": np.zeros((4, 4, 1, 1)),
+        "module.backbone.rb_block.bn1.weight": np.zeros((4,)),
+        "module.classifier.weight": np.zeros((10, 4)),
+    }
+    out = normalize_torch_keys(state)
+    assert "layer4.2.conv1.weight" in out
+    assert "layer4.3.bn1.weight" in out
+    assert not any(k.startswith("classifier") for k in out)
