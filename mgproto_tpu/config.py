@@ -60,6 +60,11 @@ class OptimConfig:
     weight_decay: float = 1e-4  # torch-Adam style L2-in-grad
     lr_decay_gamma: float = 0.4  # StepLR gamma (main.py:212)
     lr_decay_epochs: Tuple[int, ...] = (30, 45, 60, 75, 90)  # main.py:248
+    # The reference's optimizer groups omit the aux embedding Dense entirely
+    # (main.py:205-220: only features/add_on/aux_criterion), so it stays at
+    # its random init while gradients flow THROUGH it into the backbone.
+    # False reproduces that; True trains it with the features group.
+    train_embedding: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

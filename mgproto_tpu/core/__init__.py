@@ -1,3 +1,40 @@
-from mgproto_tpu.core.memory import Memory, init_memory, memory_push, memory_pull_all
+from mgproto_tpu.core.memory import (
+    Memory,
+    clear_updated,
+    init_memory,
+    memory_push,
+    memory_pull_all,
+)
+from mgproto_tpu.core.mgproto import (
+    GMMState,
+    MGProtoFeatures,
+    ForwardOutput,
+    head_forward,
+    init_gmm,
+    l2_normalize,
+    log_px,
+    patch_log_densities,
+)
+from mgproto_tpu.core.em import em_update, make_mean_optimizer, EMAux
+from mgproto_tpu.core.state import TrainState, create_train_state
 
-__all__ = ["Memory", "init_memory", "memory_push", "memory_pull_all"]
+__all__ = [
+    "Memory",
+    "clear_updated",
+    "init_memory",
+    "memory_push",
+    "memory_pull_all",
+    "GMMState",
+    "MGProtoFeatures",
+    "ForwardOutput",
+    "head_forward",
+    "init_gmm",
+    "l2_normalize",
+    "log_px",
+    "patch_log_densities",
+    "em_update",
+    "make_mean_optimizer",
+    "EMAux",
+    "TrainState",
+    "create_train_state",
+]

@@ -1,0 +1,209 @@
+"""Classification + auxiliary deep-metric-learning losses.
+
+Reference: train_and_test.py:37-55 (CE + mine CE), utils/losses.py (DML).
+The reference implements Proxy-Anchor natively (losses.py:29-61) and wraps
+pytorch_metric_learning for the other five; here all six are first-party JAX
+(no pml on TPU), implemented from their published formulations.
+
+Note the reference CLI can only ever reach Proxy-Anchor (main.py:187-198
+reads `args.loss`, which doesn't exist — SURVEY.md §2 dead-code list); the
+others are provided for capability parity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mgproto_tpu.core.mgproto import l2_normalize
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Softmax CE over class log-likelihoods (reference applies
+    F.cross_entropy to log p(x|c), i.e. a second log_softmax on top —
+    identical here)."""
+    return -jnp.mean(
+        jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), labels[:, None], axis=-1
+        )
+    )
+
+
+def mine_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over mining levels t >= 1 (reference train_and_test.py:38)."""
+    t = logits.shape[-1]
+    if t <= 1:
+        return jnp.zeros(())
+    per_level = jax.vmap(cross_entropy, in_axes=(2, None))(
+        logits[..., 1:], labels
+    )
+    return jnp.mean(per_level)
+
+
+# ---------------------------------------------------------------------------
+# auxiliary DML losses on the 32-d embedding
+# ---------------------------------------------------------------------------
+
+
+def init_proxies(key: jax.Array, num_classes: int, sz_embed: int) -> jax.Array:
+    """Kaiming-normal proxies (reference losses.py:33-34: randn then
+    kaiming_normal_ fan_out => std = sqrt(2/fan_out) = sqrt(2/sz_embed))."""
+    return jax.random.normal(key, (num_classes, sz_embed)) * jnp.sqrt(
+        2.0 / sz_embed
+    )
+
+
+def proxy_anchor(
+    embeddings: jax.Array,
+    labels: jax.Array,
+    proxies: jax.Array,
+    margin: float = 0.1,
+    beta: float = 32.0,
+) -> jax.Array:
+    """Proxy-Anchor loss (Kim et al., CVPR 2020); reference losses.py:41-61.
+
+    pos term averages over proxies WITH positives in the batch; neg term
+    averages over all classes.
+    """
+    num_classes = proxies.shape[0]
+    cos = l2_normalize(embeddings) @ l2_normalize(proxies).T  # [B, C]
+    pos_mask = jax.nn.one_hot(labels, num_classes)  # [B, C]
+    neg_mask = 1.0 - pos_mask
+
+    pos_exp = jnp.exp(-beta * (cos - margin))
+    neg_exp = jnp.exp(beta * (cos + margin))
+
+    with_pos = jnp.sum(pos_mask, axis=0) > 0  # [C]
+    num_valid = jnp.maximum(jnp.sum(with_pos), 1)
+
+    p_sim_sum = jnp.sum(pos_exp * pos_mask, axis=0)  # [C]
+    n_sim_sum = jnp.sum(neg_exp * neg_mask, axis=0)
+
+    pos_term = jnp.sum(jnp.log1p(p_sim_sum) * with_pos) / num_valid
+    neg_term = jnp.sum(jnp.log1p(n_sim_sum)) / num_classes
+    return pos_term + neg_term
+
+
+def proxy_nca(
+    embeddings: jax.Array,
+    labels: jax.Array,
+    proxies: jax.Array,
+    softmax_scale: float = 32.0,
+) -> jax.Array:
+    """Proxy-NCA (Movshovitz-Attias et al., ICCV 2017): CE over scaled
+    negative squared distances to L2-normalized proxies."""
+    x = l2_normalize(embeddings)
+    p = l2_normalize(proxies)
+    d2 = jnp.sum((x[:, None, :] - p[None, :, :]) ** 2, axis=-1)  # [B, C]
+    return cross_entropy(-softmax_scale * d2, labels)
+
+
+class _PairMasks(NamedTuple):
+    pos: jax.Array  # [B, B] same-label, i != j
+    neg: jax.Array  # [B, B] different-label
+
+
+def _pair_masks(labels: jax.Array) -> _PairMasks:
+    same = labels[:, None] == labels[None, :]
+    eye = jnp.eye(labels.shape[0], dtype=bool)
+    return _PairMasks(pos=same & ~eye, neg=~same)
+
+
+def multi_similarity(
+    embeddings: jax.Array,
+    labels: jax.Array,
+    thresh: float = 0.5,
+    epsilon: float = 0.1,
+    scale_pos: float = 2.0,
+    scale_neg: float = 50.0,
+) -> jax.Array:
+    """Multi-Similarity loss with its pair miner (Wang et al., CVPR 2019);
+    reference losses.py:77-91 hyperparameters."""
+    s = l2_normalize(embeddings) @ l2_normalize(embeddings).T  # [B, B]
+    m = _pair_masks(labels)
+
+    neg_inf = jnp.finfo(s.dtype).min
+    # miner: negatives harder than (min pos sim - eps); positives harder than
+    # (max neg sim + eps)
+    min_pos = jnp.min(jnp.where(m.pos, s, -neg_inf), axis=1)  # [B]
+    max_neg = jnp.max(jnp.where(m.neg, s, neg_inf), axis=1)
+    pos_keep = m.pos & (s < (max_neg + epsilon)[:, None])
+    neg_keep = m.neg & (s > (min_pos - epsilon)[:, None])
+
+    pos_sum = jnp.sum(jnp.exp(-scale_pos * (s - thresh)) * pos_keep, axis=1)
+    neg_sum = jnp.sum(jnp.exp(scale_neg * (s - thresh)) * neg_keep, axis=1)
+    has_any = (jnp.sum(pos_keep, 1) > 0) | (jnp.sum(neg_keep, 1) > 0)
+    per_anchor = jnp.log1p(pos_sum) / scale_pos + jnp.log1p(neg_sum) / scale_neg
+    return jnp.sum(per_anchor * has_any) / jnp.maximum(jnp.sum(has_any), 1)
+
+
+def contrastive(
+    embeddings: jax.Array,
+    labels: jax.Array,
+    pos_margin: float = 0.0,
+    neg_margin: float = 0.5,
+) -> jax.Array:
+    """Pairwise contrastive loss on euclidean distances (Hadsell et al. 2006);
+    reference losses.py:93-101 (neg_margin=0.5)."""
+    x = embeddings
+    d = jnp.sqrt(
+        jnp.maximum(jnp.sum((x[:, None] - x[None, :]) ** 2, -1), 1e-12)
+    )
+    m = _pair_masks(labels)
+    pos = jnp.maximum(d - pos_margin, 0.0)
+    neg = jnp.maximum(neg_margin - d, 0.0)
+    pos_loss = jnp.sum(pos * m.pos) / jnp.maximum(jnp.sum(m.pos), 1)
+    neg_loss = jnp.sum(neg * m.neg) / jnp.maximum(jnp.sum(m.neg), 1)
+    return pos_loss + neg_loss
+
+
+def triplet_semihard(
+    embeddings: jax.Array, labels: jax.Array, margin: float = 0.1
+) -> jax.Array:
+    """Triplet loss over semihard triplets (reference losses.py:103-113:
+    TripletMarginMiner(type='semihard')): negatives with
+    d_ap < d_an < d_ap + margin."""
+    x = embeddings
+    d = jnp.sqrt(
+        jnp.maximum(jnp.sum((x[:, None] - x[None, :]) ** 2, -1), 1e-12)
+    )
+    m = _pair_masks(labels)
+    d_ap = d[:, :, None]  # anchor-positive [B, B, 1]
+    d_an = d[:, None, :]  # anchor-negative [B, 1, B]
+    valid = m.pos[:, :, None] & m.neg[:, None, :]
+    semihard = valid & (d_an > d_ap) & (d_an < d_ap + margin)
+    losses = jnp.maximum(d_ap - d_an + margin, 0.0)
+    return jnp.sum(losses * semihard) / jnp.maximum(jnp.sum(semihard), 1)
+
+
+def npair(embeddings: jax.Array, labels: jax.Array, l2_reg: float = 0.0) -> jax.Array:
+    """N-pair loss (Sohn, NeurIPS 2016): for each anchor with a positive in
+    the batch, CE over inner-product logits against all other samples
+    (reference losses.py:115-123, normalize_embeddings=False)."""
+    b = embeddings.shape[0]
+    logits = embeddings @ embeddings.T  # [B, B]
+    m = _pair_masks(labels)
+    eye = jnp.eye(b, dtype=bool)
+    # first positive per anchor as the target
+    has_pos = jnp.any(m.pos, axis=1)
+    target = jnp.argmax(m.pos, axis=1)
+    masked = jnp.where(eye, jnp.finfo(logits.dtype).min, logits)
+    logp = jax.nn.log_softmax(masked, axis=1)
+    per_anchor = -jnp.take_along_axis(logp, target[:, None], axis=1)[:, 0]
+    loss = jnp.sum(per_anchor * has_pos) / jnp.maximum(jnp.sum(has_pos), 1)
+    return loss + l2_reg * jnp.mean(jnp.sum(embeddings**2, -1))
+
+
+AUX_LOSSES = {
+    "proxy_anchor": proxy_anchor,
+    "proxy_nca": proxy_nca,
+    "ms": multi_similarity,
+    "contrastive": contrastive,
+    "triplet": triplet_semihard,
+    "npair": npair,
+}
+
+# losses that take trainable proxies as third argument
+PROXY_BASED = {"proxy_anchor", "proxy_nca"}

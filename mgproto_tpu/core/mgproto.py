@@ -1,0 +1,231 @@
+"""The MGProto model: Flax feature extractor + pure-functional GMM head.
+
+TPU-native redesign of reference model.py:77-510. The torch module mixes
+trainable params, frozen buffers, a mutable memory bank and an embedded
+optimizer in one nn.Module; here the pieces live where JAX wants them:
+
+  * `MGProtoFeatures` (flax): backbone trunk + add-on 1x1 convs + auxiliary
+    DML embedding head — everything trained by backprop.
+  * `GMMState` (pytree): prototype means/sigmas/priors + pruning mask —
+    trained only by EM (core/em.py) and push projection (engine/push.py),
+    exactly like the reference where compute_log_prob detaches the means
+    (model.py:264-265) and the last layer is frozen (model.py:64).
+  * `forward()` (pure fn): density -> top-T mining pool -> mine masking ->
+    per-class mixture log-likelihoods, plus deduped memory-enqueue candidates.
+
+Everything is log-domain: the reference exponentiates per-patch log-densities
+(model.py:215), pools probs, then takes log of the priors-weighted sum
+(model.py:222,254). Monotonicity of exp makes top-T selection identical, and
+logsumexp reproduces log(sum pi * p) exactly, without underflow for 64-d
+Gaussians.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mgproto_tpu.config import ModelConfig
+from mgproto_tpu.models import build_backbone
+from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob, mixture_log_likelihood
+from mgproto_tpu.ops.pooling import (
+    PooledActivations,
+    dedup_first_occurrence,
+    mine_mask_activations,
+    top_t_pool,
+)
+
+
+def l2_normalize(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    """F.normalize parity (reference model.py:40-41)."""
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=axis, keepdims=True), eps)
+
+
+class GMMState(NamedTuple):
+    """Per-class Gaussian mixture over prototype space.
+
+    means:  [C, K, d] — trained by EM + push only.
+    sigmas: [C, K, d] — std (not variance), frozen at 1/sqrt(2*pi)
+            (reference model.py:151-152).
+    priors: [C, K]    — mixture weights; the reference stores them as the
+            frozen NonNegLinear weight rows (model.py:154, 298-300).
+    keep:   [C, K] bool — pruning mask (model.py:467-482); pruned slots also
+            have prior zeroed, `keep` is retained for bookkeeping/rendering.
+    """
+
+    means: jax.Array
+    sigmas: jax.Array
+    priors: jax.Array
+    keep: jax.Array
+
+    @property
+    def num_classes(self) -> int:
+        return self.means.shape[0]
+
+    @property
+    def k_per_class(self) -> int:
+        return self.means.shape[1]
+
+
+def init_gmm(cfg: ModelConfig, key: jax.Array) -> GMMState:
+    """L2-normalized uniform-random means, sigma=1/sqrt(2pi), priors=1/K
+    (reference model.py:148-154 + set_last_layer_incorrect_connection
+    model.py:440-447 with incorrect_strength=0)."""
+    c, k, d = cfg.num_classes, cfg.prototypes_per_class, cfg.proto_dim
+    means = l2_normalize(jax.random.uniform(key, (c, k, d), jnp.float32))
+    return GMMState(
+        means=means,
+        sigmas=jnp.full((c, k, d), cfg.init_sigma, jnp.float32),
+        priors=jnp.full((c, k), 1.0 / k, jnp.float32),
+        keep=jnp.ones((c, k), bool),
+    )
+
+
+class AddOnLayers(nn.Module):
+    """1x1 conv adapter into prototype space (reference model.py:117-143).
+
+    'regular' (settings.py:5): two 1x1 convs, NO activations.
+    'bottleneck': channel-halving chain with ReLU, ending in Sigmoid.
+    """
+
+    proto_dim: int
+    add_on_type: str
+    in_channels: int
+
+    @nn.compact
+    def __call__(self, x):
+        if self.add_on_type == "regular":
+            x = nn.Conv(self.proto_dim, (1, 1), name="conv0")(x)
+            x = nn.Conv(self.proto_dim, (1, 1), name="conv1")(x)
+            return x
+        if self.add_on_type == "bottleneck":
+            current_in = self.in_channels
+            i = 0
+            while True:
+                current_out = max(self.proto_dim, current_in // 2)
+                x = nn.Conv(current_out, (1, 1), name=f"conv{i}_a")(x)
+                x = nn.relu(x)
+                x = nn.Conv(current_out, (1, 1), name=f"conv{i}_b")(x)
+                if current_out > self.proto_dim:
+                    x = nn.relu(x)
+                else:
+                    x = nn.sigmoid(x)
+                    return x
+                current_in = current_in // 2
+                i += 1
+        raise ValueError(f"unknown add_on_type {self.add_on_type!r}")
+
+
+class MGProtoFeatures(nn.Module):
+    """Backbone + add-on + aux embedding (reference model.py:176-186).
+
+    Returns (proto_map [B,H,W,d], embed [B,E]): the L2 normalization of the
+    proto map happens in `forward()` so push/eval paths share it.
+    """
+
+    cfg: ModelConfig
+
+    def setup(self):
+        self.features = build_backbone(self.cfg.arch)
+        self.add_on = AddOnLayers(
+            proto_dim=self.cfg.proto_dim,
+            add_on_type=self.cfg.add_on_type,
+            in_channels=self.features.out_channels,
+            name="add_on",
+        )
+        # aux embedding reads the BACKBONE output, not the add-on output
+        # (reference model.py:180-184)
+        self.embedding = nn.Dense(self.cfg.sz_embedding, name="embedding")
+
+    def __call__(self, x, train: bool = False):
+        x = self.features(x, train=train)
+        proto_map = self.add_on(x)
+        pooled = jnp.mean(x, axis=(1, 2))  # GAP (model.py:145)
+        embed = l2_normalize(self.embedding(pooled), axis=-1)
+        return proto_map, embed
+
+    def conv_info(self):
+        return build_backbone(self.cfg.arch).conv_info()
+
+
+class ForwardOutput(NamedTuple):
+    """logits: [B, C, T] log p(x|c) per mining level (t=0 = true likelihood).
+    embed: [B, E] aux DML embedding.
+    enqueue_*: flat memory-bank candidates ([B*K, d], [B*K], [B*K]).
+    pooled: raw pool result (push/analysis)."""
+
+    logits: jax.Array
+    embed: jax.Array
+    enqueue_feats: jax.Array
+    enqueue_classes: jax.Array
+    enqueue_valid: jax.Array
+    pooled: PooledActivations
+
+
+def patch_log_densities(
+    proto_map: jax.Array, gmm: GMMState
+) -> Tuple[jax.Array, jax.Array]:
+    """L2-normalize the proto map and score every patch under every prototype.
+
+    Returns (log_prob [B, C, K, H, W], normalized feature map [B, H, W, d]).
+    Reference: model.py:208-215 (+ blocked compute_log_prob 256-275, replaced
+    by one MXU matmul in ops/gaussian.py).
+    """
+    b, h, w, d = proto_map.shape
+    feat = l2_normalize(proto_map, axis=-1)
+    lp = diag_gaussian_log_prob(feat.reshape(-1, d), gmm.means, gmm.sigmas)
+    lp = lp.reshape(b, h, w, gmm.num_classes, gmm.k_per_class)
+    return jnp.transpose(lp, (0, 3, 4, 1, 2)), feat
+
+
+def head_forward(
+    proto_map: jax.Array,
+    gmm: GMMState,
+    labels: Optional[jax.Array],
+    mine_T: int,
+    prior_eps: float = 1e-10,
+) -> Tuple[jax.Array, PooledActivations, Tuple[jax.Array, jax.Array, jax.Array]]:
+    """GMM head on an add-on feature map: returns (logits [B,C,T], pooled,
+    enqueue candidates). Pure function; no flax."""
+    log_prob, feat = patch_log_densities(proto_map, gmm)
+    pooled = top_t_pool(log_prob, feat, mine_T)
+    act = mine_mask_activations(pooled.log_act, labels)  # [B, C, K, T]
+    log_priors = jnp.log(gmm.priors + prior_eps)  # [C, K]
+    # [B, C, K, T] + [C, K] -> logsumexp over K at each mining level
+    logits = jax.nn.logsumexp(
+        act + log_priors[None, :, :, None], axis=2
+    )  # [B, C, T]
+
+    b, c, k = pooled.top1_idx.shape
+    if labels is not None:
+        # gt-class top-1 features, deduped by spatial index within each sample
+        # (reference model.py:224-250)
+        sel = labels[:, None, None]
+        idx = jnp.take_along_axis(pooled.top1_idx, sel, axis=1)[:, 0]  # [B, K]
+        feats = jnp.take_along_axis(
+            pooled.top1_feat, sel[..., None], axis=1
+        )[:, 0]  # [B, K, d]
+        valid = dedup_first_occurrence(idx)  # [B, K]
+        enq = (
+            feats.reshape(b * k, -1),
+            jnp.repeat(labels, k),
+            valid.reshape(b * k),
+        )
+    else:
+        d = pooled.top1_feat.shape[-1]
+        enq = (
+            jnp.zeros((b * k, d), proto_map.dtype),
+            jnp.full((b * k,), -1, jnp.int32),
+            jnp.zeros((b * k,), bool),
+        )
+    return logits, pooled, enq
+
+
+def log_px(logits_level0: jax.Array) -> jax.Array:
+    """OoD score log p(x) = log sum_c p(x|c) (reference
+    train_and_test.py:184-199 sums probs; logsumexp is the stable form)."""
+    return jax.nn.logsumexp(logits_level0, axis=-1)

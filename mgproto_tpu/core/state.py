@@ -1,0 +1,167 @@
+"""Functional train state: everything the reference keeps as mutable module
+state (params, BN stats, GMM, memory bank, three optimizers, iteration
+counter — SURVEY.md §7.1) as one explicit pytree threaded through jitted
+steps."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from mgproto_tpu.config import Config
+from mgproto_tpu.core.em import make_mean_optimizer
+from mgproto_tpu.core.losses import PROXY_BASED, init_proxies
+from mgproto_tpu.core.memory import Memory, init_memory
+from mgproto_tpu.core.mgproto import GMMState, MGProtoFeatures, init_gmm
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any  # {'net': flax params, 'proxies': [C, E] or absent}
+    batch_stats: Any
+    gmm: GMMState
+    memory: Memory
+    opt_state: Any  # joint optimizer state
+    warm_opt_state: Any  # warm-phase optimizer state (separate Adam, main.py:215-220)
+    proto_opt_state: Any  # EM mean-optimizer state
+
+
+def torch_adam(
+    lr: optax.ScalarOrSchedule, weight_decay: float = 0.0
+) -> optax.GradientTransformation:
+    """torch.optim.Adam semantics: weight decay is added to the GRADIENT
+    before the Adam moments (L2-in-grad), unlike optax.adamw which decays
+    after preconditioning (reference main.py:205-220 uses Adam(weight_decay=1e-4))."""
+    parts = []
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8))
+    parts.append(
+        optax.scale_by_learning_rate(lr)
+    )  # handles schedules and the sign flip
+    return optax.chain(*parts)
+
+
+def staircase_schedule(
+    base_lr: float,
+    steps_per_epoch: int,
+    decay_epochs: Tuple[int, ...],
+    gamma: float,
+    epoch_offset: int = 0,
+) -> Callable[[jax.Array], jax.Array]:
+    """StepLR stepped at fixed ABSOLUTE epochs (reference main.py:248-250:
+    gamma=0.4 at epochs {30,45,60,75,90} for R34, counted from epoch 0
+    regardless of warm-up). The joint optimizer's internal step count starts
+    when the joint phase starts, so `epoch_offset` (= num_warm_epochs) maps
+    its counter back to absolute epochs."""
+
+    def schedule(step: jax.Array) -> jax.Array:
+        epoch = step // steps_per_epoch + epoch_offset
+        n = jnp.sum(jnp.asarray(decay_epochs) <= epoch)
+        return base_lr * (gamma**n)
+
+    return schedule
+
+
+def _param_labels(params: Dict, train_embedding: bool) -> Dict:
+    """Label each top-level param subtree with its optimizer group
+    (reference main.py:205-220: features / add_on_layers / aux_criterion;
+    the embedding Dense is absent from every group there, i.e. frozen)."""
+    net_labels = {}
+    for k in params["net"]:
+        if k == "features":
+            net_labels[k] = "features"
+        elif k == "add_on":
+            net_labels[k] = "add_on"
+        elif k == "embedding":
+            net_labels[k] = "features" if train_embedding else "frozen"
+        else:
+            net_labels[k] = "frozen"
+    labels = {"net": net_labels}
+    if "proxies" in params:
+        labels["proxies"] = "aux"
+    return labels
+
+
+def make_joint_optimizer(
+    cfg: Config, steps_per_epoch: int
+) -> optax.GradientTransformation:
+    o = cfg.optim
+    sched = lambda base: staircase_schedule(
+        base,
+        steps_per_epoch,
+        o.lr_decay_epochs,
+        o.lr_decay_gamma,
+        epoch_offset=cfg.schedule.num_warm_epochs,
+    )
+    return optax.multi_transform(
+        {
+            "features": torch_adam(sched(o.features_lr), o.weight_decay),
+            "add_on": torch_adam(sched(o.add_on_lr), o.weight_decay),
+            "aux": torch_adam(sched(o.aux_proxies_lr), o.weight_decay),
+            "frozen": optax.set_to_zero(),
+        },
+        lambda p: _param_labels(p, o.train_embedding),
+    )
+
+
+def make_warm_optimizer(cfg: Config) -> optax.GradientTransformation:
+    """Warm phase: backbone frozen (reference train_and_test.py:260-268 +
+    main.py:215-220); constant lrs, no staircase (warm epochs precede it)."""
+    o = cfg.optim
+    return optax.multi_transform(
+        {
+            "features": optax.set_to_zero(),
+            "add_on": torch_adam(o.add_on_lr, o.weight_decay),
+            "aux": torch_adam(o.aux_proxies_lr, o.weight_decay),
+            "frozen": optax.set_to_zero(),
+        },
+        lambda p: _param_labels(p, o.train_embedding),
+    )
+
+
+def create_train_state(
+    cfg: Config,
+    steps_per_epoch: int,
+    rng: jax.Array,
+    model: Optional[MGProtoFeatures] = None,
+    joint_tx: Optional[optax.GradientTransformation] = None,
+    warm_tx: Optional[optax.GradientTransformation] = None,
+    proto_tx: Optional[optax.GradientTransformation] = None,
+) -> Tuple[TrainState, MGProtoFeatures]:
+    """Initialize model, GMM, memory and all optimizer states. Callers that
+    already hold the model/transforms (engine.Trainer) pass them in so there
+    is exactly one construction site."""
+    m = cfg.model
+    model = model or MGProtoFeatures(cfg=m)
+    joint_tx = joint_tx or make_joint_optimizer(cfg, steps_per_epoch)
+    warm_tx = warm_tx or make_warm_optimizer(cfg)
+    proto_tx = proto_tx or make_mean_optimizer(cfg.em)
+
+    k_init, k_gmm, k_proxy = jax.random.split(rng, 3)
+    dummy = jnp.zeros((1, m.img_size, m.img_size, 3), jnp.float32)
+    variables = model.init(k_init, dummy, train=False)
+
+    params: Dict[str, Any] = {"net": variables["params"]}
+    if cfg.loss.aux_loss in PROXY_BASED:
+        params["proxies"] = init_proxies(k_proxy, m.num_classes, m.sz_embedding)
+
+    gmm = init_gmm(m, k_gmm)
+    memory = init_memory(m.num_classes, m.mem_capacity, m.proto_dim)
+
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=variables.get("batch_stats", {}),
+        gmm=gmm,
+        memory=memory,
+        opt_state=joint_tx.init(params),
+        warm_opt_state=warm_tx.init(params),
+        proto_opt_state=proto_tx.init(gmm.means),
+    )
+    return state, model

@@ -1,0 +1,3 @@
+from mgproto_tpu.engine.train import Trainer, TrainMetrics
+
+__all__ = ["Trainer", "TrainMetrics"]

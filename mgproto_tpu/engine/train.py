@@ -1,0 +1,246 @@
+"""Jitted train/eval steps + epoch drivers.
+
+Reference: train_and_test.py. One fused, jitted step does what the reference
+spreads over forward / backward / optimizer / memory enqueue / EM call
+(train_and_test.py:26-64): the EM update runs INSIDE the step under lax.cond
+(reference calls model.module.update_GMM() every iteration once gated —
+bypassing DataParallel; here it's just more of the same jitted program, so it
+shards with the rest).
+
+Dynamic gates (`use_mine`, `update_gmm`) are traced scalars, not python
+bools — flipping them mid-training does not retrigger compilation. The
+warm/joint phase IS a static switch (two optimizers with different
+topologies, reference main.py:205-220), giving two compiled variants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mgproto_tpu.config import Config
+from mgproto_tpu.core import losses as L
+from mgproto_tpu.core.em import em_update, make_mean_optimizer
+from mgproto_tpu.core.memory import memory_push
+from mgproto_tpu.core.mgproto import (
+    MGProtoFeatures,
+    ForwardOutput,
+    head_forward,
+    log_px,
+)
+from mgproto_tpu.core.state import (
+    TrainState,
+    create_train_state,
+    make_joint_optimizer,
+    make_warm_optimizer,
+)
+
+
+class TrainMetrics(NamedTuple):
+    loss: jax.Array
+    cross_entropy: jax.Array
+    mine: jax.Array
+    aux: jax.Array
+    accuracy: jax.Array
+    full_mem_ratio: jax.Array  # fraction of classes with a full queue
+    em_active: jax.Array  # classes EM touched this step
+
+
+class EvalOutput(NamedTuple):
+    logits: jax.Array  # [B, C] level-0 class log-likelihoods
+    log_px: jax.Array  # [B] log p(x) OoD score
+    correct: jax.Array  # [B] bool (vs labels if given, else False)
+
+
+class Trainer:
+    """Owns the model + optimizers (static) and the jitted step functions.
+
+    All state flows through `TrainState`; nothing here mutates."""
+
+    def __init__(self, cfg: Config, steps_per_epoch: int):
+        self.cfg = cfg
+        self.steps_per_epoch = steps_per_epoch
+        self.model = MGProtoFeatures(cfg=cfg.model)
+        self.joint_tx = make_joint_optimizer(cfg, steps_per_epoch)
+        self.warm_tx = make_warm_optimizer(cfg)
+        self.proto_tx = make_mean_optimizer(cfg.em)
+        self._train_step = jax.jit(self._step, static_argnames=("warm",))
+        self._eval_step = jax.jit(self._eval)
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        state, _ = create_train_state(
+            self.cfg,
+            self.steps_per_epoch,
+            rng,
+            model=self.model,
+            joint_tx=self.joint_tx,
+            warm_tx=self.warm_tx,
+            proto_tx=self.proto_tx,
+        )
+        return state
+
+    # ------------------------------------------------------------------ train
+    def _apply(
+        self, params, batch_stats, images, train: bool
+    ) -> Tuple[Tuple[jax.Array, jax.Array], Any]:
+        variables = {"params": params["net"], "batch_stats": batch_stats}
+        if train:
+            (proto_map, embed), mut = self.model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            return (proto_map, embed), mut["batch_stats"]
+        proto_map, embed = self.model.apply(variables, images, train=False)
+        return (proto_map, embed), batch_stats
+
+    def _loss_fn(
+        self, params, state: TrainState, images, labels, use_mine: jax.Array
+    ):
+        (proto_map, embed), new_stats = self._apply(
+            params, state.batch_stats, images, train=True
+        )
+        logits, pooled, enq = head_forward(
+            proto_map, state.gmm, labels, self.cfg.model.mine_T
+        )
+        ce = L.cross_entropy(logits[..., 0], labels)
+        mine = L.mine_loss(logits, labels) * use_mine
+        aux_fn = L.AUX_LOSSES[self.cfg.loss.aux_loss]
+        if self.cfg.loss.aux_loss in L.PROXY_BASED:
+            aux = aux_fn(embed, labels, params["proxies"])
+        else:
+            aux = aux_fn(embed, labels)
+        c = self.cfg.loss
+        loss = c.crs_ent * ce + c.mine * mine + c.aux * aux
+        acc = jnp.mean(jnp.argmax(logits[..., 0], -1) == labels)
+        return loss, (new_stats, enq, ce, mine, aux, acc)
+
+    def _step(
+        self,
+        state: TrainState,
+        images: jax.Array,
+        labels: jax.Array,
+        use_mine: jax.Array,
+        update_gmm: jax.Array,
+        *,
+        warm: bool = False,
+    ) -> Tuple[TrainState, TrainMetrics]:
+        grad_fn = jax.value_and_grad(self._loss_fn, has_aux=True)
+        (loss, (new_stats, enq, ce, mine, aux, acc)), grads = grad_fn(
+            state.params, state, images, labels, use_mine
+        )
+
+        tx = self.warm_tx if warm else self.joint_tx
+        opt_state = state.warm_opt_state if warm else state.opt_state
+        updates, opt_state = tx.update(grads, opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        # memory enqueue (reference model.py:228-252, inside forward)
+        memory = memory_push(state.memory, *enq)
+
+        # EM gate (reference train_and_test.py:61-63): epoch-level flag AND
+        # anything in memory AND step % interval == 0
+        interval_ok = (state.step % self.cfg.em.update_interval) == 0
+        do_em = update_gmm & interval_ok & (jnp.sum(memory.length) > 0)
+
+        def run_em(args):
+            gmm, mem, popt = args
+            gmm, mem, popt, aux_em = em_update(
+                gmm, mem, popt, self.proto_tx, self.cfg.em
+            )
+            return gmm, mem, popt, aux_em.num_active
+
+        def skip_em(args):
+            gmm, mem, popt = args
+            return gmm, mem, popt, jnp.zeros((), jnp.int32)
+
+        gmm, memory, proto_opt_state, em_active = jax.lax.cond(
+            do_em, run_em, skip_em, (state.gmm, memory, state.proto_opt_state)
+        )
+
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=new_stats,
+            gmm=gmm,
+            memory=memory,
+            opt_state=state.opt_state if warm else opt_state,
+            warm_opt_state=opt_state if warm else state.warm_opt_state,
+            proto_opt_state=proto_opt_state,
+        )
+        metrics = TrainMetrics(
+            loss=loss,
+            cross_entropy=ce,
+            mine=mine,
+            aux=aux,
+            accuracy=acc,
+            full_mem_ratio=jnp.mean(
+                (memory.length == memory.capacity).astype(jnp.float32)
+            ),
+            em_active=em_active,
+        )
+        return new_state, metrics
+
+    def train_step(
+        self, state, images, labels, use_mine: bool, update_gmm: bool, warm: bool = False
+    ) -> Tuple[TrainState, TrainMetrics]:
+        return self._train_step(
+            state,
+            images,
+            labels,
+            jnp.asarray(use_mine, jnp.float32),
+            jnp.asarray(update_gmm, bool),
+            warm=warm,
+        )
+
+    # ------------------------------------------------------------------- eval
+    def _eval(
+        self, state: TrainState, images: jax.Array, labels: Optional[jax.Array]
+    ) -> EvalOutput:
+        (proto_map, _), _ = self._apply(
+            state.params, state.batch_stats, images, train=False
+        )
+        logits, _, _ = head_forward(
+            proto_map, state.gmm, None, self.cfg.model.mine_T
+        )
+        lvl0 = logits[..., 0]
+        correct = (
+            (jnp.argmax(lvl0, -1) == labels)
+            if labels is not None
+            else jnp.zeros(lvl0.shape[0], bool)
+        )
+        return EvalOutput(logits=lvl0, log_px=log_px(lvl0), correct=correct)
+
+    def eval_step(self, state, images, labels=None) -> EvalOutput:
+        return self._eval_step(state, images, labels)
+
+    # ------------------------------------------------------------ epoch gates
+    def epoch_flags(self, state: TrainState, epoch: int) -> Dict[str, bool]:
+        """Python-side epoch gating (reference main.py:237-238)."""
+        s = self.cfg.schedule
+        all_full = bool(
+            jax.device_get(
+                jnp.all(state.memory.length == state.memory.capacity)
+            )
+        )
+        return {
+            "warm": epoch < s.num_warm_epochs,
+            "use_mine": epoch >= s.mine_start,
+            "update_gmm": (epoch >= s.update_gmm_start) and all_full,
+        }
+
+    def train_epoch(self, state, batches, epoch: int):
+        """Drive one epoch over an iterable of (images, labels) host batches."""
+        flags = self.epoch_flags(state, epoch)
+        last = None
+        for images, labels in batches:
+            state, last = self.train_step(
+                state,
+                jnp.asarray(images),
+                jnp.asarray(labels),
+                use_mine=flags["use_mine"],
+                update_gmm=flags["update_gmm"],
+                warm=flags["warm"],
+            )
+        return state, last

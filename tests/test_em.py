@@ -1,0 +1,122 @@
+"""EM subsystem: recovers synthetic mixtures, respects gating, monotone
+likelihood (SURVEY.md §4 'EM monotonicity on synthetic mixtures')."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mgproto_tpu.config import EMConfig
+from mgproto_tpu.core.em import em_update, make_mean_optimizer
+from mgproto_tpu.core.memory import Memory, init_memory, memory_push
+from mgproto_tpu.core.mgproto import GMMState
+
+
+def _make_gmm(c, k, d, key=0):
+    means = jax.random.normal(jax.random.PRNGKey(key), (c, k, d)) * 0.1
+    return GMMState(
+        means=means,
+        sigmas=jnp.full((c, k, d), 0.5),
+        priors=jnp.full((c, k), 1.0 / k),
+        keep=jnp.ones((c, k), bool),
+    )
+
+
+def _fill_memory(c, cap, d, centers, rng):
+    """Fill every class queue with samples from per-class 2-component
+    mixtures at +/-centers."""
+    mem = init_memory(c, cap, d)
+    for ci in range(c):
+        comp = rng.integers(0, 2, size=cap)
+        x = centers[ci][comp] + rng.normal(size=(cap, d)) * 0.05
+        mem = memory_push(
+            mem,
+            jnp.array(x.astype(np.float32)),
+            jnp.full((cap,), ci, jnp.int32),
+            jnp.ones((cap,), bool),
+        )
+    return mem
+
+
+def test_em_moves_means_toward_clusters_and_updates_priors():
+    c, k, d, cap = 2, 2, 4, 64
+    rng = np.random.default_rng(0)
+    centers = np.stack(
+        [np.stack([np.full(d, 1.0), np.full(d, -1.0)]) for _ in range(c)]
+    )
+    mem = _fill_memory(c, cap, d, centers, rng)
+    gmm = _make_gmm(c, k, d)
+    cfg = EMConfig(mean_lr=5e-2)
+    tx = make_mean_optimizer(cfg)
+    opt = tx.init(gmm.means)
+
+    step = jax.jit(lambda g, m, o: em_update(g, m, o, tx, cfg))
+    for _ in range(60):
+        gmm, mem, opt, aux = step(gmm, mem, opt)
+        # refill the updated flags so every call is active
+        mem = mem._replace(updated=jnp.ones((c,), bool))
+
+    means = np.asarray(gmm.means)
+    for ci in range(c):
+        # one prototype near +1 cluster, one near -1 (diversity + NLL)
+        signs = sorted(np.sign(means[ci].mean(-1)).tolist())
+        assert signs == [-1.0, 1.0], means[ci].mean(-1)
+    priors = np.asarray(gmm.priors)
+    np.testing.assert_allclose(priors.sum(-1), 1.0, atol=0.05)
+
+
+def test_em_skips_inactive_classes():
+    c, k, d, cap = 3, 2, 4, 16
+    rng = np.random.default_rng(1)
+    centers = np.stack(
+        [np.stack([np.full(d, 1.0), np.full(d, -1.0)]) for _ in range(c)]
+    )
+    mem = _fill_memory(c, cap, d, centers, rng)
+    # only class 0 marked updated
+    mem = mem._replace(updated=jnp.array([True, False, False]))
+    gmm = _make_gmm(c, k, d)
+    cfg = EMConfig()
+    tx = make_mean_optimizer(cfg)
+    gmm2, mem2, _, aux = em_update(gmm, mem, tx.init(gmm.means), tx, cfg)
+
+    assert int(aux.num_active) == 1
+    assert not np.allclose(np.asarray(gmm2.means[0]), np.asarray(gmm.means[0]))
+    np.testing.assert_array_equal(np.asarray(gmm2.means[1]), np.asarray(gmm.means[1]))
+    np.testing.assert_array_equal(np.asarray(gmm2.priors[2]), np.asarray(gmm.priors[2]))
+    assert not np.asarray(mem2.updated).any()
+
+
+def test_em_requires_full_queue():
+    c, k, d, cap = 2, 2, 4, 16
+    mem = init_memory(c, cap, d)
+    # half-full queue for class 0, marked updated
+    mem = memory_push(
+        mem,
+        jnp.ones((cap // 2, d)),
+        jnp.zeros((cap // 2,), jnp.int32),
+        jnp.ones((cap // 2,), bool),
+    )
+    gmm = _make_gmm(c, k, d)
+    cfg = EMConfig()
+    tx = make_mean_optimizer(cfg)
+    gmm2, _, _, aux = em_update(gmm, mem, tx.init(gmm.means), tx, cfg)
+    assert int(aux.num_active) == 0
+    np.testing.assert_array_equal(np.asarray(gmm2.means), np.asarray(gmm.means))
+
+
+def test_em_likelihood_improves():
+    c, k, d, cap = 1, 3, 6, 128
+    rng = np.random.default_rng(2)
+    centers = np.stack([np.stack([np.full(d, 2.0), np.full(d, -2.0)])])
+    mem = _fill_memory(c, cap, d, centers, rng)
+    gmm = _make_gmm(c, k, d, key=5)
+    cfg = EMConfig(mean_lr=3e-2)
+    tx = make_mean_optimizer(cfg)
+    opt = tx.init(gmm.means)
+
+    lls = []
+    for _ in range(40):
+        gmm, mem, opt, aux = em_update(gmm, mem, opt, tx, cfg)
+        mem = mem._replace(updated=jnp.ones((c,), bool))
+        lls.append(float(aux.log_likelihood))
+    assert lls[-1] > lls[0] + 1.0, (lls[0], lls[-1])

@@ -1,0 +1,113 @@
+"""MGProto model head semantics (reference model.py:208-254)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.core import (
+    create_train_state,
+    head_forward,
+    init_gmm,
+    l2_normalize,
+    log_px,
+    patch_log_densities,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_test_config()
+    state, model = create_train_state(cfg, steps_per_epoch=10, rng=jax.random.PRNGKey(0))
+    return cfg, state, model
+
+
+def _proto_map(cfg, b=4, seed=0):
+    rng = np.random.default_rng(seed)
+    h = cfg.model.img_size // 4
+    return jnp.array(
+        rng.normal(size=(b, h, h, cfg.model.proto_dim)).astype(np.float32)
+    )
+
+
+def test_forward_shapes(setup):
+    cfg, state, model = setup
+    m = cfg.model
+    pm = _proto_map(cfg)
+    labels = jnp.array([0, 1, 2, 3])
+    logits, pooled, enq = head_forward(pm, state.gmm, labels, m.mine_T)
+    assert logits.shape == (4, m.num_classes, m.mine_T)
+    assert pooled.log_act.shape == (4, m.num_classes, m.prototypes_per_class, m.mine_T)
+    assert enq[0].shape == (4 * m.prototypes_per_class, m.proto_dim)
+    assert enq[1].shape == enq[2].shape == (4 * m.prototypes_per_class,)
+
+
+def test_logits_equal_log_weighted_prob_sum(setup):
+    """Log-domain head == reference's log(sum_k pi * exp(log_density_pooled))
+    (model.py:215-222,254)."""
+    cfg, state, _ = setup
+    pm = _proto_map(cfg)
+    logits, pooled, _ = head_forward(pm, state.gmm, None, cfg.model.mine_T)
+    act = np.asarray(pooled.log_act)  # [B, C, K, T] (no masking: labels=None)
+    priors = np.asarray(state.gmm.priors)  # [C, K]
+    want = np.log(
+        np.sum(np.exp(act) * priors[None, :, :, None], axis=2) + 1e-300
+    )
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=1e-4, atol=1e-4)
+
+
+def test_mine_levels_share_top1_for_wrong_classes(setup):
+    cfg, state, _ = setup
+    pm = _proto_map(cfg)
+    labels = jnp.array([0, 1, 2, 3])
+    logits_gt, _, _ = head_forward(pm, state.gmm, labels, cfg.model.mine_T)
+    # for a wrong class c != gt, every mining level equals level 0
+    lg = np.asarray(logits_gt)
+    for b, gt in enumerate([0, 1, 2, 3]):
+        for c in range(cfg.model.num_classes):
+            if c == gt:
+                continue
+            np.testing.assert_allclose(lg[b, c, 1:], lg[b, c, 0], rtol=1e-6)
+
+
+def test_eval_mode_no_enqueue(setup):
+    cfg, state, _ = setup
+    pm = _proto_map(cfg)
+    _, _, enq = head_forward(pm, state.gmm, None, cfg.model.mine_T)
+    assert not np.asarray(enq[2]).any()
+
+
+def test_log_px_is_logsumexp_over_classes(setup):
+    cfg, state, _ = setup
+    pm = _proto_map(cfg)
+    logits, _, _ = head_forward(pm, state.gmm, None, cfg.model.mine_T)
+    got = np.asarray(log_px(logits[..., 0]))
+    want = np.log(np.sum(np.exp(np.asarray(logits[..., 0])), axis=-1))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_init_gmm_invariants():
+    cfg = tiny_test_config()
+    gmm = init_gmm(cfg.model, jax.random.PRNGKey(1))
+    norms = np.linalg.norm(np.asarray(gmm.means), axis=-1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(gmm.priors).sum(-1), 1.0, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(gmm.sigmas), 1 / np.sqrt(2 * np.pi), rtol=1e-6
+    )
+
+
+def test_patch_log_densities_l2_normalizes(setup):
+    cfg, state, _ = setup
+    pm = _proto_map(cfg)
+    lp, feat = patch_log_densities(pm, state.gmm)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(feat), axis=-1), 1.0, rtol=1e-4
+    )
+    b, h = pm.shape[0], pm.shape[1]
+    assert lp.shape == (
+        b, cfg.model.num_classes, cfg.model.prototypes_per_class, h, h,
+    )

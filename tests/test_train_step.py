@@ -1,0 +1,134 @@
+"""End-to-end jitted train step on a tiny synthetic task (SURVEY.md §4
+'2-class/4-prototype end-to-end step')."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.engine import Trainer
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_test_config(num_classes=4, mem_capacity=8, img_size=32)
+    trainer = Trainer(cfg, steps_per_epoch=4)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    return cfg, trainer, state
+
+
+def _batch(cfg, rng, b=8):
+    """Class-colored images: class c has a bright channel pattern."""
+    labels = rng.integers(0, cfg.model.num_classes, size=b)
+    imgs = rng.normal(size=(b, cfg.model.img_size, cfg.model.img_size, 3)) * 0.1
+    for i, c in enumerate(labels):
+        imgs[i, :, :, c % 3] += 1.0 + 0.5 * (c // 3)
+    return jnp.array(imgs.astype(np.float32)), jnp.array(labels)
+
+
+def test_train_step_runs_and_updates(setup):
+    cfg, trainer, state = setup
+    rng = np.random.default_rng(0)
+    imgs, labels = _batch(cfg, rng)
+    new_state, metrics = trainer.train_step(
+        state, imgs, labels, use_mine=False, update_gmm=False
+    )
+    assert int(new_state.step) == int(state.step) + 1
+    assert np.isfinite(float(metrics.loss))
+    # params changed
+    before = jax.tree_util.tree_leaves(state.params["net"])[0]
+    after = jax.tree_util.tree_leaves(new_state.params["net"])[0]
+    assert not np.array_equal(np.asarray(before), np.asarray(after))
+    # the aux embedding head is frozen by default (the reference's optimizer
+    # groups omit it, main.py:205-220)
+    np.testing.assert_array_equal(
+        np.asarray(state.params["net"]["embedding"]["kernel"]),
+        np.asarray(new_state.params["net"]["embedding"]["kernel"]),
+    )
+    # memory received gt-class candidates
+    assert int(jnp.sum(new_state.memory.length)) > 0
+    # gmm untouched without the gate
+    np.testing.assert_array_equal(
+        np.asarray(new_state.gmm.means), np.asarray(state.gmm.means)
+    )
+
+
+def test_warm_step_freezes_backbone(setup):
+    cfg, trainer, state = setup
+    rng = np.random.default_rng(1)
+    imgs, labels = _batch(cfg, rng)
+    new_state, _ = trainer.train_step(
+        state, imgs, labels, use_mine=False, update_gmm=False, warm=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(
+            jax.tree_util.tree_leaves(state.params["net"]["features"])[0]
+        ),
+        np.asarray(
+            jax.tree_util.tree_leaves(new_state.params["net"]["features"])[0]
+        ),
+    )
+    # add_on still trains
+    assert not np.array_equal(
+        np.asarray(jax.tree_util.tree_leaves(state.params["net"]["add_on"])[0]),
+        np.asarray(
+            jax.tree_util.tree_leaves(new_state.params["net"]["add_on"])[0]
+        ),
+    )
+
+
+def test_em_triggers_once_memory_full(setup):
+    cfg, trainer, state = setup
+    rng = np.random.default_rng(2)
+    # fill memory: every class appears often enough
+    for _ in range(30):
+        imgs, labels = _batch(cfg, rng, b=8)
+        state, metrics = trainer.train_step(
+            state, imgs, labels, use_mine=False, update_gmm=False
+        )
+        if float(metrics.full_mem_ratio) == 1.0:
+            break
+    assert float(metrics.full_mem_ratio) == 1.0, "memory never filled"
+
+    means_before = np.asarray(state.gmm.means)
+    state, metrics = trainer.train_step(
+        state, imgs, labels, use_mine=True, update_gmm=True
+    )
+    assert int(metrics.em_active) > 0
+    assert not np.array_equal(means_before, np.asarray(state.gmm.means))
+    priors = np.asarray(state.gmm.priors)
+    np.testing.assert_allclose(priors.sum(-1), 1.0, atol=0.1)
+
+
+def test_loss_decreases_over_training(setup):
+    cfg, trainer, _ = setup
+    state = trainer.init_state(jax.random.PRNGKey(3))
+    rng = np.random.default_rng(3)
+    losses = []
+    for step in range(25):
+        imgs, labels = _batch(cfg, rng, b=8)
+        state, metrics = trainer.train_step(
+            state, imgs, labels, use_mine=False, update_gmm=False
+        )
+        losses.append(float(metrics.cross_entropy))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_eval_step_consistency(setup):
+    cfg, trainer, state = setup
+    rng = np.random.default_rng(4)
+    imgs, labels = _batch(cfg, rng)
+    out = trainer.eval_step(state, imgs, labels)
+    assert out.logits.shape == (8, cfg.model.num_classes)
+    assert np.isfinite(np.asarray(out.log_px)).all()
+    # eval never mutates anything: rerun gives identical output
+    out2 = trainer.eval_step(state, imgs, labels)
+    np.testing.assert_array_equal(np.asarray(out.logits), np.asarray(out2.logits))
+
+
+def test_epoch_flags(setup):
+    cfg, trainer, state = setup
+    flags = trainer.epoch_flags(state, epoch=0)
+    assert flags["use_mine"] is True  # tiny config: mine_start=0
+    assert flags["update_gmm"] is False  # memory empty
