@@ -1,0 +1,107 @@
+"""Throughput benchmark: steady-state MGProto train step, images/sec/chip.
+
+Measures the flagship recipe (ResNet-34 + CUB-200 shapes, batch 80 — the
+reference's default, reference settings.py:22 / main.py:22) in its HEAVIEST
+steady state: joint phase, mine loss on, memory enqueue on, and the EM update
+fully active every iteration (reference update_interval=1, model.py:171, with
+all 200 class queues full — the post-epoch-35 regime).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+`vs_baseline` compares against an ESTIMATED single-A100 throughput of the
+reference PyTorch implementation (never measured in-repo, BASELINE.md:
+"Throughput ... never measured"): ~350 img/s for R34-224 fwd+bwd+density —
+bounded in practice by the reference's python-loop memory enqueue
+(reference model.py:228-252) and python-loop EM over 200 classes
+(model.py:281-298). The driver north star is >=6x that on a v5e-8
+(BASELINE.json.north_star); this bench runs on ONE chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_EST_IMAGES_PER_SEC = 350.0
+
+BATCH = 80
+WARMUP = 3
+ITERS = 10
+
+
+def main() -> None:
+    from mgproto_tpu.config import Config, ModelConfig
+    from mgproto_tpu.engine.train import Trainer
+
+    cfg = Config(
+        model=ModelConfig(arch="resnet34", num_classes=200, pretrained=False)
+    )
+    trainer = Trainer(cfg, steps_per_epoch=100)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+
+    # steady state: all class queues full + touched, so EM is fully active
+    mem = state.memory
+    rng = jax.random.PRNGKey(1)
+    feats = jax.random.uniform(rng, mem.feats.shape, jnp.float32)
+    feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+    state = state.replace(
+        memory=mem._replace(
+            feats=feats,
+            length=jnp.full_like(mem.length, mem.capacity),
+            cursor=jnp.zeros_like(mem.cursor),
+            updated=jnp.ones_like(mem.updated),
+        )
+    )
+
+    host = np.random.RandomState(0)
+    images = jnp.asarray(
+        host.rand(BATCH, cfg.model.img_size, cfg.model.img_size, 3),
+        jnp.float32,
+    )
+    labels = jnp.asarray(
+        host.randint(0, cfg.model.num_classes, size=(BATCH,)), jnp.int32
+    )
+
+    def step(s):
+        s, m = trainer.train_step(
+            s, images, labels, use_mine=True, update_gmm=True, warm=False
+        )
+        # keep EM active every iteration (enqueue alone re-marks only the
+        # label classes)
+        return s.replace(
+            memory=s.memory._replace(updated=jnp.ones_like(s.memory.updated))
+        ), m
+
+    # NB: a host readback (device_get of a scalar) is the sync point; under
+    # tunneled device platforms block_until_ready can return before the device
+    # actually finishes, which inflates throughput ~1000x.
+    for _ in range(WARMUP):
+        state, metrics = step(state)
+    float(jax.device_get(metrics.loss))
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = step(state)
+    float(jax.device_get(metrics.loss))
+    int(jax.device_get(state.step))
+    dt = time.perf_counter() - t0
+
+    value = BATCH * ITERS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "mgproto_r34_cub_train_step_throughput",
+                "value": round(value, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(value / A100_EST_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,6 @@ from mgproto_tpu.core.memory import (
 from mgproto_tpu.core.mgproto import (
     GMMState,
     MGProtoFeatures,
-    ForwardOutput,
     head_forward,
     init_gmm,
     l2_normalize,
@@ -26,7 +25,6 @@ __all__ = [
     "memory_pull_all",
     "GMMState",
     "MGProtoFeatures",
-    "ForwardOutput",
     "head_forward",
     "init_gmm",
     "l2_normalize",

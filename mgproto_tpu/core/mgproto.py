@@ -10,8 +10,8 @@ optimizer in one nn.Module; here the pieces live where JAX wants them:
     trained only by EM (core/em.py) and push projection (engine/push.py),
     exactly like the reference where compute_log_prob detaches the means
     (model.py:264-265) and the last layer is frozen (model.py:64).
-  * `forward()` (pure fn): density -> top-T mining pool -> mine masking ->
-    per-class mixture log-likelihoods, plus deduped memory-enqueue candidates.
+  * `head_forward()` (pure fn): density -> top-T mining pool -> mine masking
+    -> per-class mixture log-likelihoods, plus deduped enqueue candidates.
 
 Everything is log-domain: the reference exponentiates per-patch log-densities
 (model.py:215), pools probs, then takes log of the priors-weighted sum
@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from mgproto_tpu.config import ModelConfig
 from mgproto_tpu.models import build_backbone
-from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob, mixture_log_likelihood
+from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob
 from mgproto_tpu.ops.pooling import (
     PooledActivations,
     dedup_first_occurrence,
@@ -150,20 +150,6 @@ class MGProtoFeatures(nn.Module):
 
     def conv_info(self):
         return build_backbone(self.cfg.arch).conv_info()
-
-
-class ForwardOutput(NamedTuple):
-    """logits: [B, C, T] log p(x|c) per mining level (t=0 = true likelihood).
-    embed: [B, E] aux DML embedding.
-    enqueue_*: flat memory-bank candidates ([B*K, d], [B*K], [B*K]).
-    pooled: raw pool result (push/analysis)."""
-
-    logits: jax.Array
-    embed: jax.Array
-    enqueue_feats: jax.Array
-    enqueue_classes: jax.Array
-    enqueue_valid: jax.Array
-    pooled: PooledActivations
 
 
 def patch_log_densities(

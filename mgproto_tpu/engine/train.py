@@ -27,7 +27,6 @@ from mgproto_tpu.core.em import em_update, make_mean_optimizer
 from mgproto_tpu.core.memory import memory_push
 from mgproto_tpu.core.mgproto import (
     MGProtoFeatures,
-    ForwardOutput,
     head_forward,
     log_px,
 )

@@ -1,0 +1,33 @@
+"""Distributed runtime: device mesh, shardings, SPMD trainer.
+
+Replaces the reference's `torch.nn.DataParallel` single-process replication
+(reference main.py:184) with a first-class mesh runtime over ICI/DCN
+(SURVEY.md §2.3, §5.8)."""
+
+from mgproto_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    initialize_distributed,
+    make_mesh,
+)
+from mgproto_tpu.parallel.sharding import (
+    batch_sharding,
+    class_sharding,
+    put_batch,
+    replicated,
+    state_shardings,
+)
+from mgproto_tpu.parallel.trainer import ShardedTrainer
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "initialize_distributed",
+    "make_mesh",
+    "batch_sharding",
+    "class_sharding",
+    "put_batch",
+    "replicated",
+    "state_shardings",
+    "ShardedTrainer",
+]

@@ -1,0 +1,84 @@
+"""Device mesh construction + multi-host bring-up.
+
+The reference's entire parallelism story is single-process
+`torch.nn.DataParallel` (reference main.py:184, run.sh:12 — one GPU). The
+TPU-native equivalent (SURVEY.md §2.3, §5.8) is one global `jax.sharding.Mesh`
+over every chip with two logical axes:
+
+  * ``data``  — batch sharding (the DP axis); gradients and BatchNorm batch
+    statistics reduce over it automatically under SPMD jit.
+  * ``model`` — class-axis sharding of the GMM head, memory bank and EM (the
+    tensor-parallel analogue for this model family: classes are independent
+    until the final [B, C] stack, SURVEY.md §5.7).
+
+Multi-host pods: call `initialize_distributed()` once per process before any
+jax op; the mesh then spans all processes' devices and pjit collectives ride
+ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+_distributed_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up (idempotent). Must run before any other jax call.
+    On TPU pods all three arguments are auto-detected from the environment; on
+    CPU/GPU clusters pass them explicitly. Replaces the reference's absent
+    `torch.distributed` story.
+
+    With explicit arguments, failures propagate (a wrong coordinator address
+    must not silently fall back to single-host). With no arguments the call is
+    best-effort: on single-host environments with nothing to auto-detect it is
+    a no-op."""
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _distributed_initialized = True
+    except (ValueError, RuntimeError):
+        if explicit:
+            raise
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global 2-axis mesh.
+
+    Args:
+      data:  size of the data axis; -1 = all remaining devices.
+      model: size of the model (class-sharding) axis.
+      devices: defaults to `jax.devices()` (global, all processes).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if model < 1 or n % model:
+        raise ValueError(f"model axis {model} must divide device count {n}")
+    if data == -1:
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    return Mesh(np.asarray(devs).reshape(data, model), (DATA_AXIS, MODEL_AXIS))

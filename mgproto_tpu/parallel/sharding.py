@@ -1,0 +1,93 @@
+"""Sharding specs for the MGProto train state and data batches.
+
+Layout (SURVEY.md §2.3 "TPU-native equivalent"):
+
+  * batch arrays         -> P('data')   — sharded on the leading batch axis.
+  * net params/opt state -> replicated  — the whole model is ~20M params; DP
+    replication is the right call (prototype tensors are tiny: 200x10x64).
+  * gmm / memory / EM optimizer state -> P('model') on the CLASS axis when the
+    mesh has a model axis — per-class density, enqueue and EM are all
+    class-independent, so the (B*H*W) x (C*K) density matrix and the
+    [C, cap, d] memory bank partition cleanly (SURVEY.md §5.7's
+    ImageNet-1000 stretch layout).
+
+Under SPMD jit the three replica hazards of the reference become collectives
+XLA inserts for us: memory enqueue sees the global batch (all_gather over
+'data'), gradients and BatchNorm batch stats psum over 'data', and the EM
+sufficient statistics stay local to each class shard (no collective at all).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mgproto_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over the data axis (any rank)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def class_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over the model axis (any rank)."""
+    return NamedSharding(mesh, P(MODEL_AXIS))
+
+
+def _class_shard_tree(tree: Any, mesh: Mesh, num_classes: int) -> Any:
+    """Shard every leaf whose leading axis is the class axis; replicate the
+    rest (e.g. optax scalar step counters)."""
+    repl = replicated(mesh)
+    cls = class_sharding(mesh)
+    model_size = mesh.shape[MODEL_AXIS]
+
+    def per_leaf(x):
+        if (
+            hasattr(x, "ndim")
+            and x.ndim >= 1
+            and x.shape[0] == num_classes
+            and num_classes % model_size == 0
+        ):
+            return cls
+        return repl
+
+    return jax.tree.map(per_leaf, tree)
+
+
+def state_shardings(state: Any, mesh: Mesh, num_classes: int) -> Any:
+    """A TrainState-shaped pytree of NamedShardings for `state`."""
+    repl = replicated(mesh)
+    sh = jax.tree.map(lambda _: repl, state)
+    if mesh.shape[MODEL_AXIS] > 1:
+        sh = sh.replace(
+            gmm=_class_shard_tree(state.gmm, mesh, num_classes),
+            memory=_class_shard_tree(state.memory, mesh, num_classes),
+            proto_opt_state=_class_shard_tree(
+                state.proto_opt_state, mesh, num_classes
+            ),
+        )
+    return sh
+
+
+def put_batch(batch: Any, mesh: Mesh) -> Any:
+    """Place a host batch onto the mesh, sharded on the data axis.
+
+    Single-process: a plain sharded device_put of the global batch.
+    Multi-host: each process passes its LOCAL shard of the global batch and
+    the global array is assembled across processes (the `jax.distributed`
+    path the reference has no analogue for)."""
+    sh = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sh)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)),
+        batch,
+    )

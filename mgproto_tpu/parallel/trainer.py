@@ -1,0 +1,125 @@
+"""Mesh-sharded Trainer: the distributed runtime around engine.train.Trainer.
+
+The step functions themselves are unchanged — SPMD jit partitions the same
+program the single-chip Trainer runs, with shardings pinned so that:
+
+  * the batch lives split over 'data' (scatter the reference does per forward
+    via DataParallel, main.py:184 — here it never materializes unsharded);
+  * params/opt state are replicated and gradients arrive all-reduced (the
+    NCCL allreduce the reference never got to, SURVEY.md §2.3);
+  * gmm/memory/EM state is class-sharded over 'model' when the mesh has one,
+    so density scoring, enqueue and EM scale past 1000 classes.
+
+This design FIXES the reference's lost-update bug by construction: memory
+enqueue candidates from every data shard are globally visible to the one
+logical `memory_push` (reference loses all non-primary replicas' writes,
+model.py:228-252 under DataParallel).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mgproto_tpu.config import Config
+from mgproto_tpu.engine.train import EvalOutput, Trainer, TrainMetrics
+from mgproto_tpu.core.state import TrainState
+from mgproto_tpu.parallel.mesh import make_mesh
+from mgproto_tpu.parallel.sharding import (
+    batch_sharding,
+    put_batch,
+    replicated,
+    state_shardings,
+)
+
+
+class ShardedTrainer(Trainer):
+    """Trainer whose jitted steps run SPMD over a device mesh.
+
+    Usage:
+        trainer = ShardedTrainer(cfg, steps_per_epoch)       # mesh from cfg
+        state = trainer.init_state(rng)                       # sharded state
+        state, m = trainer.train_step(state, images, labels, ...)
+
+    State restored from a checkpoint must pass through `prepare(state)` once
+    before stepping.
+    """
+
+    def __init__(
+        self, cfg: Config, steps_per_epoch: int, mesh: Optional[Mesh] = None
+    ):
+        super().__init__(cfg, steps_per_epoch)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            cfg.mesh.data, cfg.mesh.model
+        )
+        self._repl = replicated(self.mesh)
+        self._batch_sh = batch_sharding(self.mesh)
+        self._state_sh = None  # built lazily from the first state seen
+
+    # -------------------------------------------------------------- plumbing
+    def _build_jits(self, state_sh: Any) -> None:
+        self._state_sh = state_sh
+        # pjit forbids kwargs alongside in_shardings, so the static `warm`
+        # flag becomes two compiled variants dispatched host-side (matching
+        # the two optimizer topologies, reference main.py:205-220)
+        in_sh = (state_sh, self._batch_sh, self._batch_sh, self._repl, self._repl)
+        out_sh = (state_sh, self._repl)
+        jits = {
+            w: jax.jit(
+                functools.partial(self._step, warm=w),
+                in_shardings=in_sh,
+                out_shardings=out_sh,
+            )
+            for w in (False, True)
+        }
+        self._train_step = lambda state, images, labels, mine, gmm, warm=False: (
+            jits[bool(warm)](state, images, labels, mine, gmm)
+        )
+        eval_out_sh = EvalOutput(
+            logits=self._batch_sh, log_px=self._batch_sh, correct=self._batch_sh
+        )
+        self._eval_step = jax.jit(
+            self._eval,
+            in_shardings=(state_sh, self._batch_sh, self._batch_sh),
+            out_shardings=eval_out_sh,
+        )
+
+    def prepare(self, state: TrainState) -> TrainState:
+        """Pin `state` to its mesh sharding (and build the sharded jits)."""
+        sh = state_shardings(state, self.mesh, self.cfg.model.num_classes)
+        if self._state_sh is None:
+            self._build_jits(sh)
+        return jax.device_put(state, sh)
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        return self.prepare(super().init_state(rng))
+
+    def put_batch(self, batch: Any) -> Any:
+        """Host batch -> data-sharded device arrays (multi-host aware)."""
+        return put_batch(batch, self.mesh)
+
+    # ----------------------------------------------------------------- steps
+    def train_step(
+        self,
+        state: TrainState,
+        images: jax.Array,
+        labels: jax.Array,
+        use_mine: bool,
+        update_gmm: bool,
+        warm: bool = False,
+    ) -> Tuple[TrainState, TrainMetrics]:
+        images, labels = self.put_batch((jnp.asarray(images), jnp.asarray(labels)))
+        return super().train_step(state, images, labels, use_mine, update_gmm, warm)
+
+    def eval_step(
+        self, state: TrainState, images: jax.Array, labels=None
+    ) -> EvalOutput:
+        if labels is None:
+            # sharded eval always carries a label array; -1 never matches argmax
+            labels = jnp.full((jnp.asarray(images).shape[0],), -1, jnp.int32)
+        images, labels = self.put_batch((jnp.asarray(images), jnp.asarray(labels)))
+        return self._eval_step(state, images, labels)
