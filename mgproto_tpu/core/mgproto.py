@@ -180,7 +180,11 @@ def head_forward(
     log_prob, feat = patch_log_densities(proto_map, gmm)
     pooled = top_t_pool(log_prob, feat, mine_T)
     act = mine_mask_activations(pooled.log_act, labels)  # [B, C, K, T]
-    log_priors = jnp.log(gmm.priors + prior_eps)  # [C, K]
+    # exactly-zero priors (pruned slots, model.py:481-482) must contribute
+    # exp(-inf)=0, not eps — eps only stabilizes small-but-live priors
+    log_priors = jnp.where(
+        gmm.priors > 0, jnp.log(gmm.priors + prior_eps), -jnp.inf
+    )  # [C, K]
     # [B, C, K, T] + [C, K] -> logsumexp over K at each mining level
     logits = jax.nn.logsumexp(
         act + log_priors[None, :, :, None], axis=2
@@ -209,6 +213,23 @@ def head_forward(
             jnp.zeros((b * k,), bool),
         )
     return logits, pooled, enq
+
+
+def prune_top_m(gmm: GMMState, top_m: int) -> GMMState:
+    """Keep each class's top-M prototypes by prior; zero the rest.
+
+    Reference `prune_prototypes_topM` (model.py:467-482): the per-class
+    keep set is `prior >= kth-largest prior` (so prior TIES at the threshold
+    keep MORE than M slots, exactly as the reference's `>=` does), pruned
+    slots get prior 0 in the classifier weights, and priors are NOT
+    renormalized. Density for pruned slots still gets computed here (they
+    contribute exp(-inf)=0 via the zero prior), matching the reference where
+    pruned columns stay in the weight matrix as zeros."""
+    if not (1 <= top_m <= gmm.k_per_class):
+        raise ValueError(f"top_m {top_m} not in [1, {gmm.k_per_class}]")
+    thresh = jax.lax.top_k(gmm.priors, top_m)[0][:, -1]  # [C] kth largest
+    keep = gmm.priors >= thresh[:, None]  # [C, K]
+    return gmm._replace(priors=jnp.where(keep, gmm.priors, 0.0), keep=keep)
 
 
 def log_px(logits_level0: jax.Array) -> jax.Array:

@@ -1,0 +1,61 @@
+"""Data layer: datasets, transforms, loaders.
+
+Replaces the reference's inline torchvision pipelines (reference
+main.py:96-163) with torchvision-free PIL/numpy transforms and a threaded
+prefetching loader."""
+
+from mgproto_tpu.data.folder import Cub2011Eval, ImageFolder, Sample
+from mgproto_tpu.data.loader import DataLoader
+from mgproto_tpu.data.transforms import (
+    ood_transform,
+    push_transform,
+    test_transform,
+    train_transform,
+)
+
+__all__ = [
+    "Cub2011Eval",
+    "ImageFolder",
+    "Sample",
+    "DataLoader",
+    "ood_transform",
+    "push_transform",
+    "test_transform",
+    "train_transform",
+]
+
+
+def build_pipelines(cfg):
+    """The reference's four loaders from one DataConfig (main.py:96-163):
+    (train, push, test, [ood...]) — ood list may be empty."""
+    from mgproto_tpu.config import Config
+
+    assert isinstance(cfg, Config)
+    d, img = cfg.data, cfg.model.img_size
+    train = DataLoader(
+        ImageFolder(d.train_dir, train_transform(img)),
+        d.train_batch_size,
+        shuffle=True,
+        drop_last=True,
+        num_workers=d.num_workers,
+        seed=cfg.seed,
+    )
+    push = DataLoader(
+        ImageFolder(d.train_push_dir, push_transform(img)),
+        d.train_push_batch_size,
+        num_workers=d.num_workers,
+    )
+    test = DataLoader(
+        ImageFolder(d.test_dir, test_transform(img)),
+        d.test_batch_size,
+        num_workers=d.num_workers,
+    )
+    oods = [
+        DataLoader(
+            ImageFolder(o, ood_transform(img)),
+            d.test_batch_size,
+            num_workers=d.num_workers,
+        )
+        for o in d.ood_dirs
+    ]
+    return train, push, test, oods

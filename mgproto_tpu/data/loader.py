@@ -1,0 +1,154 @@
+"""Threaded prefetching batch loader.
+
+The reference trains with `num_workers=0` — every JPEG decoded serially on
+the main thread between optimizer steps (reference main.py:94; SURVEY.md
+§7.3.6 calls this the bottleneck-by-neglect). Here decode/augment runs on a
+thread pool (PIL decode releases the GIL) overlapped with device compute, and
+batches are pre-assembled into pinned numpy arrays ready for device_put.
+
+Determinism: sample i of epoch e is transformed with a generator seeded by
+(seed, epoch, sample index) — reproducible regardless of worker scheduling,
+unlike torch's global-RNG loaders.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class DataLoader:
+    """Iterable over (images [B,H,W,3] f32, labels [B] i32, ids [B] i64).
+
+    Args:
+      dataset: object with __len__ and load(index, rng) -> (img, label, id).
+      batch_size: global batch size.
+      shuffle: reshuffle each epoch (epoch advances on each __iter__).
+      drop_last: drop the trailing partial batch (train: True so jitted
+        shapes stay static; eval: False, the tail batch is padded and
+        `valid_count` marks real rows).
+      num_workers: decode threads (0 = synchronous).
+      seed: base seed for shuffle + augmentation streams.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        num_workers: int = 8,
+        seed: int = 0,
+        prefetch_batches: int = 2,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.num_workers = num_workers
+        self.seed = seed
+        self.prefetch_batches = prefetch_batches
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _order(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            return np.random.default_rng(
+                [self.seed, self.epoch]
+            ).permutation(n)
+        return np.arange(n)
+
+    def _load_one(self, index: int, epoch: int):
+        rng = np.random.default_rng([self.seed, epoch, int(index)])
+        img, label, sid = self.dataset.load(int(index), rng)
+        return np.asarray(img, np.float32), label, sid
+
+    def _batches_of_indices(self, order: np.ndarray):
+        n = len(order)
+        stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        for i in range(0, stop, self.batch_size):
+            yield order[i : i + self.batch_size]
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        order = self._order()
+        epoch = self.epoch
+        self.epoch += 1
+
+        def assemble(results):
+            imgs = np.stack([r[0] for r in results])
+            labels = np.asarray([r[1] for r in results], np.int32)
+            ids = np.asarray([r[2] for r in results], np.int64)
+            if not self.drop_last and len(results) < self.batch_size:
+                pad = self.batch_size - len(results)
+                imgs = np.concatenate(
+                    [imgs, np.zeros((pad,) + imgs.shape[1:], imgs.dtype)]
+                )
+                labels = np.concatenate(
+                    [labels, np.full((pad,), -1, np.int32)]
+                )
+                ids = np.concatenate([ids, np.full((pad,), -1, np.int64)])
+            return imgs, labels, ids
+
+        if self.num_workers <= 0:
+            for idx_batch in self._batches_of_indices(order):
+                yield assemble([self._load_one(i, epoch) for i in idx_batch])
+            return
+
+        # pipelined: a feeder thread keeps `prefetch_batches` batches in
+        # flight; each batch's samples decode in parallel on the pool.
+        # An early `break` by the consumer (GeneratorExit) must unblock the
+        # feeder (stuck in put on the bounded queue) or the thread leaks.
+        batch_q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_batches)
+        sentinel = object()
+        stop = threading.Event()
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            def put_or_stop(item) -> bool:
+                while not stop.is_set():
+                    try:
+                        batch_q.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
+            def feeder():
+                try:
+                    for idx_batch in self._batches_of_indices(order):
+                        futures = [
+                            pool.submit(self._load_one, i, epoch)
+                            for i in idx_batch
+                        ]
+                        if not put_or_stop(futures):
+                            for f in futures:
+                                f.cancel()
+                            return
+                finally:
+                    put_or_stop(sentinel)
+
+            t = threading.Thread(target=feeder, daemon=True)
+            t.start()
+            try:
+                while True:
+                    item = batch_q.get()
+                    if item is sentinel:
+                        break
+                    yield assemble([f.result() for f in item])
+            finally:
+                stop.set()
+                try:  # drain so the feeder's pending put unblocks
+                    while True:
+                        batch_q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=10)

@@ -1,0 +1,306 @@
+"""Host-side image transforms, PIL/numpy implementations.
+
+torchvision is not a dependency of this framework; these reproduce the exact
+transform semantics the reference uses (reference main.py:96-163):
+
+  train: RandomPerspective(0.2, p=.5) -> ColorJitter((.6,1.4)x3, hue .02)
+         -> RandomHorizontalFlip -> RandomAffine(25deg, shear +-15,
+         translate .05) -> RandomResizedCrop(img, scale=(.6,1)) -> normalize
+  push:  Resize((img,img))                      [unnormalized]
+  test:  Resize(img+32 shorter side) -> CenterCrop(img) -> normalize
+  ood:   Resize((img,img)) -> normalize
+
+Each random transform takes a `numpy.random.Generator` so the pipeline is
+deterministic per (seed, epoch, sample) — the reference's loader is only as
+deterministic as torch's global RNG.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image, ImageEnhance
+
+from mgproto_tpu.utils.images import IMAGENET_MEAN, IMAGENET_STD
+
+BILINEAR = Image.BILINEAR
+
+
+# --------------------------------------------------------------- deterministic
+def resize(img: Image.Image, size) -> Image.Image:
+    """torchvision Resize: int = shorter side to `size` keeping aspect;
+    (h, w) = exact."""
+    if isinstance(size, int):
+        w, h = img.size
+        if w <= h:
+            ow, oh = size, max(1, round(size * h / w))
+        else:
+            oh, ow = size, max(1, round(size * w / h))
+        return img.resize((ow, oh), BILINEAR)
+    h, w = size
+    return img.resize((w, h), BILINEAR)
+
+
+def center_crop(img: Image.Image, size: int) -> Image.Image:
+    w, h = img.size
+    if w < size or h < size:
+        img = resize(img, size)
+        w, h = img.size
+    x0 = int(round((w - size) / 2.0))
+    y0 = int(round((h - size) / 2.0))
+    return img.crop((x0, y0, x0 + size, y0 + size))
+
+
+def to_array(img: Image.Image) -> np.ndarray:
+    """PIL -> float32 [H, W, 3] in [0, 1] (torchvision ToTensor, NHWC)."""
+    return np.asarray(img.convert("RGB"), np.float32) / 255.0
+
+
+def normalize(x: np.ndarray) -> np.ndarray:
+    return (x - IMAGENET_MEAN) / IMAGENET_STD
+
+
+# ------------------------------------------------------------------- random
+def random_horizontal_flip(
+    img: Image.Image, rng: np.random.Generator, p: float = 0.5
+) -> Image.Image:
+    if rng.random() < p:
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    return img
+
+
+def _perspective_coeffs(
+    startpoints: Sequence[Tuple[float, float]],
+    endpoints: Sequence[Tuple[float, float]],
+) -> List[float]:
+    """8-param homography mapping OUTPUT (start) -> INPUT (end) coords, the
+    direction PIL's PERSPECTIVE transform wants."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        a.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        b.extend([ex, ey])
+    coeffs, *_ = np.linalg.lstsq(
+        np.asarray(a, np.float64), np.asarray(b, np.float64), rcond=None
+    )
+    return coeffs.tolist()
+
+
+def random_perspective(
+    img: Image.Image,
+    rng: np.random.Generator,
+    distortion_scale: float = 0.2,
+    p: float = 0.5,
+) -> Image.Image:
+    """torchvision RandomPerspective: each corner jitters inward by up to
+    distortion_scale * half-extent."""
+    if rng.random() >= p:
+        return img
+    w, h = img.size
+    dx = distortion_scale * w / 2
+    dy = distortion_scale * h / 2
+
+    def jitter(lo_x, lo_y):
+        return (
+            float(rng.integers(0, int(dx) + 1)),
+            float(rng.integers(0, int(dy) + 1)),
+        )
+
+    jx0, jy0 = jitter(0, 0)
+    jx1, jy1 = jitter(0, 0)
+    jx2, jy2 = jitter(0, 0)
+    jx3, jy3 = jitter(0, 0)
+    startpoints = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+    endpoints = [
+        (jx0, jy0),
+        (w - 1 - jx1, jy1),
+        (w - 1 - jx2, h - 1 - jy2),
+        (jx3, h - 1 - jy3),
+    ]
+    # PIL wants output->input; torchvision's F.perspective(start, end) solves
+    # the homography H with H(endpoint) = startpoint, so content SHRINKS into
+    # the jittered quad (borders filled), not zoom-in
+    coeffs = _perspective_coeffs(endpoints, startpoints)
+    return img.transform((w, h), Image.PERSPECTIVE, coeffs, BILINEAR)
+
+
+def _adjust_hue(img: Image.Image, factor: float) -> Image.Image:
+    """Shift hue by `factor` (in turns, [-0.5, 0.5])."""
+    if abs(factor) < 1e-8:
+        return img
+    hsv = np.asarray(img.convert("HSV"), np.uint8).copy()
+    shift = np.uint8(int(factor * 255) % 256)
+    hsv[..., 0] = hsv[..., 0] + shift  # uint8 wraparound is the hue circle
+    return Image.fromarray(hsv, "HSV").convert("RGB")
+
+
+def color_jitter(
+    img: Image.Image,
+    rng: np.random.Generator,
+    brightness: Tuple[float, float] = (0.6, 1.4),
+    contrast: Tuple[float, float] = (0.6, 1.4),
+    saturation: Tuple[float, float] = (0.6, 1.4),
+    hue: Tuple[float, float] = (-0.02, 0.02),
+) -> Image.Image:
+    """torchvision ColorJitter: uniform factor per property, applied in a
+    random order (reference main.py:100's exact ranges are the defaults)."""
+    factors = {
+        0: rng.uniform(*brightness),
+        1: rng.uniform(*contrast),
+        2: rng.uniform(*saturation),
+        3: rng.uniform(*hue),
+    }
+    order = rng.permutation(4)
+    img = img.convert("RGB")
+    for t in order:
+        if t == 0:
+            img = ImageEnhance.Brightness(img).enhance(factors[0])
+        elif t == 1:
+            img = ImageEnhance.Contrast(img).enhance(factors[1])
+        elif t == 2:
+            img = ImageEnhance.Color(img).enhance(factors[2])
+        else:
+            img = _adjust_hue(img, factors[3])
+    return img
+
+
+def _inverse_affine_matrix(
+    center: Tuple[float, float],
+    angle_deg: float,
+    translate: Tuple[float, float],
+    scale: float,
+    shear_deg: Tuple[float, float],
+) -> List[float]:
+    """Inverse of the torchvision affine (output->input, for PIL AFFINE).
+
+    Follows the matrix convention of torchvision.transforms.functional:
+    M = T(center) R(angle) S(scale) Sh(shear) T(-center) T(translate)^-1 ...
+    computed directly as the inverse map."""
+    rot = math.radians(angle_deg)
+    sx, sy = (math.radians(s) for s in shear_deg)
+    cx, cy = center
+    tx, ty = translate
+
+    # RSS: rotation * shear * scale (forward), per torchvision
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+
+    # inverse of scale * RSS
+    det = a * d - b * c
+    ia, ib, ic, id_ = d / det, -b / det, -c / det, a / det
+    ia, ib, ic, id_ = (v / scale for v in (ia, ib, ic, id_))
+
+    # inverse translation: x_in = inv(RSS) @ (x_out - center - translate) + center
+    m02 = ia * (-cx - tx) + ib * (-cy - ty) + cx
+    m12 = ic * (-cx - tx) + id_ * (-cy - ty) + cy
+    return [ia, ib, m02, ic, id_, m12]
+
+
+def random_affine(
+    img: Image.Image,
+    rng: np.random.Generator,
+    degrees: float = 25.0,
+    translate: Tuple[float, float] = (0.05, 0.05),
+    shear: Tuple[float, float] = (-15.0, 15.0),
+) -> Image.Image:
+    """torchvision RandomAffine(degrees=25, shear=(-15,15),
+    translate=[.05,.05]) — reference main.py:102. A 2-tuple shear range
+    shears the x axis only."""
+    w, h = img.size
+    angle = rng.uniform(-degrees, degrees)
+    max_dx = translate[0] * w
+    max_dy = translate[1] * h
+    tx = float(np.round(rng.uniform(-max_dx, max_dx)))
+    ty = float(np.round(rng.uniform(-max_dy, max_dy)))
+    shear_x = rng.uniform(shear[0], shear[1])
+    matrix = _inverse_affine_matrix(
+        ((w - 1) * 0.5, (h - 1) * 0.5), angle, (tx, ty), 1.0, (shear_x, 0.0)
+    )
+    return img.transform((w, h), Image.AFFINE, matrix, BILINEAR)
+
+
+def random_resized_crop(
+    img: Image.Image,
+    rng: np.random.Generator,
+    size: int,
+    scale: Tuple[float, float] = (0.6, 1.0),
+    ratio: Tuple[float, float] = (3.0 / 4.0, 4.0 / 3.0),
+) -> Image.Image:
+    """torchvision RandomResizedCrop(size, scale=(0.6, 1.0)) — reference
+    main.py:103. 10 attempts, then center-crop fallback."""
+    w, h = img.size
+    area = w * h
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(*log_ratio))
+        cw = int(round(math.sqrt(target_area * aspect)))
+        ch = int(round(math.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x0 = int(rng.integers(0, w - cw + 1))
+            y0 = int(rng.integers(0, h - ch + 1))
+            return img.resize(
+                (size, size), BILINEAR, box=(x0, y0, x0 + cw, y0 + ch)
+            )
+    # fallback: largest valid center crop
+    in_ratio = w / h
+    if in_ratio < ratio[0]:
+        cw, ch = w, int(round(w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        ch, cw = h, int(round(h * ratio[1]))
+    else:
+        cw, ch = w, h
+    x0 = (w - cw) // 2
+    y0 = (h - ch) // 2
+    return img.resize((size, size), BILINEAR, box=(x0, y0, x0 + cw, y0 + ch))
+
+
+# ---------------------------------------------------------------- pipelines
+Transform = Callable[[Image.Image, Optional[np.random.Generator]], np.ndarray]
+
+
+def train_transform(img_size: int) -> Transform:
+    """The reference's training augmentation stack (main.py:98-106)."""
+
+    def apply(img: Image.Image, rng: np.random.Generator) -> np.ndarray:
+        img = img.convert("RGB")
+        img = random_perspective(img, rng)
+        img = color_jitter(img, rng)
+        img = random_horizontal_flip(img, rng)
+        img = random_affine(img, rng)
+        img = random_resized_crop(img, rng, img_size)
+        return normalize(to_array(img))
+
+    return apply
+
+
+def push_transform(img_size: int) -> Transform:
+    """Resize-only, UNNORMALIZED (main.py:111-116)."""
+
+    def apply(img: Image.Image, rng=None) -> np.ndarray:
+        return to_array(resize(img, (img_size, img_size)))
+
+    return apply
+
+
+def test_transform(img_size: int) -> Transform:
+    """Resize(shorter=img+32) + CenterCrop (main.py:128-135)."""
+
+    def apply(img: Image.Image, rng=None) -> np.ndarray:
+        return normalize(to_array(center_crop(resize(img, img_size + 32), img_size)))
+
+    return apply
+
+
+def ood_transform(img_size: int) -> Transform:
+    """Exact-resize + normalize (main.py:141-163)."""
+
+    def apply(img: Image.Image, rng=None) -> np.ndarray:
+        return normalize(to_array(resize(img, (img_size, img_size))))
+
+    return apply

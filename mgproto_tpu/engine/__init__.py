@@ -1,3 +1,4 @@
+from mgproto_tpu.engine.push import PushResult, push_prototypes
 from mgproto_tpu.engine.train import Trainer, TrainMetrics
 
-__all__ = ["Trainer", "TrainMetrics"]
+__all__ = ["Trainer", "TrainMetrics", "PushResult", "push_prototypes"]

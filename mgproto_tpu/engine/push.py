@@ -1,0 +1,255 @@
+"""Prototype projection ("push"): snap each Gaussian prototype mean to its
+nearest real training patch, for interpretability.
+
+Reference: push.py:14-231. Two passes there: (1) a python scan recording, for
+every prototype, every same-class image's best patch (spatial argmin of
+distance = argmax of density); (2) a greedy pass in prototype order that sorts
+each prototype's candidates by distance and takes the best patch from an image
+no other prototype has claimed yet, copying that patch's feature vector into
+the prototype mean (push.py:193-198).
+
+TPU-native redesign: pass 1 is one jitted device function per batch — for
+each image, the spatial argmax + feature gather for its gt class's K
+prototypes only ([B,K] work instead of the reference's 2000-iteration python
+loop per batch, push.py:125-158). The candidate tensor streamed to host is
+tiny ([B, K] + [B, K, d]). Pass 2's image-dedup greedy is inherently
+sequential (SURVEY.md §7.3.3) and runs on host over the collected candidates.
+Rendering (heatmap/bbox crops, push.py:202-226) re-forwards only the chosen
+images, batched.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mgproto_tpu.core.mgproto import GMMState, patch_log_densities
+from mgproto_tpu.core.state import TrainState
+from mgproto_tpu.utils import vis
+from mgproto_tpu.utils.images import preprocess_input
+
+
+class PushResult(NamedTuple):
+    """Per-prototype projection record ([C, K] leading axes).
+
+    pushed:       bool — whether a patch was found (classes with no images
+                  in the push set keep their learned mean, as in the
+                  reference where the candidate list stays empty).
+    image_id:     int — global dataset index of the source image (-1 if not
+                  pushed); the dedup key (reference uses file names).
+    spatial_idx:  int — flattened latent (h*W + w) of the chosen patch.
+    log_prob:     float — the patch's log-density under the prototype.
+    """
+
+    pushed: np.ndarray
+    image_id: np.ndarray
+    spatial_idx: np.ndarray
+    log_prob: np.ndarray
+
+
+def make_scan_fn(model) -> Callable:
+    """Jitted pass-1 kernel: (params, batch_stats, gmm, images, labels) ->
+    (val [B,K], idx [B,K], fvec [B,K,d]) — each image's best patch per
+    gt-class prototype. `images` must already be normalized."""
+
+    def fn(params, batch_stats, gmm: GMMState, images, labels):
+        variables = {"params": params["net"], "batch_stats": batch_stats}
+        proto_map, _ = model.apply(variables, images, train=False)
+        log_prob, feat = patch_log_densities(proto_map, gmm)  # [B,C,K,H,W]
+        b, c, k, h, w = log_prob.shape
+        sel = labels[:, None, None, None, None]
+        lp = jnp.take_along_axis(log_prob, sel, axis=1)[:, 0]  # [B,K,H,W]
+        flat = lp.reshape(b, k, h * w)
+        idx = jnp.argmax(flat, axis=-1)  # [B,K]
+        val = jnp.max(flat, axis=-1)  # [B,K]
+        fv = feat.reshape(b, h * w, -1)  # [B,HW,d]
+        fvec = jnp.take_along_axis(fv, idx[:, :, None], axis=1)  # [B,K,d]
+        return val, idx, fvec
+
+    return jax.jit(fn)
+
+
+def _greedy_assign(
+    labels: np.ndarray,  # [N]
+    image_ids: np.ndarray,  # [N]
+    vals: np.ndarray,  # [N, K]
+    idxs: np.ndarray,  # [N, K]
+    fvecs: np.ndarray,  # [N, K, d]
+    num_classes: int,
+) -> Tuple[np.ndarray, PushResult]:
+    """Pass 2: reference push.py:160-228 dedup semantics — prototypes claim
+    images greedily in prototype order (c*K + k), best candidate first, one
+    distinct image per prototype across the WHOLE prototype set."""
+    k_per_class = vals.shape[1]
+    d = fvecs.shape[-1]
+    new_means = np.zeros((num_classes, k_per_class, d), np.float32)
+    pushed = np.zeros((num_classes, k_per_class), bool)
+    out_img = np.full((num_classes, k_per_class), -1, np.int64)
+    out_idx = np.full((num_classes, k_per_class), -1, np.int64)
+    out_lp = np.full((num_classes, k_per_class), -np.inf, np.float64)
+
+    by_class: Dict[int, np.ndarray] = {}
+    for c in range(num_classes):
+        by_class[c] = np.where(labels == c)[0]
+
+    used: set = set()
+    for c in range(num_classes):
+        rows = by_class[c]
+        for k in range(k_per_class):
+            if rows.size == 0:
+                continue
+            order = rows[np.argsort(-vals[rows, k])]  # best density first
+            for r in order:
+                img = int(image_ids[r])
+                if img in used:
+                    continue
+                used.add(img)
+                new_means[c, k] = fvecs[r, k]
+                pushed[c, k] = True
+                out_img[c, k] = img
+                out_idx[c, k] = int(idxs[r, k])
+                out_lp[c, k] = float(vals[r, k])
+                break
+    return new_means, PushResult(pushed, out_img, out_idx, out_lp)
+
+
+def push_prototypes(
+    trainer,
+    state: TrainState,
+    batches: Iterable[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+    save_dir: Optional[str] = None,
+    epoch: Optional[int] = None,
+    load_image: Optional[Callable[[int], np.ndarray]] = None,
+    normalize: Callable[[np.ndarray], np.ndarray] = preprocess_input,
+) -> Tuple[TrainState, PushResult]:
+    """Project every prototype mean onto its nearest training patch.
+
+    Args:
+      trainer:  engine Trainer (supplies the model; state carries params).
+      state:    current TrainState; returns a new one with projected means.
+      batches:  iterable of (images [B,H,W,3] in [0,1] UNNORMALIZED,
+                labels [B], image_ids [B]) — the reference's push loader
+                (resize-only, no normalization, main.py:111-116).
+      save_dir: if set, render 3 files per pushed prototype
+                (reference push.py:202-226); requires `load_image`.
+      load_image: image_id -> [H,W,3] float in [0,1] (push-transform sized).
+    """
+    scan = make_scan_fn(trainer.model)
+
+    all_labels: List[np.ndarray] = []
+    all_ids: List[np.ndarray] = []
+    all_vals: List[np.ndarray] = []
+    all_idxs: List[np.ndarray] = []
+    all_fvecs: List[np.ndarray] = []
+    for images, labels, image_ids in batches:
+        images = normalize(np.asarray(images, np.float32))
+        val, idx, fvec = scan(
+            state.params,
+            state.batch_stats,
+            state.gmm,
+            jnp.asarray(images),
+            jnp.asarray(labels, jnp.int32),
+        )
+        all_labels.append(np.asarray(labels))
+        all_ids.append(np.asarray(image_ids))
+        all_vals.append(jax.device_get(val))
+        all_idxs.append(jax.device_get(idx))
+        all_fvecs.append(jax.device_get(fvec))
+
+    if not all_labels:
+        raise ValueError("push set is empty")
+
+    labels = np.concatenate(all_labels)
+    image_ids = np.concatenate(all_ids)
+    vals = np.concatenate(all_vals)
+    idxs = np.concatenate(all_idxs)
+    fvecs = np.concatenate(all_fvecs)
+
+    c = state.gmm.num_classes
+    new_means, result = _greedy_assign(labels, image_ids, vals, idxs, fvecs, c)
+
+    means = jnp.where(
+        jnp.asarray(result.pushed)[:, :, None],
+        jnp.asarray(new_means),
+        state.gmm.means,
+    )
+    new_state = state.replace(gmm=state.gmm._replace(means=means))
+
+    if save_dir is not None:
+        if load_image is None:
+            raise ValueError("save_dir requires load_image")
+        out = (
+            os.path.join(save_dir, f"epoch-{epoch}")
+            if epoch is not None
+            else save_dir
+        )
+        vis.makedir(out)
+        _render(trainer, new_state, result, load_image, normalize, out)
+
+    return new_state, result
+
+
+def _render(
+    trainer,
+    state: TrainState,
+    result: PushResult,
+    load_image: Callable[[int], np.ndarray],
+    normalize: Callable[[np.ndarray], np.ndarray],
+    out_dir: str,
+) -> None:
+    """Per pushed prototype: original+bbox, self-activation overlay+bbox,
+    and the cropped high-activation region (reference push.py:202-226)."""
+
+    def act_fn(params, batch_stats, gmm, image, c):
+        variables = {"params": params["net"], "batch_stats": batch_stats}
+        proto_map, _ = trainer.model.apply(
+            variables, image[None], train=False
+        )
+        log_prob, _ = patch_log_densities(proto_map, gmm)  # [1,C,K,H,W]
+        return jnp.exp(log_prob[0, c])  # [K, H, W] densities (act = -dist)
+
+    act_jit = jax.jit(act_fn)
+
+    c_total, k_per_class = result.pushed.shape
+    for c in range(c_total):
+        if not result.pushed[c].any():
+            continue
+        img_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for k in range(k_per_class):
+            if not result.pushed[c, k]:
+                continue
+            img_id = int(result.image_id[c, k])
+            if img_id not in img_cache:
+                raw = np.asarray(load_image(img_id), np.float32)
+                acts = jax.device_get(
+                    act_jit(
+                        state.params,
+                        state.batch_stats,
+                        state.gmm,
+                        jnp.asarray(normalize(raw)),
+                        c,
+                    )
+                )
+                img_cache[img_id] = (raw, acts)
+            raw, acts = img_cache[img_id]
+            j = c * k_per_class + k  # reference's flat prototype index
+            up = vis.upsample_activation(acts[k], raw.shape[:2])
+            y0, y1, x0, x1 = vis.find_high_activation_crop(up)
+            vis.imsave_with_bbox(
+                os.path.join(out_dir, f"{j}prototype-img-original.jpg"),
+                raw, y0, y1, x0, x1,
+            )
+            vis.imsave_with_bbox(
+                os.path.join(
+                    out_dir, f"{j}prototype-img-original_with_self_act.jpg"
+                ),
+                vis.heatmap_overlay(raw, up), y0, y1, x0, x1,
+            )
+            vis.imsave(
+                os.path.join(out_dir, f"{j}prototype-img.jpg"),
+                raw[y0:y1, x0:x1],
+            )

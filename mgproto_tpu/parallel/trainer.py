@@ -22,8 +22,8 @@ import functools
 from typing import Any, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import numpy as np
+from jax.sharding import Mesh
 
 from mgproto_tpu.config import Config
 from mgproto_tpu.engine.train import EvalOutput, Trainer, TrainMetrics
@@ -112,14 +112,17 @@ class ShardedTrainer(Trainer):
         update_gmm: bool,
         warm: bool = False,
     ) -> Tuple[TrainState, TrainMetrics]:
-        images, labels = self.put_batch((jnp.asarray(images), jnp.asarray(labels)))
+        images = np.asarray(images, np.float32)
+        labels = np.asarray(labels, np.int32)
+        images, labels = self.put_batch((images, labels))
         return super().train_step(state, images, labels, use_mine, update_gmm, warm)
 
     def eval_step(
         self, state: TrainState, images: jax.Array, labels=None
     ) -> EvalOutput:
+        images = np.asarray(images, np.float32)
         if labels is None:
             # sharded eval always carries a label array; -1 never matches argmax
-            labels = jnp.full((jnp.asarray(images).shape[0],), -1, jnp.int32)
-        images, labels = self.put_batch((jnp.asarray(images), jnp.asarray(labels)))
+            labels = np.full((images.shape[0],), -1, np.int32)
+        images, labels = self.put_batch((images, np.asarray(labels, np.int32)))
         return self._eval_step(state, images, labels)

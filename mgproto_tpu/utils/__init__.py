@@ -1,0 +1,29 @@
+"""Host-side utilities: image normalization, visualization, logging."""
+
+from mgproto_tpu.utils.images import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    preprocess_input,
+    undo_preprocess_input,
+)
+from mgproto_tpu.utils.vis import (
+    find_high_activation_crop,
+    heatmap_overlay,
+    imsave,
+    imsave_with_bbox,
+    makedir,
+    upsample_activation,
+)
+
+__all__ = [
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "preprocess_input",
+    "undo_preprocess_input",
+    "find_high_activation_crop",
+    "heatmap_overlay",
+    "imsave",
+    "imsave_with_bbox",
+    "makedir",
+    "upsample_activation",
+]

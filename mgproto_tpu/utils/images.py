@@ -1,0 +1,21 @@
+"""Image normalization helpers (reference utils/preprocess.py:3-36).
+
+Arrays are NHWC float32 in [0, 1]; normalization uses the torchvision
+ImageNet statistics the pretrained backbones were trained with."""
+
+from __future__ import annotations
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def preprocess_input(x):
+    """[0,1] NHWC -> ImageNet-normalized (reference preprocess.py:15-20)."""
+    return (x - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def undo_preprocess_input(x):
+    """ImageNet-normalized NHWC -> [0,1] (reference preprocess.py:31-36)."""
+    return x * IMAGENET_STD + IMAGENET_MEAN

@@ -1,0 +1,91 @@
+"""Host-side prototype visualization (cv2/matplotlib — stays on CPU).
+
+Behavior-parity with reference utils/helpers.py:38-74 (95th-percentile
+connected-component crop) and push.py:202-226 (heatmap overlay + bbox
+rendering). These run on numpy arrays pulled off-device; nothing here is
+jitted or traced."""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+def makedir(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+
+
+def find_high_activation_crop(
+    activation_map: np.ndarray, percentile: float = 95
+) -> Tuple[int, int, int, int]:
+    """Bounding box (y0, y1, x0, x1) of the connected component of
+    above-percentile activation that contains the activation peak
+    (reference utils/helpers.py:38-74)."""
+    import cv2
+
+    threshold = np.percentile(activation_map, percentile)
+    mask = (activation_map >= threshold).astype(np.uint8)
+    peak_y, peak_x = np.unravel_index(
+        np.argmax(activation_map), activation_map.shape
+    )
+    n_labels, labeled = cv2.connectedComponents(mask, connectivity=8)
+    peak_label = labeled[peak_y, peak_x]
+    if peak_label != 0:
+        mask = (labeled == peak_label).astype(np.uint8)
+
+    ys = np.where(mask.max(axis=1) > 0)[0]
+    xs = np.where(mask.max(axis=0) > 0)[0]
+    y0 = int(ys[0]) if ys.size else 0
+    y1 = int(ys[-1]) if ys.size else 0
+    x0 = int(xs[0]) if xs.size else 0
+    x1 = int(xs[-1]) if xs.size else 0
+    return (y0, y1 + 1, x0, x1 + 1)
+
+
+def upsample_activation(act: np.ndarray, size_hw: Tuple[int, int]) -> np.ndarray:
+    """Bicubic latent-grid -> pixel-grid upsample (reference push.py:208)."""
+    import cv2
+
+    return cv2.resize(
+        act, dsize=(size_hw[1], size_hw[0]), interpolation=cv2.INTER_CUBIC
+    )
+
+
+def heatmap_overlay(img_rgb01: np.ndarray, act: np.ndarray) -> np.ndarray:
+    """0.5*img + 0.3*jet(normalized act) (reference push.py:216-221)."""
+    import cv2
+
+    lo, hi = act.min(), act.max()
+    rescaled = np.clip((act - lo) / max(hi - lo, 1e-12), 0, 1)
+    heatmap = cv2.applyColorMap(np.uint8(255 * rescaled), cv2.COLORMAP_JET)
+    heatmap = np.float32(heatmap) / 255
+    heatmap = heatmap[..., ::-1]  # BGR -> RGB
+    return 0.5 * img_rgb01 + 0.3 * heatmap
+
+
+def imsave_with_bbox(
+    fname: str,
+    img_rgb01: np.ndarray,
+    y0: int,
+    y1: int,
+    x0: int,
+    x1: int,
+    color=(0, 255, 255),
+) -> None:
+    """Save with a 2px rectangle (reference push.py:234-239)."""
+    import cv2
+    import matplotlib.pyplot as plt
+
+    img_bgr = cv2.cvtColor(
+        np.uint8(255 * np.clip(img_rgb01, 0, 1)), cv2.COLOR_RGB2BGR
+    )
+    cv2.rectangle(img_bgr, (x0, y0), (x1 - 1, y1 - 1), color, thickness=2)
+    plt.imsave(fname, np.float32(img_bgr[..., ::-1]) / 255, vmin=0.0, vmax=1.0)
+
+
+def imsave(fname: str, img_rgb01: np.ndarray) -> None:
+    import matplotlib.pyplot as plt
+
+    plt.imsave(fname, np.clip(img_rgb01, 0, 1), vmin=0.0, vmax=1.0)

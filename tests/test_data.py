@@ -1,0 +1,196 @@
+"""Data layer tests: transforms (torchvision-parity properties), ImageFolder,
+threaded loader determinism, CUB eval metadata."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mgproto_tpu.data import Cub2011Eval, DataLoader, ImageFolder
+from mgproto_tpu.data import ood_transform, push_transform, train_transform
+from mgproto_tpu.data import test_transform as eval_transform
+from mgproto_tpu.data import transforms as T
+from mgproto_tpu.utils.images import IMAGENET_MEAN, IMAGENET_STD
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    """4 classes x 5 images of distinct solid colors, varying sizes."""
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.RandomState(0)
+    for c in range(4):
+        cdir = root / f"class_{c:03d}"
+        cdir.mkdir()
+        for i in range(5):
+            h, w = rng.randint(40, 90), rng.randint(40, 90)
+            arr = np.full((h, w, 3), 40 * c + 8 * i + 20, np.uint8)
+            Image.fromarray(arr).save(cdir / f"img_{i}.jpg")
+    return str(root)
+
+
+def _pil(h=64, w=48, value=128):
+    return Image.fromarray(np.full((h, w, 3), value, np.uint8))
+
+
+# ---------------------------------------------------------------- transforms
+def test_resize_semantics():
+    img = _pil(100, 50)
+    out = T.resize(img, 64)  # shorter side (w=50) -> 64
+    assert out.size == (64, 128)
+    out = T.resize(img, (32, 40))  # exact (h, w)
+    assert out.size == (40, 32)
+
+
+def test_center_crop():
+    img = _pil(100, 80)
+    out = T.center_crop(img, 64)
+    assert out.size == (64, 64)
+
+
+def test_test_transform_shape_and_normalization():
+    fn = eval_transform(64)
+    out = fn(_pil(200, 100, value=255))
+    assert out.shape == (64, 64, 3)
+    # white pixel -> (1 - mean) / std
+    np.testing.assert_allclose(
+        out[32, 32], (1.0 - IMAGENET_MEAN) / IMAGENET_STD, rtol=1e-5
+    )
+
+
+def test_push_transform_unnormalized():
+    fn = push_transform(32)
+    out = fn(_pil(value=255))
+    assert out.shape == (32, 32, 3)
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_ood_transform_shape():
+    assert ood_transform(48)(_pil(77, 33)).shape == (48, 48, 3)
+
+
+def test_train_transform_deterministic_given_rng():
+    fn = train_transform(32)
+    img = Image.fromarray(
+        np.random.RandomState(3).randint(0, 255, (80, 70, 3), dtype=np.uint8)
+    )
+    a = fn(img, np.random.default_rng(42))
+    b = fn(img, np.random.default_rng(42))
+    c = fn(img, np.random.default_rng(43))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (32, 32, 3)
+    assert not np.allclose(a, c)  # different stream -> different augmentation
+
+
+def test_random_resized_crop_always_output_size():
+    img = _pil(37, 91)
+    for seed in range(5):
+        out = T.random_resized_crop(img, np.random.default_rng(seed), 24)
+        assert out.size == (24, 24)
+
+
+def test_affine_identity_when_no_params():
+    img = Image.fromarray(
+        np.random.RandomState(0).randint(0, 255, (40, 40, 3), dtype=np.uint8)
+    )
+    m = T._inverse_affine_matrix((19.5, 19.5), 0.0, (0.0, 0.0), 1.0, (0.0, 0.0))
+    out = img.transform((40, 40), Image.AFFINE, m, T.BILINEAR)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(img))
+
+
+def test_perspective_p0_identity():
+    img = _pil()
+    out = T.random_perspective(img, np.random.default_rng(0), p=0.0)
+    assert out is img
+
+
+# -------------------------------------------------------------- image folder
+def test_image_folder_layout(image_tree):
+    ds = ImageFolder(image_tree)
+    assert len(ds) == 20
+    assert ds.classes == [f"class_{c:03d}" for c in range(4)]
+    img, label, sid = ds.load(0)
+    assert label == 0 and sid == 0
+    assert img.dtype == np.float32 and img.ndim == 3
+    # ids are stable positions; path_of round-trips
+    assert ds.path_of(sid) == ds.samples[0].path
+    # labels grouped 5 per class in sorted order
+    labels = [s.label for s in ds.samples]
+    assert labels == sorted(labels)
+
+
+def test_image_folder_missing_root():
+    with pytest.raises(FileNotFoundError):
+        ImageFolder("/nonexistent/path/xyz")
+
+
+# -------------------------------------------------------------------- loader
+def test_loader_epoch_determinism_and_shuffle(image_tree):
+    ds = ImageFolder(image_tree, push_transform(16))
+    a = DataLoader(ds, 8, shuffle=True, drop_last=True, num_workers=2, seed=7)
+    b = DataLoader(ds, 8, shuffle=True, drop_last=True, num_workers=0, seed=7)
+    batches_a = list(a)
+    batches_b = list(b)
+    assert len(batches_a) == len(batches_b) == 2  # 20 // 8
+    for (ia, la, da), (ib, lb, db) in zip(batches_a, batches_b):
+        np.testing.assert_array_equal(da, db)  # same order threaded vs sync
+        np.testing.assert_array_equal(la, lb)
+        np.testing.assert_allclose(ia, ib)
+    # second epoch shuffles differently
+    second = list(a)
+    assert not all(
+        np.array_equal(x[2], y[2]) for x, y in zip(batches_a, second)
+    )
+
+
+def test_loader_pads_last_batch(image_tree):
+    ds = ImageFolder(image_tree, push_transform(16))
+    dl = DataLoader(ds, 8, drop_last=False, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 3
+    imgs, labels, ids = batches[-1]
+    assert imgs.shape[0] == 8
+    assert (labels == -1).sum() == 4  # 20 = 2*8 + 4 real rows
+    assert (ids == -1).sum() == 4
+
+
+def test_loader_early_break_no_thread_leak(image_tree):
+    import threading
+
+    ds = ImageFolder(image_tree, push_transform(16))
+    dl = DataLoader(ds, 4, num_workers=2, prefetch_batches=1)
+    before = threading.active_count()
+    for _ in range(3):
+        for batch in dl:
+            break  # consumer bails mid-epoch
+    # feeder threads must have unblocked and exited
+    assert threading.active_count() <= before + 1
+
+
+# ----------------------------------------------------------------- CUB eval
+def test_cub2011_eval(tmp_path):
+    root = tmp_path / "cub"
+    (root / "images" / "001.Sp").mkdir(parents=True)
+    names = []
+    for i in range(4):
+        name = f"001.Sp/im_{i}.jpg"
+        Image.fromarray(np.full((20, 20, 3), 50, np.uint8)).save(
+            root / "images" / name
+        )
+        names.append(name)
+    with open(root / "images.txt", "w") as f:
+        for i, n in enumerate(names):
+            f.write(f"{i + 1} {n}\n")
+    with open(root / "image_class_labels.txt", "w") as f:
+        for i in range(4):
+            f.write(f"{i + 1} 1\n")
+    with open(root / "train_test_split.txt", "w") as f:
+        for i in range(4):
+            f.write(f"{i + 1} {1 if i < 2 else 0}\n")
+
+    train = Cub2011Eval(str(root), train=True)
+    test = Cub2011Eval(str(root), train=False)
+    assert len(train) == 2 and len(test) == 2
+    img, label, img_id = test.load(0)
+    assert label == 0  # 1-based -> 0-based
+    assert img_id == 3  # official CUB id preserved

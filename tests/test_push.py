@@ -1,0 +1,190 @@
+"""Push projection + pruning tests (reference push.py / model.py:467-482
+semantics on toy data)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.core.mgproto import init_gmm, prune_top_m
+from mgproto_tpu.engine.push import _greedy_assign, push_prototypes
+from mgproto_tpu.engine.train import Trainer
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_test_config()
+
+
+@pytest.fixture(scope="module")
+def trainer_state(cfg):
+    trainer = Trainer(cfg, steps_per_epoch=2)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    return trainer, state
+
+
+def _push_batches(cfg, n_per_class=3, seed=0):
+    rng = np.random.RandomState(seed)
+    c = cfg.model.num_classes
+    n = c * n_per_class
+    images = rng.rand(n, cfg.model.img_size, cfg.model.img_size, 3).astype(
+        np.float32
+    )
+    labels = np.repeat(np.arange(c), n_per_class).astype(np.int32)
+    ids = np.arange(n)
+    # two batches
+    half = n // 2
+    yield images[:half], labels[:half], ids[:half]
+    yield images[half:], labels[half:], ids[half:]
+
+
+def test_push_projects_means_to_real_patches(cfg, trainer_state):
+    trainer, state = trainer_state
+    new_state, result = push_prototypes(
+        trainer, state, _push_batches(cfg), normalize=lambda x: x
+    )
+    k = cfg.model.prototypes_per_class
+    # 3 images/class < K=3 prototypes? n_per_class=3, K=3 -> all pushable
+    assert result.pushed.sum() > 0
+    # pushed means are L2-normalized feature vectors (backbone output is
+    # normalized in patch_log_densities)
+    means = np.asarray(new_state.gmm.means)
+    norms = np.linalg.norm(means[np.asarray(result.pushed)], axis=-1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
+    # each pushed prototype claims a DISTINCT image of its own class
+    ids = result.image_id[result.pushed]
+    assert len(set(ids.tolist())) == len(ids)
+    for c in range(cfg.model.num_classes):
+        for kk in range(k):
+            if result.pushed[c, kk]:
+                assert result.image_id[c, kk] // 3 == c  # ids grouped by class
+
+
+def test_push_means_change_and_unpushed_kept(cfg, trainer_state):
+    trainer, state = trainer_state
+    new_state, result = push_prototypes(
+        trainer, state, _push_batches(cfg), normalize=lambda x: x
+    )
+    old = np.asarray(state.gmm.means)
+    new = np.asarray(new_state.gmm.means)
+    pushed = np.asarray(result.pushed)
+    assert not np.allclose(old[pushed], new[pushed])
+    np.testing.assert_array_equal(old[~pushed], new[~pushed])
+
+
+def test_greedy_assign_dedup_order():
+    """Prototype order wins: earlier prototypes claim the globally best
+    image; later ones fall back to the next-best unused image."""
+    # 1 class, 2 prototypes, 2 images; image 7 is best for BOTH prototypes
+    labels = np.array([0, 0])
+    ids = np.array([7, 9])
+    vals = np.array([[5.0, 5.0], [1.0, 1.0]])  # [N, K]
+    idxs = np.zeros((2, 2), np.int64)
+    fvecs = np.arange(2 * 2 * 4, dtype=np.float32).reshape(2, 2, 4)
+    means, res = _greedy_assign(labels, ids, vals, idxs, fvecs, num_classes=1)
+    assert res.image_id[0, 0] == 7  # k=0 gets the best image
+    assert res.image_id[0, 1] == 9  # k=1 deduped onto the other image
+    np.testing.assert_array_equal(means[0, 0], fvecs[0, 0])
+    np.testing.assert_array_equal(means[0, 1], fvecs[1, 1])
+
+
+def test_greedy_assign_class_with_no_images():
+    labels = np.array([0])
+    ids = np.array([0])
+    vals = np.ones((1, 2))
+    idxs = np.zeros((1, 2), np.int64)
+    fvecs = np.ones((1, 2, 3), np.float32)
+    _, res = _greedy_assign(labels, ids, vals, idxs, fvecs, num_classes=2)
+    assert res.pushed[0].sum() == 1  # only 1 image for class 0 -> 1 push
+    assert not res.pushed[1].any()  # class 1 untouched
+
+
+def test_push_rendering(tmp_path, cfg, trainer_state):
+    trainer, state = trainer_state
+    rng = np.random.RandomState(0)
+    n = cfg.model.num_classes * 3
+    imgs = rng.rand(n, cfg.model.img_size, cfg.model.img_size, 3).astype(
+        np.float32
+    )
+
+    def batches():
+        yield imgs, np.repeat(
+            np.arange(cfg.model.num_classes), 3
+        ).astype(np.int32), np.arange(n)
+
+    _, result = push_prototypes(
+        trainer,
+        state,
+        batches(),
+        save_dir=str(tmp_path),
+        load_image=lambda i: imgs[i],
+        normalize=lambda x: x,
+    )
+    files = list(tmp_path.iterdir())
+    n_pushed = int(result.pushed.sum())
+    assert len(files) == 3 * n_pushed  # 3 renders per pushed prototype
+
+
+def test_prune_top_m(cfg):
+    gmm = init_gmm(cfg.model, jax.random.PRNGKey(3))
+    priors = jnp.asarray(
+        np.random.RandomState(0).dirichlet(np.ones(3), size=4), jnp.float32
+    )
+    gmm = gmm._replace(priors=priors)
+    pruned = prune_top_m(gmm, 2)
+    keep = np.asarray(pruned.keep)
+    assert (keep.sum(axis=1) == 2).all()
+    p = np.asarray(pruned.priors)
+    assert (p[~keep] == 0).all()
+    # kept priors unchanged (no renormalization, reference model.py:481-482)
+    np.testing.assert_array_equal(p[keep], np.asarray(priors)[keep])
+    with pytest.raises(ValueError):
+        prune_top_m(gmm, 0)
+
+
+def test_pruned_slots_are_silenced_in_head():
+    """A pruned prototype with huge density must contribute exactly zero to
+    the class logit (reference: zeroed NonNegLinear weight, model.py:481-482),
+    not eps-weighted mass."""
+    from mgproto_tpu.core.mgproto import GMMState, head_forward
+
+    d = 4
+    means = jnp.stack(
+        [jnp.stack([jnp.zeros(d), jnp.ones(d) * 5.0])]
+    )  # [1, 2, d]
+    gmm = GMMState(
+        means=means,
+        sigmas=jnp.full((1, 2, d), 0.01),  # sharp -> enormous densities
+        priors=jnp.array([[1.0, 0.0]]),  # slot 1 pruned
+        keep=jnp.array([[True, False]]),
+    )
+    # a patch sitting exactly on the PRUNED mean
+    proto_map = jnp.broadcast_to(
+        jnp.ones(d)[None, None, None, :] * 5.0, (1, 1, 1, d)
+    )
+    logits, _, _ = head_forward(proto_map, gmm, None, mine_T=1)
+    # logit must equal log(prior0 * p(x|mean0)) alone; with the pruned slot
+    # leaking via eps it would be ~1e5 nats higher
+    from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob
+
+    feat = proto_map.reshape(1, d) / jnp.linalg.norm(proto_map.reshape(1, d))
+    expected = diag_gaussian_log_prob(feat, gmm.means, gmm.sigmas)[0, 0, 0]
+    np.testing.assert_allclose(
+        float(logits[0, 0, 0]), float(expected) + np.log(1.0 + 1e-10), rtol=1e-6
+    )
+
+
+def test_prune_keeps_ties():
+    """reference uses >= threshold: ties at the M-th prior keep extra slots."""
+    from mgproto_tpu.core.mgproto import GMMState
+
+    priors = jnp.array([[0.4, 0.3, 0.3]])
+    gmm = GMMState(
+        means=jnp.zeros((1, 3, 2)),
+        sigmas=jnp.ones((1, 3, 2)),
+        priors=priors,
+        keep=jnp.ones((1, 3), bool),
+    )
+    pruned = prune_top_m(gmm, 2)
+    assert np.asarray(pruned.keep).sum() == 3  # tie at 0.3 keeps both
