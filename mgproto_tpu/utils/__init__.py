@@ -1,5 +1,14 @@
-"""Host-side utilities: image normalization, visualization, logging."""
+"""Host-side utilities: image normalization, visualization, logging,
+checkpointing."""
 
+from mgproto_tpu.utils.checkpoint import (
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+    save_state_w_condition,
+)
+from mgproto_tpu.utils.log import Logger, MetricsWriter, profiler_trace, timed_span
 from mgproto_tpu.utils.images import (
     IMAGENET_MEAN,
     IMAGENET_STD,
@@ -16,6 +25,15 @@ from mgproto_tpu.utils.vis import (
 )
 
 __all__ = [
+    "latest_checkpoint",
+    "list_checkpoints",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "save_state_w_condition",
+    "Logger",
+    "MetricsWriter",
+    "profiler_trace",
+    "timed_span",
     "IMAGENET_MEAN",
     "IMAGENET_STD",
     "preprocess_input",

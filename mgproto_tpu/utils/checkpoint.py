@@ -1,0 +1,120 @@
+"""Checkpoint / resume for the full functional train state.
+
+The reference saves only `state_dict` when test accuracy clears a threshold
+(reference utils/save.py:5-12) — optimizer state is dropped and there is no
+resume path (reference main.py:31-33 even deletes the model dir on restart;
+SURVEY.md §5.3-5.4). Here a checkpoint is the WHOLE `TrainState` pytree
+(params, batch_stats, GMM, memory bank, all three optimizer states, step), so
+training resumes bit-exactly, via orbax.
+
+Filename convention keeps the reference's readable encoding
+(`{epoch}{stage}{accuracy}` e.g. `104nopush0.8224`, reference utils/save.py:9)
+as a directory name per checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+_NAME_RE = re.compile(r"^(\d+)([a-z_]+)([0-9.]+)$")
+
+
+def _checkpointer() -> ocp.Checkpointer:
+    return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+
+
+def checkpoint_name(epoch: int, stage: str, accuracy: float) -> str:
+    """`{epoch}{stage}{acc:.4f}` (reference utils/save.py:9 filename scheme)."""
+    return f"{epoch}{stage}{accuracy:.4f}"
+
+
+def parse_checkpoint_name(name: str) -> Optional[Tuple[int, str, float]]:
+    m = _NAME_RE.match(name)
+    if not m:
+        return None
+    return int(m.group(1)), m.group(2), float(m.group(3))
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    state: Any,
+    name: str,
+    metadata: Optional[dict] = None,
+) -> str:
+    """Write `state` (any pytree of arrays) to `ckpt_dir/name`."""
+    path = os.path.abspath(os.path.join(ckpt_dir, name))
+    _checkpointer().save(path, jax.device_get(state), force=True)
+    if metadata is not None:
+        with open(os.path.join(path, "mgproto_meta.json"), "w") as f:
+            json.dump(metadata, f)
+    return path
+
+
+def restore_checkpoint(path: str, target: Any) -> Any:
+    """Restore a pytree with the structure/shardings of `target`.
+
+    `target` is a concrete state (e.g. a fresh `Trainer.init_state(...)`);
+    restored arrays adopt its dtypes and shardings, so a restore into a
+    sharded state lands directly on the mesh.
+    """
+    return _checkpointer().restore(os.path.abspath(path), item=target)
+
+
+def load_metadata(path: str) -> Optional[dict]:
+    meta = os.path.join(path, "mgproto_meta.json")
+    if not os.path.exists(meta):
+        return None
+    with open(meta) as f:
+        return json.load(f)
+
+
+def save_state_w_condition(
+    ckpt_dir: str,
+    state: Any,
+    epoch: int,
+    stage: str,
+    accuracy: float,
+    target_accuracy: float,
+    metadata: Optional[dict] = None,
+) -> Optional[str]:
+    """Parity with reference utils/save.py:5-12: save only when accuracy
+    clears the threshold; name encodes epoch/stage/accuracy."""
+    if accuracy <= target_accuracy:
+        return None
+    meta = dict(metadata or {})
+    meta.update(epoch=epoch, stage=stage, accuracy=accuracy)
+    return save_checkpoint(
+        ckpt_dir, state, checkpoint_name(epoch, stage, accuracy), metadata=meta
+    )
+
+
+# Within one epoch the reference saves nopush, then push, then prune
+# (reference main.py:255/281/287) — resume must pick the latest STAGE, not the
+# highest accuracy (push/prune typically dip).
+_STAGE_ORDER = {"nopush": 0, "push": 1, "prune": 2}
+
+
+def list_checkpoints(ckpt_dir: str):
+    """All parseable checkpoints in `ckpt_dir` as (epoch, stage, acc, path),
+    ordered by (epoch, stage progression)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        parsed = parse_checkpoint_name(name)
+        if parsed and os.path.isdir(os.path.join(ckpt_dir, name)):
+            out.append((*parsed, os.path.join(ckpt_dir, name)))
+    out.sort(key=lambda t: (t[0], _STAGE_ORDER.get(t[1], -1), t[2]))
+    return out
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Highest-epoch checkpoint path (the resume point the reference lacks)."""
+    ckpts = list_checkpoints(ckpt_dir)
+    return ckpts[-1][3] if ckpts else None

@@ -1,0 +1,108 @@
+"""Logging / metrics / profiling.
+
+Covers the reference's three observability channels (SURVEY.md §5.5):
+file logger with periodic fsync (reference utils/log.py:4-17), wandb scalar
+streams (reference train_and_test.py:73-80 — disabled by default there,
+main.py:53; here a local JSONL stream with the same keys), and wall-clock
+spans (reference train_and_test.py:17,87-89). Adds what the reference lacks:
+a `jax.profiler` trace harness for real TPU profiling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+class Logger:
+    """Append-file + stdout logger, fsync every `flush_every` lines
+    (reference utils/log.py:4-17 closure, as a class with close())."""
+
+    def __init__(self, log_path: Optional[str], flush_every: int = 10):
+        self.path = log_path
+        self.flush_every = flush_every
+        self._count = 0
+        self._f = None
+        if log_path:
+            os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+            self._f = open(log_path, "a")
+
+    def log(self, message: str) -> None:
+        print(message)
+        sys.stdout.flush()
+        if self._f is None:
+            return
+        self._f.write(message + "\n")
+        self._count += 1
+        if self._count % self.flush_every == 0:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    __call__ = log
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+
+class MetricsWriter:
+    """JSONL scalar stream — the local stand-in for the reference's wandb
+    channel (reference main.py:53-54, train_and_test.py:73-80). One JSON
+    object per `write()`, always stamped with step and wall time."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._f = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "a")
+
+    def write(self, step: int, scalars: Dict[str, Any]) -> None:
+        if self._f is None:
+            return
+        rec = {"step": int(step), "time": time.time()}
+        for k, v in scalars.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = v if isinstance(v, (str, bool, type(None))) else str(v)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+@contextlib.contextmanager
+def timed_span(logger: Logger, name: str):
+    """Wall-clock span (reference train_and_test.py:17,87-89 semantics)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.log(f"\t{name} time: \t{time.perf_counter() - t0:.2f}s")
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: Optional[str]):
+    """jax.profiler trace around a block; no-op when logdir is falsy.
+    View with TensorBoard / xprof. The reference has no profiler hooks
+    (SURVEY.md §5.1) — this is the TPU-native upgrade."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

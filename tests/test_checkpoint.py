@@ -1,0 +1,133 @@
+"""Checkpoint/resume + logging tests (capability gap the reference lacks —
+reference utils/save.py saves state_dict only, no optimizer state, no resume;
+SURVEY.md §5.3-5.4)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.engine.train import Trainer
+from mgproto_tpu.utils.checkpoint import (
+    checkpoint_name,
+    latest_checkpoint,
+    list_checkpoints,
+    load_metadata,
+    parse_checkpoint_name,
+    restore_checkpoint,
+    save_checkpoint,
+    save_state_w_condition,
+)
+from mgproto_tpu.utils.log import Logger, MetricsWriter
+
+
+def _tiny_trainer():
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=2)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    return cfg, trainer, state
+
+
+def _batch(cfg, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3).astype(
+        np.float32
+    )
+    labels = rng.randint(0, cfg.model.num_classes, size=(4,)).astype(np.int32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def test_name_roundtrip():
+    name = checkpoint_name(104, "nopush", 0.8224)
+    assert name == "104nopush0.8224"
+    assert parse_checkpoint_name(name) == (104, "nopush", 0.8224)
+    assert parse_checkpoint_name("not-a-ckpt") is None
+
+
+def test_save_restore_resume_bitexact(tmp_path):
+    """Saving after step k and restoring must reproduce step k+1 exactly —
+    including optimizer and EM state (the thing reference checkpoints drop)."""
+    cfg, trainer, state = _tiny_trainer()
+    images, labels = _batch(cfg)
+
+    state, _ = trainer.train_step(
+        state, images, labels, use_mine=True, update_gmm=True, warm=False
+    )
+    path = save_checkpoint(str(tmp_path), state, "1nopush0.5000", {"epoch": 1})
+
+    state_cont, m_cont = trainer.train_step(
+        state, images, labels, use_mine=True, update_gmm=True, warm=False
+    )
+
+    fresh = trainer.init_state(jax.random.PRNGKey(7))
+    restored = restore_checkpoint(path, fresh)
+    assert int(restored.step) == int(state.step)
+    state_res, m_res = trainer.train_step(
+        restored, images, labels, use_mine=True, update_gmm=True, warm=False
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(m_cont.loss), np.asarray(m_res.loss), rtol=1e-6
+    )
+    leaves_a = jax.tree.leaves(state_cont.gmm)
+    leaves_b = jax.tree.leaves(state_res.gmm)
+    for a, b in zip(leaves_a, leaves_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    assert load_metadata(path) == {"epoch": 1}
+
+
+def test_conditional_save_and_latest(tmp_path):
+    cfg, trainer, state = _tiny_trainer()
+    # below threshold: no save (reference utils/save.py:11 condition)
+    assert (
+        save_state_w_condition(
+            str(tmp_path), state, 3, "nopush", 0.50, target_accuracy=0.60
+        )
+        is None
+    )
+    p1 = save_state_w_condition(
+        str(tmp_path), state, 3, "nopush", 0.70, target_accuracy=0.60
+    )
+    p2 = save_state_w_condition(
+        str(tmp_path), state, 5, "push", 0.72, target_accuracy=0.60
+    )
+    assert p1 and p2
+    ckpts = list_checkpoints(str(tmp_path))
+    assert [(c[0], c[1]) for c in ckpts] == [(3, "nopush"), (5, "push")]
+    assert latest_checkpoint(str(tmp_path)) == p2
+    # same epoch, later stage, LOWER accuracy: stage progression wins
+    # (reference main.py:255/281/287 saves nopush->push->prune per epoch)
+    p3 = save_state_w_condition(
+        str(tmp_path), state, 5, "prune", 0.69, target_accuracy=0.60
+    )
+    assert latest_checkpoint(str(tmp_path)) == p3
+    meta = load_metadata(p2)
+    assert meta["stage"] == "push" and meta["accuracy"] == pytest.approx(0.72)
+
+
+def test_logger_and_metrics(tmp_path):
+    log_path = os.path.join(tmp_path, "train.log")
+    logger = Logger(log_path, flush_every=2)
+    logger.log("hello")
+    logger("epoch: \t1")
+    logger.close()
+    lines = open(log_path).read().splitlines()
+    assert lines == ["hello", "epoch: \t1"]
+
+    mpath = os.path.join(tmp_path, "metrics.jsonl")
+    mw = MetricsWriter(mpath)
+    mw.write(0, {"loss": jnp.asarray(1.5), "acc": 0.25})
+    mw.write(1, {"loss": 1.25, "note": "x"})
+    mw.close()
+    recs = [json.loads(l) for l in open(mpath).read().splitlines()]
+    assert recs[0]["loss"] == pytest.approx(1.5)
+    assert recs[0]["step"] == 0 and "time" in recs[0]
+    assert recs[1]["note"] == "x"
+
+    # null-path variants are no-ops
+    Logger(None).log("to stdout only")
+    MetricsWriter(None).write(0, {"a": 1})
