@@ -1,0 +1,10 @@
+"""Command-line entry points (reference main.py / eval_*.py / run.sh).
+
+`python -m mgproto_tpu.cli.train`  — full training driver
+`python -m mgproto_tpu.cli.evaluate` — test / OoD / interpretability metrics
+`python -m mgproto_tpu.cli.prep`  — offline dataset preparation
+"""
+
+from mgproto_tpu.cli.common import DATASET_PRESETS, config_from_args
+
+__all__ = ["DATASET_PRESETS", "config_from_args"]

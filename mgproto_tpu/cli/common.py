@@ -1,0 +1,137 @@
+"""Shared CLI plumbing: dataset presets + argparse -> Config.
+
+The reference splits configuration between settings.py module constants and
+argparse flags (reference settings.py:1-52, main.py:19-27). Here every knob
+lands in one typed `Config`; presets fill per-dataset class counts and
+directory conventions (reference settings.py:8-24)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Dict
+
+from mgproto_tpu.config import (
+    Config,
+    DataConfig,
+    EMConfig,
+    LossConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    ScheduleConfig,
+)
+
+# num_classes per dataset (reference: CUB settings.py:2; Cars/Dogs/Pets from
+# the paper's experimental suite, README.md:34-45 + preprocess_data scripts)
+DATASET_PRESETS: Dict[str, Dict] = {
+    "CUB": {"num_classes": 200, "sub": "cub200_cropped"},
+    "Cars": {"num_classes": 196, "sub": "stanford_cars_cropped"},
+    "Dogs": {"num_classes": 120, "sub": "stanford_dogs"},
+    "Pets": {"num_classes": 37, "sub": "oxford_pets"},
+}
+
+
+def add_train_args(p: argparse.ArgumentParser) -> None:
+    # reference main.py:19-27 flags (minus -gpuid: device selection is
+    # JAX_PLATFORMS / mesh shape here)
+    p.add_argument("--dataset", default="CUB", choices=sorted(DATASET_PRESETS))
+    p.add_argument("--arch", default="resnet34")
+    p.add_argument("--aux_loss", default="proxy_anchor",
+                   choices=["proxy_anchor", "proxy_nca", "ms", "contrastive",
+                            "triplet", "npair"])
+    p.add_argument("--aux_emb_sz", type=int, default=32)
+    p.add_argument("--mem_sz", type=int, default=800)
+    p.add_argument("--mine_level", type=int, default=20)
+    # paths (reference settings.py:8-19; explicit flags replace hard-coding)
+    p.add_argument("--data_root", default="./datasets")
+    p.add_argument("--train_dir", default="")
+    p.add_argument("--test_dir", default="")
+    p.add_argument("--push_dir", default="")
+    p.add_argument("--ood_dir", action="append", default=[],
+                   help="OoD test set root (repeatable)")
+    p.add_argument("--model_dir", default="./saved_models")
+    # shapes / schedule
+    p.add_argument("--img_size", type=int, default=224)
+    p.add_argument("--num_classes", type=int, default=0,
+                   help="0 = dataset preset")
+    p.add_argument("--protos_per_class", type=int, default=10)
+    p.add_argument("--proto_dim", type=int, default=64)
+    p.add_argument("--batch_size", type=int, default=80)
+    p.add_argument("--epochs", type=int, default=120)
+    p.add_argument("--warm_epochs", type=int, default=0)
+    p.add_argument("--mine_start", type=int, default=40)
+    p.add_argument("--gmm_start", type=int, default=35)
+    p.add_argument("--push_start", type=int, default=100)
+    p.add_argument("--push_every", type=int, default=10)
+    p.add_argument("--prune_top_m", type=int, default=8)
+    p.add_argument("--no_pretrained", action="store_true")
+    p.add_argument("--num_workers", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    # runtime
+    p.add_argument("--mesh_data", type=int, default=-1,
+                   help="data-axis size (-1 = all devices)")
+    p.add_argument("--mesh_model", type=int, default=1)
+    p.add_argument("--resume", default="",
+                   help="checkpoint path, or 'auto' for latest in model_dir")
+    p.add_argument("--profile_dir", default="",
+                   help="write a jax.profiler trace of one epoch here")
+    p.add_argument("--target_accu", type=float, default=0.0,
+                   help="save checkpoints only above this test accuracy")
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    preset = DATASET_PRESETS[args.dataset]
+    num_classes = args.num_classes or preset["num_classes"]
+    root = os.path.join(args.data_root, preset["sub"])
+    # reference directory conventions (settings.py:9-13): train_cropped_augmented /
+    # train_cropped (push) / test_cropped
+    train_dir = args.train_dir or os.path.join(root, "train_cropped_augmented")
+    push_dir = args.push_dir or os.path.join(root, "train_cropped")
+    test_dir = args.test_dir or os.path.join(root, "test_cropped")
+    return Config(
+        model=ModelConfig(
+            arch=args.arch,
+            img_size=args.img_size,
+            num_classes=num_classes,
+            prototypes_per_class=args.protos_per_class,
+            proto_dim=args.proto_dim,
+            sz_embedding=args.aux_emb_sz,
+            mine_T=args.mine_level,
+            mem_capacity=args.mem_sz,
+            pretrained=not args.no_pretrained,
+        ),
+        em=EMConfig(),
+        optim=OptimConfig(),
+        schedule=ScheduleConfig(
+            num_train_epochs=args.epochs,
+            num_warm_epochs=args.warm_epochs,
+            mine_start=args.mine_start,
+            update_gmm_start=args.gmm_start,
+            push_start=args.push_start,
+            push_every=args.push_every,
+            prune_top_m=args.prune_top_m,
+        ),
+        loss=LossConfig(aux_loss=args.aux_loss),
+        data=DataConfig(
+            dataset=args.dataset,
+            train_dir=train_dir,
+            test_dir=test_dir,
+            train_push_dir=push_dir,
+            ood_dirs=tuple(args.ood_dir),
+            train_batch_size=args.batch_size,
+            test_batch_size=args.batch_size,
+            train_push_batch_size=args.batch_size,
+            num_workers=args.num_workers,
+        ),
+        mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
+        seed=args.seed,
+        model_dir=args.model_dir,
+    )
+
+
+def describe(cfg: Config) -> str:
+    return "\n".join(
+        f"{f.name}: {getattr(cfg, f.name)}" for f in dataclasses.fields(cfg)
+    )

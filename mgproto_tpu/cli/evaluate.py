@@ -1,0 +1,55 @@
+"""Evaluation driver: test accuracy + OoD metrics from a checkpoint.
+
+Reference: the eval half of main.py plus the `_testing_with_OoD` path
+(train_and_test.py:161-238). Interpretability metrics (consistency /
+stability / purity) live in `mgproto_tpu.cli.interpret`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+import jax
+
+from mgproto_tpu.cli.common import add_train_args, config_from_args
+from mgproto_tpu.cli.train import _test
+from mgproto_tpu.data import build_pipelines
+from mgproto_tpu.parallel import ShardedTrainer
+from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="Evaluate an MGProto-TPU checkpoint (test acc + OoD)"
+    )
+    add_train_args(p)
+    p.add_argument(
+        "--checkpoint",
+        default="auto",
+        help="checkpoint path ('auto' = latest in --model_dir)",
+    )
+    args = p.parse_args(argv)
+    cfg = config_from_args(args)
+
+    _, _, test_loader, ood_loaders = build_pipelines(cfg)
+    trainer = ShardedTrainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
+
+    path = (
+        latest_checkpoint(cfg.model_dir)
+        if args.checkpoint == "auto"
+        else args.checkpoint
+    )
+    if not path:
+        raise FileNotFoundError(f"no checkpoint found in {cfg.model_dir}")
+    state = trainer.prepare(restore_checkpoint(path, state))
+    print(f"loaded {path}")
+
+    accu, results = _test(trainer, state, test_loader, ood_loaders, print)
+    print(json.dumps({"checkpoint": path, "accuracy": accu, **results}))
+
+
+if __name__ == "__main__":
+    main()

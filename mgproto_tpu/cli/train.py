@@ -1,0 +1,192 @@
+"""Training driver: the reference main.py epoch loop, TPU-native.
+
+Flow (reference main.py:230-287): per epoch — warm/joint phase select, train
+epoch with mine/EM gates, test (+OoD when configured), conditional "nopush"
+checkpoint; at push epochs — prototype projection, re-test, "push"
+checkpoint; after the loop — top-M pruning, re-test, "prune" checkpoint.
+
+Differences by design: checkpoints carry the FULL train state and `--resume`
+continues bit-exactly (the reference deletes its model dir on restart,
+main.py:31-33); the step runs SPMD over the configured mesh; metrics stream
+to a local JSONL instead of wandb.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mgproto_tpu.cli.common import add_train_args, config_from_args, describe
+from mgproto_tpu.config import Config
+from mgproto_tpu.core.mgproto import prune_top_m
+from mgproto_tpu.data import build_pipelines
+from mgproto_tpu.engine import evaluate, evaluate_with_ood, push_prototypes
+from mgproto_tpu.parallel import ShardedTrainer
+from mgproto_tpu.utils import (
+    Logger,
+    MetricsWriter,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_state_w_condition,
+    timed_span,
+)
+from mgproto_tpu.utils.checkpoint import load_metadata
+from mgproto_tpu.utils.log import profiler_trace
+
+
+def _labeled(loader):
+    for images, labels, _ids in loader:
+        yield images, labels
+
+
+def _test(trainer, state, test_loader, ood_loaders, log):
+    if ood_loaders:
+        return evaluate_with_ood(
+            trainer,
+            state,
+            _labeled(test_loader),
+            [_labeled(o) for o in ood_loaders],
+            log=log,
+        )
+    return evaluate(trainer, state, _labeled(test_loader), log=log)
+
+
+def run_training(
+    cfg: Config,
+    resume: str = "",
+    profile_dir: str = "",
+    target_accu: float = 0.0,
+    render_push: bool = True,
+):
+    """Run the full schedule; returns (final_state, last_test_accuracy)."""
+    os.makedirs(cfg.model_dir, exist_ok=True)
+    log = Logger(os.path.join(cfg.model_dir, "train.log"))
+    metrics = MetricsWriter(os.path.join(cfg.model_dir, "metrics.jsonl"))
+
+    log(describe(cfg))
+    train_loader, push_loader, test_loader, ood_loaders = build_pipelines(cfg)
+    steps_per_epoch = len(train_loader)
+    trainer = ShardedTrainer(cfg, steps_per_epoch)
+    log(f"devices: {jax.device_count()}  mesh: {dict(trainer.mesh.shape)}")
+    log(f"steps/epoch: {steps_per_epoch}")
+
+    state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
+    start_epoch = 0
+    if resume:
+        path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
+        if path:
+            meta = load_metadata(path) or {}
+            state = trainer.prepare(restore_checkpoint(path, state))
+            if meta.get("stage") == "prune":
+                log(f"run already complete ({path}); nothing to resume")
+                metrics.close()
+                log.close()
+                return state, float(meta.get("accuracy", 0.0))
+            start_epoch = int(meta.get("epoch", -1)) + 1
+            log(f"resumed {path} -> epoch {start_epoch}")
+        elif resume != "auto":
+            raise FileNotFoundError(resume)
+
+    img_dir = os.path.join(cfg.model_dir, "img")
+    push_ds = push_loader.dataset
+    accu = 0.0
+
+    log("start training")
+    for epoch in range(start_epoch, cfg.schedule.num_train_epochs):
+        log(f"epoch: \t{epoch}")
+        flags = trainer.epoch_flags(state, epoch)
+        log(f"use mining: \t{flags['use_mine']}")
+        log(f"update GMM: \t{flags['update_gmm']}")
+
+        trace = (
+            profiler_trace(profile_dir)
+            if (profile_dir and epoch == start_epoch)
+            else contextlib.nullcontext()
+        )
+        with timed_span(log, "train"), trace:
+            state, last = trainer.train_epoch(
+                state, _labeled(train_loader), epoch
+            )
+        if last is not None:
+            m = jax.device_get(last._asdict())
+            log(
+                "\tloss: {loss:.4f}  ce: {cross_entropy:.4f}  mine: {mine:.4f}"
+                "  aux: {aux:.4f}  acc: {accuracy:.4f}  mem: {full_mem_ratio:.3f}".format(
+                    **{k: float(v) for k, v in m.items()}
+                )
+            )
+            metrics.write(
+                int(state.step),
+                {"epoch": epoch, **{k: float(v) for k, v in m.items()}},
+            )
+
+        with timed_span(log, "test"):
+            accu, test_results = _test(
+                trainer, state, test_loader, ood_loaders, log
+            )
+        metrics.write(int(state.step), {"epoch": epoch, **test_results})
+        save_state_w_condition(
+            cfg.model_dir, state, epoch, "nopush", accu, target_accu
+        )
+
+        if epoch >= cfg.schedule.push_start and epoch in cfg.schedule.push_epochs():
+            with timed_span(log, "push"):
+                state, _ = push_prototypes(
+                    trainer,
+                    state,
+                    iter(push_loader),
+                    save_dir=img_dir if render_push else None,
+                    epoch=epoch,
+                    load_image=lambda i: push_ds.load(i)[0],
+                )
+            accu, test_results = _test(
+                trainer, state, test_loader, ood_loaders, log
+            )
+            metrics.write(
+                int(state.step), {"epoch": epoch, "stage": "push", **test_results}
+            )
+            save_state_w_condition(
+                cfg.model_dir, state, epoch, "push", accu, target_accu
+            )
+
+    # pruning (reference main.py:285-287)
+    last_epoch = max(cfg.schedule.num_train_epochs - 1, start_epoch)
+    state = state.replace(
+        gmm=prune_top_m(state.gmm, cfg.schedule.prune_top_m)
+    )
+    accu, test_results = _test(trainer, state, test_loader, ood_loaders, log)
+    metrics.write(
+        int(state.step), {"epoch": last_epoch, "stage": "prune", **test_results}
+    )
+    save_state_w_condition(
+        cfg.model_dir, state, last_epoch, "prune", accu, target_accu
+    )
+
+    log("training done")
+    metrics.close()
+    log.close()
+    return state, accu
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="Train MGProto-TPU (reference main.py equivalent)"
+    )
+    add_train_args(p)
+    args = p.parse_args(argv)
+    cfg = config_from_args(args)
+    run_training(
+        cfg,
+        resume=args.resume,
+        profile_dir=args.profile_dir,
+        target_accu=args.target_accu,
+    )
+
+
+if __name__ == "__main__":
+    main()

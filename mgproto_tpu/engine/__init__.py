@@ -1,4 +1,17 @@
+from mgproto_tpu.engine.evaluate import (
+    evaluate,
+    evaluate_with_ood,
+    prototype_pair_distance,
+)
 from mgproto_tpu.engine.push import PushResult, push_prototypes
 from mgproto_tpu.engine.train import Trainer, TrainMetrics
 
-__all__ = ["Trainer", "TrainMetrics", "PushResult", "push_prototypes"]
+__all__ = [
+    "Trainer",
+    "TrainMetrics",
+    "PushResult",
+    "push_prototypes",
+    "evaluate",
+    "evaluate_with_ood",
+    "prototype_pair_distance",
+]

@@ -1,0 +1,125 @@
+"""Test + OoD evaluation drivers.
+
+Reference: train_and_test.py:100-242. `_testing` = accuracy + mean CE +
+mean prototype pair distance; `_testing_with_OoD` additionally derives an
+OoD threshold from the ID test set's generative scores p(x) = sum_c p(x|c)
+and reports, per OoD set, the fraction predicted in-distribution (the
+reference calls this FPR95_*; its threshold is the 5th ID percentile).
+
+All device math is log-domain (`log_px` = logsumexp of class log-likelihoods);
+percentile/threshold bookkeeping is host-side numpy over per-sample scalars,
+exactly as the reference does it on CPU (train_and_test.py:195-200).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mgproto_tpu.core.mgproto import GMMState
+
+
+def prototype_pair_distance(gmm: GMMState) -> float:
+    """Mean pairwise squared distance over ALL prototypes (reference
+    train_and_test.py:148-151 + utils/helpers.py:13-14 `list_of_distances`,
+    which includes the zero diagonal in the mean)."""
+    p = np.asarray(gmm.means, np.float64).reshape(-1, gmm.means.shape[-1])
+    sq = (p**2).sum(-1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (p @ p.T)
+    return float(np.maximum(d2, 0.0).mean())
+
+
+def _run_eval(trainer, state, batches) -> Tuple[np.ndarray, np.ndarray, float, int]:
+    """Shared loop: returns (per-sample log p(x), per-sample correct flags,
+    summed CE over batches, batch count).
+
+    Batches may be bare image arrays (unlabeled OoD), (images, labels), or
+    (images, labels, ids) — the loader's padded tail rows carry label -1 and
+    are dropped host-side so jitted shapes stay static."""
+    log_pxs, corrects = [], []
+    ce_total, n_batches = 0.0, 0
+    for batch in batches:
+        if isinstance(batch, tuple):
+            images, labels = batch[0], batch[1]
+        else:
+            images, labels = batch, None
+        images = jnp.asarray(images)
+        labels_dev = None if labels is None else jnp.asarray(labels)
+        out = trainer.eval_step(state, images, labels_dev)
+        batch_log_px = np.asarray(jax.device_get(out.log_px))
+        batch_correct = np.asarray(jax.device_get(out.correct))
+        if labels is None:
+            log_pxs.append(batch_log_px)
+            corrects.append(batch_correct)
+            continue
+        valid = np.asarray(labels) >= 0
+        log_pxs.append(batch_log_px[valid])
+        corrects.append(batch_correct[valid])
+        if valid.any():
+            logits = np.asarray(jax.device_get(out.logits), np.float64)[valid]
+            lbl = np.asarray(labels)[valid]
+            lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1))
+            lse += logits.max(-1)
+            ce_total += float(np.mean(lse - logits[np.arange(len(lbl)), lbl]))
+            n_batches += 1
+    return (
+        np.concatenate(log_pxs) if log_pxs else np.zeros((0,)),
+        np.concatenate(corrects) if corrects else np.zeros((0,), bool),
+        ce_total,
+        n_batches,
+    )
+
+
+def evaluate(trainer, state, batches, log=print) -> Tuple[float, Dict]:
+    """Accuracy pass (reference `_testing`, train_and_test.py:100-157).
+
+    `batches` yields (images, labels) host arrays. Returns
+    (accuracy, {'acc', 'cross_entropy', 'p_avg_pair_dist'})."""
+    _, correct, ce_total, n_batches = _run_eval(trainer, state, batches)
+    acc = float(correct.mean()) if correct.size else 0.0
+    pdist = prototype_pair_distance(state.gmm)
+    log(f"\ttest acc: \t\t{acc * 100}%")
+    log(f"\tp dist pair: \t{pdist}")
+    return acc, {
+        "acc": acc,
+        "cross_entropy": ce_total / max(n_batches, 1),
+        "p_avg_pair_dist": pdist,
+    }
+
+
+def evaluate_with_ood(
+    trainer,
+    state,
+    id_batches,
+    ood_batch_iters: Sequence[Iterable],
+    percentile: float = 5.0,
+    log=print,
+) -> Tuple[float, Dict]:
+    """OoD pass (reference `_testing_with_OoD`, train_and_test.py:161-238).
+
+    Quirk preserved from the reference: the threshold is the `percentile`-th
+    percentile of SUM_c p(x|c) over the ID set (train_and_test.py:196-197),
+    but each OoD sample is flagged in-distribution when its MEAN_c p(x|c)
+    exceeds that threshold (train_and_test.py:213,227) — a C-fold asymmetry
+    kept for behavior parity. Reported `fpr` per OoD set = fraction of OoD
+    samples predicted in-distribution at the ID-`percentile` operating point.
+    """
+    id_log_px, correct, _, _ = _run_eval(trainer, state, id_batches)
+    acc = float(correct.mean()) if correct.size else 0.0
+    log(f"\tTest Acc: \t{acc * 100}")
+
+    num_classes = state.gmm.num_classes
+    # sum_c p(x|c) = exp(log_px); kept in float64 on host for a stable percentile
+    ood_thresh = float(np.percentile(np.exp(id_log_px.astype(np.float64)), percentile))
+
+    results: Dict[str, float] = {"acc": acc, "ood_thresh": ood_thresh}
+    for i, ood_batches in enumerate(ood_batch_iters, start=1):
+        ood_log_px, _, _, _ = _run_eval(trainer, state, ood_batches)
+        mean_px = np.exp(ood_log_px.astype(np.float64)) / num_classes
+        fpr = float((mean_px > ood_thresh).mean()) if mean_px.size else 0.0
+        results[f"FPR95_{i}"] = fpr
+        log(f"\tFPR95_{i}: \t{fpr}")
+    return acc, results

@@ -234,10 +234,12 @@ class Trainer:
         flags = self.epoch_flags(state, epoch)
         last = None
         for images, labels in batches:
+            # raw host arrays: train_step converts (and, in the sharded
+            # subclass, device_puts with the batch sharding)
             state, last = self.train_step(
                 state,
-                jnp.asarray(images),
-                jnp.asarray(labels),
+                images,
+                labels,
                 use_mine=flags["use_mine"],
                 update_gmm=flags["update_gmm"],
                 warm=flags["warm"],

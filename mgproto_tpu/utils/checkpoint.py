@@ -20,12 +20,15 @@ import re
 from typing import Any, Optional, Tuple
 
 import jax
-import orbax.checkpoint as ocp
 
-_NAME_RE = re.compile(r"^(\d+)([a-z_]+)([0-9.]+)$")
+_NAME_RE = re.compile(r"^(\d+)([a-z_]+)(\d+\.\d+)$")
 
 
-def _checkpointer() -> ocp.Checkpointer:
+def _checkpointer():
+    # orbax import kept lazy: it is needed only when actually checkpointing,
+    # not by every consumer of the utils package
+    import orbax.checkpoint as ocp
+
     return ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
 
 

@@ -68,10 +68,13 @@ class MetricsWriter:
             return
         rec = {"step": int(step), "time": time.time()}
         for k, v in scalars.items():
+            if isinstance(v, (str, bool, type(None))):
+                rec[k] = v
+                continue
             try:
                 rec[k] = float(v)
             except (TypeError, ValueError):
-                rec[k] = v if isinstance(v, (str, bool, type(None))) else str(v)
+                rec[k] = str(v)
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 

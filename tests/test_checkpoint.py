@@ -46,6 +46,8 @@ def test_name_roundtrip():
     assert name == "104nopush0.8224"
     assert parse_checkpoint_name(name) == (104, "nopush", 0.8224)
     assert parse_checkpoint_name("not-a-ckpt") is None
+    assert parse_checkpoint_name("1backup0.5.1") is None  # multi-dot junk
+    assert parse_checkpoint_name("104nopush0") is None  # no fraction
 
 
 def test_save_restore_resume_bitexact(tmp_path):
