@@ -1,0 +1,105 @@
+"""Interpretability metric driver: consistency / stability / purity.
+
+Reference: eval_consistency.py, eval_stability.py, eval_purity.py — three
+near-identical scripts, folded into one CLI with a --metric flag. Loads a
+checkpoint, runs the CUB test split through the gt-class activation
+collector, and prints the score(s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+import jax
+
+from mgproto_tpu.cli.common import add_train_args, config_from_args
+from mgproto_tpu.data import Cub2011Eval, DataLoader, ood_transform
+from mgproto_tpu.data.cub_parts import CubParts
+from mgproto_tpu.engine.interpretability import (
+    collect_gt_activations,
+    evaluate_consistency,
+    evaluate_purity,
+    evaluate_stability,
+    make_gt_act_fn,
+)
+from mgproto_tpu.parallel import ShardedTrainer
+from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="Prototype interpretability metrics (reference eval_*.py)"
+    )
+    add_train_args(p)
+    p.add_argument(
+        "--metric",
+        default="all",
+        choices=["consistency", "stability", "purity", "all"],
+    )
+    p.add_argument(
+        "--cub_root",
+        required=True,
+        help="CUB_200_2011 root (images.txt, parts/, images/)",
+    )
+    p.add_argument("--checkpoint", default="auto")
+    p.add_argument("--half_size", type=int, default=36,
+                   help="box half-size for consistency/stability (purity uses 16)")
+    p.add_argument("--purity_half_size", type=int, default=16)
+    p.add_argument("--purity_top_k", type=int, default=10)
+    args = p.parse_args(argv)
+    cfg = config_from_args(args)
+
+    parts = CubParts(args.cub_root)
+    # squash-resize + normalize: the reference eval scripts' transform
+    # (interpretability.py:29-33 Resize((img,img)) — NOT the center-crop test
+    # pipeline), so part coordinates scaled by width/height line up with the
+    # activation grid
+    dataset = Cub2011Eval(
+        args.cub_root, train=False, transform=ood_transform(cfg.model.img_size)
+    )
+    loader = DataLoader(
+        dataset, cfg.data.test_batch_size, num_workers=cfg.data.num_workers
+    )
+
+    trainer = ShardedTrainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
+    path = (
+        latest_checkpoint(cfg.model_dir)
+        if args.checkpoint == "auto"
+        else args.checkpoint
+    )
+    if not path:
+        raise FileNotFoundError(f"no checkpoint in {cfg.model_dir}")
+    state = trainer.prepare(restore_checkpoint(path, state))
+    print(f"loaded {path}")
+
+    c = cfg.model.num_classes
+    # one compiled forward + one clean test-set pass shared by all metrics
+    act_fn = make_gt_act_fn(trainer.model)
+    clean = collect_gt_activations(trainer, state, iter(loader), act_fn=act_fn)
+    results = {}
+    if args.metric in ("consistency", "all"):
+        results["consistency"] = evaluate_consistency(
+            trainer, state, None, parts, c, half_size=args.half_size,
+            activations=clean,
+        )
+    if args.metric in ("stability", "all"):
+        results["stability"] = evaluate_stability(
+            trainer, state, lambda: iter(loader), parts, c,
+            half_size=args.half_size, activations=clean, act_fn=act_fn,
+        )
+    if args.metric in ("purity", "all"):
+        mean, std = evaluate_purity(
+            trainer, state, None, parts, c,
+            half_size=args.purity_half_size, top_k=args.purity_top_k,
+            activations=clean,
+        )
+        results["purity"] = mean
+        results["purity_std"] = std
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

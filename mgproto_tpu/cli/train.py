@@ -154,11 +154,10 @@ def run_training(
                 cfg.model_dir, state, epoch, "push", accu, target_accu
             )
 
-    # pruning (reference main.py:285-287)
+    # pruning (reference main.py:285-287); top_m can't exceed K per class
     last_epoch = max(cfg.schedule.num_train_epochs - 1, start_epoch)
-    state = state.replace(
-        gmm=prune_top_m(state.gmm, cfg.schedule.prune_top_m)
-    )
+    top_m = min(cfg.schedule.prune_top_m, cfg.model.prototypes_per_class)
+    state = state.replace(gmm=prune_top_m(state.gmm, top_m))
     accu, test_results = _test(trainer, state, test_loader, ood_loaders, log)
     metrics.write(
         int(state.step), {"epoch": last_epoch, "stage": "prune", **test_results}

@@ -1,0 +1,303 @@
+"""Interpretability metrics: consistency, stability, purity.
+
+Reference: utils/interpretability.py. All three metrics share one primitive:
+for each prototype of an image's ground-truth class, upsample its activation
+map to pixel space, take a box of `half_size` around the argmax, and mark
+which annotated bird parts fall inside (the "hit matrix").
+
+  * consistency (interpretability.py:134-160): a prototype is consistent if
+    some part is hit in >= `part_thresh` of the class's images (normalized by
+    that part's visibility count). Score = % consistent prototypes.
+  * stability (interpretability.py:163-178): % of images whose hit vector is
+    unchanged when imperceptible Gaussian noise perturbs the input.
+  * purity (interpretability.py:183-315): over each prototype's top-K most
+    activated images, the best per-part mean hit rate; score = mean/std over
+    prototypes (x100).
+
+Device work (forward + gt-class map gather) is one jitted function; the
+geometric bookkeeping is host-side numpy exactly like the reference's CPU
+post-pass. Activations are exp(log-density) = the reference's
+`-proto_dist` (model.py:437) so bicubic upsampling (a non-monotone resample)
+sees the same surface the reference feeds it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mgproto_tpu.core.mgproto import GMMState, patch_log_densities
+from mgproto_tpu.data.cub_parts import CubParts, in_bbox
+from mgproto_tpu.utils.vis import upsample_activation
+
+
+def perturb_images(
+    images: np.ndarray, rng: np.random.Generator, std: float = 0.2,
+    eps: float = 0.25,
+) -> np.ndarray:
+    """Clipped Gaussian noise on NORMALIZED images (reference
+    interpretability.py:14-18)."""
+    noise = np.clip(
+        rng.normal(0.0, std, size=images.shape), -eps, eps
+    ).astype(images.dtype)
+    return images + noise
+
+
+def make_gt_act_fn(model):
+    """Jitted: (params, batch_stats, gmm, images, labels) ->
+    [B, K, H, W] exp-density maps of each image's gt-class prototypes
+    (reference interpretability.py:49-56 gather)."""
+
+    def fn(params, batch_stats, gmm: GMMState, images, labels):
+        variables = {"params": params["net"], "batch_stats": batch_stats}
+        proto_map, _ = model.apply(variables, images, train=False)
+        log_prob, _ = patch_log_densities(proto_map, gmm)  # [B,C,K,H,W]
+        sel = labels[:, None, None, None, None]
+        lp = jnp.take_along_axis(log_prob, sel, axis=1)[:, 0]  # [B,K,H,W]
+        return jnp.exp(lp)
+
+    return jax.jit(fn)
+
+
+def collect_gt_activations(
+    trainer,
+    state,
+    batches,
+    use_noise: bool = False,
+    noise_seed: int = 0,
+    act_fn=None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Run the test set; returns (acts [N,K,h,w], targets [N], img_ids [N]).
+    `batches` yields (normalized images, labels, img_ids); padded tail rows
+    (label -1) are dropped. Pass a prebuilt `act_fn` (make_gt_act_fn) to
+    share one compiled forward across metric passes."""
+    if act_fn is None:
+        act_fn = make_gt_act_fn(trainer.model)
+    rng = np.random.default_rng(noise_seed)
+    accs, targets, ids = [], [], []
+    for images, labels, img_ids in batches:
+        images = np.asarray(images, np.float32)
+        if use_noise:
+            images = perturb_images(images, rng)
+        valid = np.asarray(labels) >= 0
+        acts = act_fn(
+            state.params,
+            state.batch_stats,
+            state.gmm,
+            jnp.asarray(images),
+            jnp.asarray(np.maximum(labels, 0), jnp.int32),
+        )
+        accs.append(np.asarray(jax.device_get(acts))[valid])
+        targets.append(np.asarray(labels)[valid])
+        ids.append(np.asarray(img_ids)[valid])
+    return (
+        np.concatenate(accs),
+        np.concatenate(targets),
+        np.concatenate(ids),
+    )
+
+
+def hit_matrix(
+    act_maps: np.ndarray,  # [N, K, h, w] one class's images
+    part_labels: Sequence[Sequence[Sequence[int]]],  # per image [(pid, x, y)]
+    part_num: int,
+    img_size: int,
+    half_size: int,
+    rows: Optional[Sequence[Tuple[int, int]]] = None,  # (out_row, img_idx) per K
+) -> np.ndarray:
+    """The shared geometric core (reference interpretability.py:108-131):
+    for image i and prototype k, mark parts within `half_size` of the
+    upsampled activation argmax. Returns [K, R, part_num] where R = number of
+    rows (= N, or len(rows) when a top-K subset is scored)."""
+    n, k_per_class = act_maps.shape[:2]
+    r = n if rows is None else len(rows)
+    out = np.zeros((k_per_class, r, part_num))
+    for k in range(k_per_class):
+        row_iter = (
+            enumerate(range(n)) if rows is None else enumerate(rows)
+        )
+        for out_row, img_idx in row_iter:
+            up = upsample_activation(
+                act_maps[img_idx, k], (img_size, img_size)
+            )
+            my, mx = np.unravel_index(np.argmax(up), up.shape)
+            region = (
+                max(0, my - half_size),
+                min(img_size, my + half_size),
+                max(0, mx - half_size),
+                min(img_size, mx + half_size),
+            )
+            for pid, x, y in part_labels[img_idx]:
+                if in_bbox((y, x), region):
+                    out[k, out_row, pid] = 1
+    return out
+
+
+def _per_class_annotations(
+    parts: CubParts, img_ids: np.ndarray, img_size: int
+) -> Tuple[List[List[List[int]]], np.ndarray]:
+    """Part labels + visibility masks for a class's images, rescaled to the
+    model's input size using each image's ORIGINAL dimensions."""
+    labels, masks = [], []
+    for img_id in img_ids:
+        pl, mask = parts.scaled_part_labels(
+            int(img_id), parts.orig_wh(int(img_id)), img_size
+        )
+        labels.append(pl)
+        masks.append(mask)
+    return labels, np.stack(masks)
+
+
+def _iter_class_hits(
+    acts: np.ndarray,
+    targets: np.ndarray,
+    img_ids: np.ndarray,
+    parts: CubParts,
+    img_size: int,
+    half_size: int,
+    num_classes: int,
+    top_k: Optional[int] = None,
+):
+    """Yields (class, hits [K,R,P], masks [N,P]) per class, in class order.
+    With top_k, R indexes each prototype's top-K most-activated images
+    (reference interpretability.py:222-224)."""
+    for c in range(num_classes):
+        idx = np.nonzero(targets == c)[0]
+        if idx.size == 0:
+            continue
+        class_acts = acts[idx]
+        labels, masks = _per_class_annotations(parts, img_ids[idx], img_size)
+        if top_k is None:
+            yield c, hit_matrix(
+                class_acts, labels, parts.part_num, img_size, half_size
+            ), masks
+        else:
+            peak = class_acts.max(axis=(2, 3))  # [N, K]
+            order = np.argsort(-peak, axis=0, kind="stable")  # best first
+            kk = min(top_k, idx.size)
+            # one single-prototype hit_matrix per k: scoring only that
+            # prototype's top-K images (not K x K work)
+            hits = np.stack(
+                [
+                    hit_matrix(
+                        class_acts[:, k : k + 1],
+                        labels,
+                        parts.part_num,
+                        img_size,
+                        half_size,
+                        rows=list(order[:kk, k]),
+                    )[0]
+                    for k in range(class_acts.shape[1])
+                ]
+            )
+            yield c, hits, masks
+
+
+def evaluate_consistency(
+    trainer,
+    state,
+    batches,
+    parts: CubParts,
+    num_classes: int,
+    half_size: int = 36,
+    part_thresh: float = 0.8,
+    activations: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+) -> float:
+    """% of prototypes hitting the same visible part in >= part_thresh of
+    their class's images (reference interpretability.py:134-160).
+    `activations` = a precomputed collect_gt_activations triple (shared
+    across metrics so the test set forwards once)."""
+    img_size = trainer.cfg.model.img_size
+    acts, targets, img_ids = (
+        activations
+        if activations is not None
+        else collect_gt_activations(trainer, state, batches)
+    )
+    consis = []
+    for _c, hits, masks in _iter_class_hits(
+        acts, targets, img_ids, parts, img_size, half_size, num_classes
+    ):
+        vis_count = np.maximum(masks.sum(axis=0), 1.0)  # [P]
+        for k in range(hits.shape[0]):
+            mean_part = hits[k].sum(axis=0) / vis_count
+            consis.append(1 if (mean_part >= part_thresh).any() else 0)
+    return float(np.mean(consis) * 100.0)
+
+
+def evaluate_stability(
+    trainer,
+    state,
+    batches_factory,
+    parts: CubParts,
+    num_classes: int,
+    half_size: int = 36,
+    noise_seed: int = 0,
+    activations: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    act_fn=None,
+) -> float:
+    """% of (prototype, image) hit vectors unchanged under input noise
+    (reference interpretability.py:163-178). `batches_factory()` returns a
+    fresh batch iterator (the noisy pass always re-reads it; the clean pass
+    reuses `activations` when given)."""
+    img_size = trainer.cfg.model.img_size
+    if act_fn is None:
+        act_fn = make_gt_act_fn(trainer.model)
+    acts, targets, img_ids = (
+        activations
+        if activations is not None
+        else collect_gt_activations(
+            trainer, state, batches_factory(), act_fn=act_fn
+        )
+    )
+    acts_n, _, _ = collect_gt_activations(
+        trainer,
+        state,
+        batches_factory(),
+        use_noise=True,
+        noise_seed=noise_seed,
+        act_fn=act_fn,
+    )
+    stab = []
+    clean = _iter_class_hits(
+        acts, targets, img_ids, parts, img_size, half_size, num_classes
+    )
+    noisy = _iter_class_hits(
+        acts_n, targets, img_ids, parts, img_size, half_size, num_classes
+    )
+    for (_c, h0, _m0), (_c2, h1, _m1) in zip(clean, noisy):
+        for k in range(h0.shape[0]):
+            unchanged = (np.abs(h0[k] - h1[k]).sum(axis=-1) == 0)
+            stab.append(unchanged.mean())
+    return float(np.mean(stab) * 100.0)
+
+
+def evaluate_purity(
+    trainer,
+    state,
+    batches,
+    parts: CubParts,
+    num_classes: int,
+    half_size: int = 16,
+    top_k: int = 10,
+    activations: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+) -> Tuple[float, float]:
+    """Mean/std over prototypes of the best per-part hit rate across each
+    prototype's top-K activated images (reference interpretability.py:298-315)."""
+    img_size = trainer.cfg.model.img_size
+    acts, targets, img_ids = (
+        activations
+        if activations is not None
+        else collect_gt_activations(trainer, state, batches)
+    )
+    purity = []
+    for _c, hits, _masks in _iter_class_hits(
+        acts, targets, img_ids, parts, img_size, half_size, num_classes,
+        top_k=top_k,
+    ):
+        for k in range(hits.shape[0]):
+            purity.append(hits[k].mean(axis=0).max())
+    arr = np.asarray(purity)
+    return float(arr.mean() * 100.0), float(arr.std() * 100.0)

@@ -1,0 +1,150 @@
+"""Interpretability metric tests: CUB parts parsing, the hit-matrix geometric
+core against hand-built golden cases, and the three metrics end-to-end on a
+synthetic CUB tree (reference utils/interpretability.py semantics)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from PIL import Image
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.data import Cub2011Eval, DataLoader
+from mgproto_tpu.data import ood_transform as make_squash_transform
+from mgproto_tpu.data.cub_parts import CubParts, in_bbox
+from mgproto_tpu.engine.interpretability import (
+    evaluate_consistency,
+    evaluate_purity,
+    evaluate_stability,
+    hit_matrix,
+    perturb_images,
+)
+from mgproto_tpu.engine.train import Trainer
+
+IMG_SIZE = 32
+NUM_CLASSES = 2
+PER_CLASS = 3
+PART_NUM = 3
+
+
+@pytest.fixture(scope="module")
+def cub_root(tmp_path_factory):
+    """Minimal CUB_200_2011-layout tree: 2 classes x 3 test images, 3 parts."""
+    root = tmp_path_factory.mktemp("cub")
+    rng = np.random.RandomState(0)
+    os.makedirs(root / "parts", exist_ok=True)
+    images, labels, split, bboxes, part_locs = [], [], [], [], []
+    img_id = 0
+    for c in range(NUM_CLASSES):
+        folder = f"{c + 1:03d}.Class_{c}"
+        os.makedirs(root / "images" / folder, exist_ok=True)
+        for i in range(PER_CLASS):
+            img_id += 1
+            name = f"img_{i}.jpg"
+            w, h = 64, 48  # non-square original
+            arr = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(root / "images" / folder / name)
+            images.append(f"{img_id} {folder}/{name}")
+            labels.append(f"{img_id} {c + 1}")
+            split.append(f"{img_id} 0")  # all test
+            bboxes.append(f"{img_id} 4.0 4.0 40.0 32.0")
+            # parts 1,2 visible everywhere; part 3 never visible
+            part_locs.append(f"{img_id} 1 {w // 4}.0 {h // 4}.0 1")
+            part_locs.append(f"{img_id} 2 {3 * w // 4}.0 {3 * h // 4}.0 1")
+            part_locs.append(f"{img_id} 3 0.0 0.0 0")
+    (root / "images.txt").write_text("\n".join(images) + "\n")
+    (root / "image_class_labels.txt").write_text("\n".join(labels) + "\n")
+    (root / "train_test_split.txt").write_text("\n".join(split) + "\n")
+    (root / "bounding_boxes.txt").write_text("\n".join(bboxes) + "\n")
+    (root / "parts" / "parts.txt").write_text(
+        "1 beak\n2 tail\n3 crown\n"
+    )
+    (root / "parts" / "part_locs.txt").write_text("\n".join(part_locs) + "\n")
+    return str(root)
+
+
+def test_cub_parts_tables(cub_root):
+    parts = CubParts(cub_root)
+    assert parts.part_num == PART_NUM
+    assert parts.id_to_path[1][1] == "img_0.jpg"
+    assert parts.id_to_bbox[1] == (4, 4, 44, 36)
+    assert parts.cls_to_id[0] == [1, 2, 3] and parts.cls_to_id[1] == [4, 5, 6]
+    assert parts.id_to_train[1] == 0
+    # only the 2 visible parts survive
+    assert [p[0] for p in parts.id_to_part_loc[1]] == [1, 2]
+    # scaling: x=16 on a 64-wide original -> 8 at img_size 32
+    labels, mask = parts.scaled_part_labels(1, (64, 48), 32)
+    assert labels[0] == [0, 8, 8]
+    assert mask.tolist() == [1.0, 1.0, 0.0]
+    assert in_bbox((5, 5), (0, 10, 0, 10)) and not in_bbox((11, 5), (0, 10, 0, 10))
+
+
+def test_hit_matrix_golden():
+    """One image, one prototype, peak at latent center -> pixel center;
+    a part at the center is hit, a part in the far corner is not."""
+    act = np.zeros((1, 1, 4, 4), np.float32)
+    act[0, 0, 2, 2] = 1.0  # latent peak -> pixel ~(20, 20) at img_size 32
+    part_labels = [[[0, 20, 20], [1, 0, 0]]]  # (pid, x, y)
+    hits = hit_matrix(act, part_labels, 2, img_size=32, half_size=6)
+    assert hits.shape == (1, 1, 2)
+    assert hits[0, 0, 0] == 1.0 and hits[0, 0, 1] == 0.0
+    # rows= selects a subset/order of images
+    hits2 = hit_matrix(
+        act, part_labels, 2, img_size=32, half_size=6, rows=[0, 0]
+    )
+    assert hits2.shape == (1, 2, 2)
+
+
+def test_perturb_bounded():
+    rng = np.random.default_rng(0)
+    imgs = np.zeros((2, 8, 8, 3), np.float32)
+    out = perturb_images(imgs, rng, std=0.2, eps=0.25)
+    assert np.abs(out).max() <= 0.25 and np.abs(out).max() > 0
+
+
+@pytest.fixture(scope="module")
+def setup(cub_root):
+    cfg = tiny_test_config(num_classes=NUM_CLASSES, img_size=IMG_SIZE)
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    # squash resize: the transform the reference eval scripts use, and the
+    # geometry scaled_part_labels assumes (width/height -> img_size ratios)
+    dataset = Cub2011Eval(
+        cub_root, train=False, transform=make_squash_transform(IMG_SIZE)
+    )
+    parts = CubParts(cub_root)
+    loader = DataLoader(dataset, batch_size=4, num_workers=0)
+    return trainer, state, parts, loader
+
+
+def test_metrics_end_to_end(setup):
+    trainer, state, parts, loader = setup
+    consis = evaluate_consistency(
+        trainer, state, iter(loader), parts, NUM_CLASSES, half_size=12
+    )
+    assert 0.0 <= consis <= 100.0
+
+    stab = evaluate_stability(
+        trainer, state, lambda: iter(loader), parts, NUM_CLASSES, half_size=12
+    )
+    assert 0.0 <= stab <= 100.0
+    purity, purity_std = evaluate_purity(
+        trainer, state, iter(loader), parts, NUM_CLASSES, half_size=8, top_k=2
+    )
+    assert 0.0 <= purity <= 100.0 and purity_std >= 0.0
+
+
+def test_consistency_extremes(setup):
+    """A giant half_size box covers every part -> consistency 100."""
+    trainer, state, parts, loader = setup
+    consis = evaluate_consistency(
+        trainer, state, iter(loader), parts, NUM_CLASSES,
+        half_size=IMG_SIZE,  # box = whole image
+    )
+    assert consis == pytest.approx(100.0)
+    purity, _ = evaluate_purity(
+        trainer, state, iter(loader), parts, NUM_CLASSES,
+        half_size=IMG_SIZE, top_k=2,
+    )
+    assert purity == pytest.approx(100.0)
