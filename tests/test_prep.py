@@ -1,0 +1,171 @@
+"""Offline data-prep tests (reference preprocess_data/* semantics)."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mgproto_tpu.data import prep
+
+
+@pytest.fixture()
+def cub_tree(tmp_path):
+    """CUB-layout root: 2 classes x 2 images + segmentations."""
+    root = tmp_path / "CUB"
+    seg = tmp_path / "segs"
+    rng = np.random.RandomState(0)
+    images, boxes, split = [], [], []
+    iid = 0
+    for c in range(2):
+        folder = f"{c + 1:03d}.C{c}"
+        os.makedirs(root / "images" / folder)
+        os.makedirs(seg / folder)
+        for i in range(2):
+            iid += 1
+            rel = f"{folder}/im{i}.jpg"
+            arr = rng.randint(0, 255, (40, 60, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(root / "images" / rel)
+            # mask: 0 bg, 128 border, 255 fg
+            m = np.zeros((40, 60), np.uint8)
+            m[10:30, 20:50] = 255
+            m[10:12] = 128
+            Image.fromarray(m).save(seg / f"{folder}/im{i}.png")
+            images.append(f"{iid} {rel}")
+            boxes.append(f"{iid} 10.0 5.0 30.0 20.0")
+            split.append(f"{iid} {1 if i == 0 else 0}")
+    (root / "images.txt").write_text("\n".join(images) + "\n")
+    (root / "bounding_boxes.txt").write_text("\n".join(boxes) + "\n")
+    (root / "train_test_split.txt").write_text("\n".join(split) + "\n")
+    return str(root), str(seg)
+
+
+def test_crop_cub(cub_tree, tmp_path):
+    root, _ = cub_tree
+    out = str(tmp_path / "out")
+    n_train, n_test = prep.crop_cub(root, out)
+    assert (n_train, n_test) == (2, 2)
+    p = os.path.join(out, "train_cropped", "001.C0", "im0.jpg")
+    with Image.open(p) as im:
+        assert im.size == (30, 20)  # the bbox w x h
+    # source untouched (the reference overwrites in place — we must not)
+    with Image.open(os.path.join(root, "images", "001.C0", "im0.jpg")) as im:
+        assert im.size == (60, 40)
+    assert os.path.exists(
+        os.path.join(out, "test_cropped", "002.C1", "im1.jpg")
+    )
+
+
+def test_crop_and_binarize_masks(cub_tree, tmp_path):
+    root, seg = cub_tree
+    out = str(tmp_path / "masks")
+    n = prep.crop_cub_masks(root, seg, out)
+    assert n == 4
+    fg_out = str(tmp_path / "fg")
+    n2 = prep.binarize_masks(os.path.join(out, "mask_train"), fg_out)
+    assert n2 == 2
+    with Image.open(
+        os.path.join(fg_out, "001.C0", "im0.png")
+    ) as im:
+        arr = np.asarray(im)
+    assert set(np.unique(arr)) <= {0, 255}
+    assert (arr == 255).any() and (arr == 0).any()
+
+
+def test_build_pets(tmp_path):
+    img_dir = tmp_path / "imgs"
+    os.makedirs(img_dir)
+    for name in ["Abyssinian_1", "beagle_2"]:
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(
+            img_dir / f"{name}.jpg"
+        )
+    label_file = tmp_path / "trainval.txt"
+    label_file.write_text(
+        "# comment line\nAbyssinian_1 1 1 1\nbeagle_2 2 2 1\n"
+    )
+    out = str(tmp_path / "pets")
+    n = prep.build_pets(str(img_dir), str(label_file), out)
+    assert n == 2
+    assert os.path.exists(os.path.join(out, "1", "Abyssinian_1.jpg"))
+    assert os.path.exists(os.path.join(out, "2", "beagle_2.jpg"))
+
+
+def test_augment_offline(tmp_path):
+    src = tmp_path / "src" / "clsA"
+    os.makedirs(src)
+    rng = np.random.RandomState(1)
+    for i in range(2):
+        Image.fromarray(
+            rng.randint(0, 255, (32, 48, 3), dtype=np.uint8)
+        ).save(src / f"im{i}.jpg")
+    dst = str(tmp_path / "dst")
+    n = prep.augment_offline(
+        str(tmp_path / "src"), dst, copies_per_op=2, seed=0
+    )
+    # 2 images x 4 ops x 2 copies
+    assert n == 16
+    files = os.listdir(os.path.join(dst, "clsA"))
+    assert len(files) == 16
+    # every op family produced outputs, sizes preserved
+    for op in ("rotate", "skew", "shear", "distortion"):
+        assert any(op in f for f in files)
+    with Image.open(os.path.join(dst, "clsA", sorted(files)[0])) as im:
+        assert im.size == (48, 32)
+    # deterministic: same seed reproduces byte-identical output sizes
+    dst2 = str(tmp_path / "dst2")
+    prep.augment_offline(str(tmp_path / "src"), dst2, copies_per_op=2, seed=0)
+    a = sorted(os.listdir(os.path.join(dst, "clsA")))
+    b = sorted(os.listdir(os.path.join(dst2, "clsA")))
+    assert a == b
+    for f in a[:4]:
+        pa = np.asarray(Image.open(os.path.join(dst, "clsA", f)))
+        pb = np.asarray(Image.open(os.path.join(dst2, "clsA", f)))
+        np.testing.assert_array_equal(pa, pb)
+
+
+def test_binarize_two_level_mask(tmp_path):
+    """A clean binary mask {0, 255} keeps its foreground (only the lowest
+    level is background when there are just two)."""
+    src = tmp_path / "m" / "c"
+    os.makedirs(src)
+    m = np.zeros((10, 10), np.uint8)
+    m[3:7, 3:7] = 255
+    Image.fromarray(m).save(src / "a.png")
+    prep.binarize_masks(str(tmp_path / "m"), str(tmp_path / "out"))
+    arr = np.asarray(Image.open(tmp_path / "out" / "c" / "a.png"))
+    assert (arr == 255).sum() == 16
+
+
+def test_augment_same_stem_no_collision(tmp_path):
+    src = tmp_path / "s" / "c"
+    os.makedirs(src)
+    rng = np.random.RandomState(0)
+    Image.fromarray(rng.randint(0, 255, (8, 8, 3), dtype=np.uint8)).save(
+        src / "a.jpg"
+    )
+    Image.fromarray(rng.randint(0, 255, (8, 8, 3), dtype=np.uint8)).save(
+        src / "a.png"
+    )
+    n = prep.augment_offline(
+        str(tmp_path / "s"), str(tmp_path / "d"), copies_per_op=1,
+        seed=0, ops=["rotate"],
+    )
+    files = os.listdir(tmp_path / "d" / "c")
+    assert n == 2 and len(files) == 2  # no overwrite
+
+
+def test_augment_empty_ops_rejected(tmp_path):
+    os.makedirs(tmp_path / "s" / "c")
+    with pytest.raises(ValueError):
+        prep.augment_offline(str(tmp_path / "s"), str(tmp_path / "d"), ops=[])
+
+
+def test_augment_single_op(tmp_path):
+    src = tmp_path / "s" / "c"
+    os.makedirs(src)
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(src / "x.jpg")
+    n = prep.augment_offline(
+        str(tmp_path / "s"), str(tmp_path / "d"), copies_per_op=3,
+        seed=1, ops=["rotate"],
+    )
+    assert n == 3
