@@ -38,7 +38,13 @@ def main() -> None:
     from mgproto_tpu.engine.train import Trainer
 
     cfg = Config(
-        model=ModelConfig(arch="resnet34", num_classes=200, pretrained=False)
+        model=ModelConfig(
+            arch="resnet34",
+            num_classes=200,
+            pretrained=False,
+            # bf16 trunk on the MXU; params/BN-stats/density/losses stay f32
+            compute_dtype="bfloat16",
+        )
     )
     trainer = Trainer(cfg, steps_per_epoch=100)
     state = trainer.init_state(jax.random.PRNGKey(0))

@@ -95,21 +95,26 @@ class AddOnLayers(nn.Module):
     proto_dim: int
     add_on_type: str
     in_channels: int
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x):
         if self.add_on_type == "regular":
-            x = nn.Conv(self.proto_dim, (1, 1), name="conv0")(x)
-            x = nn.Conv(self.proto_dim, (1, 1), name="conv1")(x)
+            x = nn.Conv(self.proto_dim, (1, 1), name="conv0", dtype=self.dtype)(x)
+            x = nn.Conv(self.proto_dim, (1, 1), name="conv1", dtype=self.dtype)(x)
             return x
         if self.add_on_type == "bottleneck":
             current_in = self.in_channels
             i = 0
             while True:
                 current_out = max(self.proto_dim, current_in // 2)
-                x = nn.Conv(current_out, (1, 1), name=f"conv{i}_a")(x)
+                x = nn.Conv(
+                    current_out, (1, 1), name=f"conv{i}_a", dtype=self.dtype
+                )(x)
                 x = nn.relu(x)
-                x = nn.Conv(current_out, (1, 1), name=f"conv{i}_b")(x)
+                x = nn.Conv(
+                    current_out, (1, 1), name=f"conv{i}_b", dtype=self.dtype
+                )(x)
                 if current_out > self.proto_dim:
                     x = nn.relu(x)
                 else:
@@ -130,21 +135,31 @@ class MGProtoFeatures(nn.Module):
     cfg: ModelConfig
 
     def setup(self):
-        self.features = build_backbone(self.cfg.arch)
+        # Mixed precision: convs/BN run in cfg.compute_dtype (bf16 on the MXU),
+        # params + batch_stats stay f32, and everything downstream of the
+        # trunk — L2 norm, density, losses — is cast back to f32 (OoD
+        # thresholds depend on the p(x) scale; SURVEY.md §7.3.5).
+        dtype = jnp.dtype(self.cfg.compute_dtype)
+        dtype = None if dtype == jnp.float32 else dtype
+        self.features = build_backbone(self.cfg.arch, dtype=dtype)
         self.add_on = AddOnLayers(
             proto_dim=self.cfg.proto_dim,
             add_on_type=self.cfg.add_on_type,
             in_channels=self.features.out_channels,
+            dtype=dtype,
             name="add_on",
         )
         # aux embedding reads the BACKBONE output, not the add-on output
-        # (reference model.py:180-184)
+        # (reference model.py:180-184); tiny Dense, kept in f32
         self.embedding = nn.Dense(self.cfg.sz_embedding, name="embedding")
 
     def __call__(self, x, train: bool = False):
-        x = self.features(x, train=train)
-        proto_map = self.add_on(x)
-        pooled = jnp.mean(x, axis=(1, 2))  # GAP (model.py:145)
+        dtype = jnp.dtype(self.cfg.compute_dtype)
+        x = self.features(x.astype(dtype), train=train)
+        proto_map = self.add_on(x).astype(jnp.float32)
+        # GAP (model.py:145) — accumulate in f32: H*W bf16 additions would
+        # round before the downstream-of-trunk f32 boundary
+        pooled = jnp.mean(x, axis=(1, 2), dtype=jnp.float32)
         embed = l2_normalize(self.embedding(pooled), axis=-1)
         return proto_map, embed
 

@@ -25,7 +25,9 @@ import jax.numpy as jnp
 
 ConvInfo = Tuple[List[int], List[int], List[Any]]
 
-# torch BatchNorm2d defaults: momentum=0.1 (flax momentum = 1 - 0.1), eps=1e-5
+# torch BatchNorm2d defaults: momentum=0.1 (flax momentum = 1 - 0.1), eps=1e-5.
+# `dtype` is the mixed-precision compute dtype (params/batch_stats stay f32 via
+# param_dtype; flax computes the batch statistics themselves in f32 regardless).
 BatchNorm = partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5)
 
 
@@ -36,6 +38,7 @@ def conv(
     padding: int = 0,
     use_bias: bool = False,
     name: str | None = None,
+    dtype: Any = None,
 ) -> nn.Conv:
     return nn.Conv(
         features=features,
@@ -44,6 +47,7 @@ def conv(
         padding=[(padding, padding), (padding, padding)],
         use_bias=use_bias,
         name=name,
+        dtype=dtype,
     )
 
 

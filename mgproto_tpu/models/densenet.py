@@ -9,7 +9,7 @@ ops only (the reference counts the removed pool0, densenet_features.py:119).
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Any, List, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -23,15 +23,18 @@ class DenseLayer(nn.Module):
 
     growth_rate: int
     bn_size: int = 4
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        y = BatchNorm(name="norm1")(x, use_running_average=not train)
+        y = BatchNorm(name="norm1", dtype=self.dtype)(x, use_running_average=not train)
         y = nn.relu(y)
-        y = conv(self.bn_size * self.growth_rate, 1, 1, 0, name="conv1")(y)
-        y = BatchNorm(name="norm2")(y, use_running_average=not train)
+        y = conv(
+            self.bn_size * self.growth_rate, 1, 1, 0, name="conv1", dtype=self.dtype
+        )(y)
+        y = BatchNorm(name="norm2", dtype=self.dtype)(y, use_running_average=not train)
         y = nn.relu(y)
-        y = conv(self.growth_rate, 3, 1, 1, name="conv2")(y)
+        y = conv(self.growth_rate, 3, 1, 1, name="conv2", dtype=self.dtype)(y)
         return jnp.concatenate([x, y], axis=-1)
 
 
@@ -39,12 +42,13 @@ class Transition(nn.Module):
     """BN-ReLU-1x1 + 2x2 avgpool (reference densenet_features.py:71-84)."""
 
     out_features: int
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        x = BatchNorm(name="norm")(x, use_running_average=not train)
+        x = BatchNorm(name="norm", dtype=self.dtype)(x, use_running_average=not train)
         x = nn.relu(x)
-        x = conv(self.out_features, 1, 1, 0, name="conv")(x)
+        x = conv(self.out_features, 1, 1, 0, name="conv", dtype=self.dtype)(x)
         return avg_pool(x, 2, 2)
 
 
@@ -54,11 +58,12 @@ class DenseNetFeatures(nn.Module):
     num_init_features: int = 64
     bn_size: int = 4
     stem_pool: bool = False  # reference removes pool0 (densenet_features.py:116)
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = conv(self.num_init_features, 7, 2, 3, name="conv0")(x)
-        x = BatchNorm(name="norm0")(x, use_running_average=not train)
+        x = conv(self.num_init_features, 7, 2, 3, name="conv0", dtype=self.dtype)(x)
+        x = BatchNorm(name="norm0", dtype=self.dtype)(x, use_running_average=not train)
         x = nn.relu(x)
         if self.stem_pool:
             x = max_pool(x, 3, 2, 1)
@@ -70,15 +75,18 @@ class DenseNetFeatures(nn.Module):
                     growth_rate=self.growth_rate,
                     bn_size=self.bn_size,
                     name=f"denseblock{bi + 1}_denselayer{li + 1}",
+                    dtype=self.dtype,
                 )(x, train)
             num_features += num_layers * self.growth_rate
             if bi != len(self.block_config) - 1:
                 num_features //= 2
                 x = Transition(
-                    out_features=num_features, name=f"transition{bi + 1}"
+                    out_features=num_features,
+                    name=f"transition{bi + 1}",
+                    dtype=self.dtype,
                 )(x, train)
 
-        x = BatchNorm(name="norm5")(x, use_running_average=not train)
+        x = BatchNorm(name="norm5", dtype=self.dtype)(x, use_running_average=not train)
         return nn.relu(x)
 
     @property

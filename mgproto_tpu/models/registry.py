@@ -4,7 +4,7 @@ plus a tiny CNN for tests/dry-runs."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict
+from typing import Any, Callable, Dict
 
 import flax.linen as nn
 
@@ -17,16 +17,17 @@ class TinyFeatures(nn.Module):
     structural contract (NHWC in/out, conv_info, out_channels) as the zoo."""
 
     width: int = 32
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = conv(self.width, 3, 2, 1, name="conv0")(x)
-        x = BatchNorm(name="bn0")(x, use_running_average=not train)
+        x = conv(self.width, 3, 2, 1, name="conv0", dtype=self.dtype)(x)
+        x = BatchNorm(name="bn0", dtype=self.dtype)(x, use_running_average=not train)
         x = nn.relu(x)
-        x = conv(self.width, 3, 2, 1, name="conv1")(x)
-        x = BatchNorm(name="bn1")(x, use_running_average=not train)
+        x = conv(self.width, 3, 2, 1, name="conv1", dtype=self.dtype)(x)
+        x = BatchNorm(name="bn1", dtype=self.dtype)(x, use_running_average=not train)
         x = nn.relu(x)
-        x = conv(self.width, 3, 1, 1, name="conv2")(x)
+        x = conv(self.width, 3, 1, 1, name="conv2", dtype=self.dtype)(x)
         return nn.relu(x)
 
     @property

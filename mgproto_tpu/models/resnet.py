@@ -14,7 +14,7 @@ reference, which always counts the skipped maxpool, resnet_features.py:140).
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Any, List, Sequence
 
 import flax.linen as nn
 
@@ -28,18 +28,21 @@ class BasicBlock(nn.Module):
     stride: int = 1
     has_downsample: bool = False
     expansion: int = 1
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
         identity = x
-        out = conv(self.planes, 3, self.stride, 1, name="conv1")(x)
-        out = BatchNorm(name="bn1")(out, use_running_average=not train)
+        out = conv(self.planes, 3, self.stride, 1, name="conv1", dtype=self.dtype)(x)
+        out = BatchNorm(name="bn1", dtype=self.dtype)(out, use_running_average=not train)
         out = nn.relu(out)
-        out = conv(self.planes, 3, 1, 1, name="conv2")(out)
-        out = BatchNorm(name="bn2")(out, use_running_average=not train)
+        out = conv(self.planes, 3, 1, 1, name="conv2", dtype=self.dtype)(out)
+        out = BatchNorm(name="bn2", dtype=self.dtype)(out, use_running_average=not train)
         if self.has_downsample:
-            identity = conv(self.planes, 1, self.stride, 0, name="downsample_conv")(x)
-            identity = BatchNorm(name="downsample_bn")(
+            identity = conv(
+                self.planes, 1, self.stride, 0, name="downsample_conv", dtype=self.dtype
+            )(x)
+            identity = BatchNorm(name="downsample_bn", dtype=self.dtype)(
                 identity, use_running_average=not train
             )
         return nn.relu(out + identity)
@@ -56,21 +59,25 @@ class Bottleneck(nn.Module):
     stride: int = 1
     has_downsample: bool = False
     expansion: int = 4
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
         identity = x
-        out = conv(self.planes, 1, 1, 0, name="conv1")(x)
-        out = BatchNorm(name="bn1")(out, use_running_average=not train)
+        out = conv(self.planes, 1, 1, 0, name="conv1", dtype=self.dtype)(x)
+        out = BatchNorm(name="bn1", dtype=self.dtype)(out, use_running_average=not train)
         out = nn.relu(out)
-        out = conv(self.planes, 3, self.stride, 1, name="conv2")(out)
-        out = BatchNorm(name="bn2")(out, use_running_average=not train)
+        out = conv(self.planes, 3, self.stride, 1, name="conv2", dtype=self.dtype)(out)
+        out = BatchNorm(name="bn2", dtype=self.dtype)(out, use_running_average=not train)
         out = nn.relu(out)
-        out = conv(self.planes * 4, 1, 1, 0, name="conv3")(out)
-        out = BatchNorm(name="bn3")(out, use_running_average=not train)
+        out = conv(self.planes * 4, 1, 1, 0, name="conv3", dtype=self.dtype)(out)
+        out = BatchNorm(name="bn3", dtype=self.dtype)(out, use_running_average=not train)
         if self.has_downsample:
-            identity = conv(self.planes * 4, 1, self.stride, 0, name="downsample_conv")(x)
-            identity = BatchNorm(name="downsample_bn")(
+            identity = conv(
+                self.planes * 4, 1, self.stride, 0, name="downsample_conv",
+                dtype=self.dtype,
+            )(x)
+            identity = BatchNorm(name="downsample_bn", dtype=self.dtype)(
                 identity, use_running_average=not train
             )
         return nn.relu(out + identity)
@@ -86,11 +93,12 @@ class ResNetFeatures(nn.Module):
     block_cls: type
     layers: Sequence[int]
     stem_pool: bool = False  # reference skips it (resnet_features.py:199)
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = conv(64, 7, 2, 3, name="conv1")(x)
-        x = BatchNorm(name="bn1")(x, use_running_average=not train)
+        x = conv(64, 7, 2, 3, name="conv1", dtype=self.dtype)(x)
+        x = BatchNorm(name="bn1", dtype=self.dtype)(x, use_running_average=not train)
         x = nn.relu(x)
         if self.stem_pool:
             x = max_pool(x, 3, 2, 1)
@@ -108,6 +116,7 @@ class ResNetFeatures(nn.Module):
                     stride=s,
                     has_downsample=needs_ds and bi == 0,
                     name=f"layer{li + 1}_{bi}",
+                    dtype=self.dtype,
                 )(x, train)
                 inplanes = planes * self.block_cls.expansion
         return x

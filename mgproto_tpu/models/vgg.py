@@ -9,7 +9,7 @@ since the last cfg entry is always 'M'; default True = ReLU kept).
 
 from __future__ import annotations
 
-from typing import List, Tuple, Union
+from typing import Any, List, Tuple, Union
 
 import flax.linen as nn
 
@@ -31,6 +31,7 @@ class VGGFeatures(nn.Module):
     batch_norm: bool = False
     final_maxpool: bool = False  # reference default: final pool removed
     final_relu: bool = True
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -43,9 +44,12 @@ class VGGFeatures(nn.Module):
                 x = max_pool(x, 2, 2, 0)
             else:
                 # torch VGG convs have bias (nn.Conv2d default)
-                x = conv(int(v), 3, 1, 1, use_bias=True, name=f"conv{conv_idx}")(x)
+                x = conv(
+                    int(v), 3, 1, 1, use_bias=True, name=f"conv{conv_idx}",
+                    dtype=self.dtype,
+                )(x)
                 if self.batch_norm:
-                    x = BatchNorm(name=f"bn{conv_idx}")(
+                    x = BatchNorm(name=f"bn{conv_idx}", dtype=self.dtype)(
                         x, use_running_average=not train
                     )
                     x = nn.relu(x)

@@ -111,3 +111,61 @@ def test_patch_log_densities_l2_normalizes(setup):
     assert lp.shape == (
         b, cfg.model.num_classes, cfg.model.prototypes_per_class, h, h,
     )
+
+
+class TestMixedPrecision:
+    """bf16 trunk (cfg.compute_dtype) with f32 params/stats/density:
+    the MXU path the bench runs (common.py dtype plumbing)."""
+
+    def _trainers(self):
+        import dataclasses
+
+        from mgproto_tpu.engine.train import Trainer
+
+        out = []
+        for dt in ("float32", "bfloat16"):
+            cfg = tiny_test_config()
+            cfg = cfg.replace(
+                model=dataclasses.replace(
+                    cfg.model, compute_dtype=dt, arch="resnet18", img_size=32
+                )
+            )
+            out.append(Trainer(cfg, steps_per_epoch=2))
+        return out
+
+    def test_bf16_matches_f32_and_keeps_f32_state(self):
+        tr32, tr16 = self._trainers()
+        st32 = tr32.init_state(jax.random.PRNGKey(0))
+        st16 = tr16.init_state(jax.random.PRNGKey(0))
+        # same init regardless of compute dtype
+        chex = np.testing.assert_allclose
+        for a, b in zip(
+            jax.tree_util.tree_leaves(st32.params),
+            jax.tree_util.tree_leaves(st16.params),
+        ):
+            assert b.dtype == a.dtype  # params stay f32 under bf16 compute
+            chex(np.asarray(a), np.asarray(b))
+
+        imgs = jax.random.uniform(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        lbls = jnp.array([0, 1, 2, 3])
+        s32, m32 = tr32.train_step(st32, imgs, lbls, use_mine=True, update_gmm=False)
+        s16, m16 = tr16.train_step(st16, imgs, lbls, use_mine=True, update_gmm=False)
+        # losses agree to bf16 tolerance; all state stays f32
+        assert np.isfinite(float(m16.loss))
+        assert abs(float(m16.loss) - float(m32.loss)) < 0.05 * max(
+            1.0, abs(float(m32.loss))
+        )
+        for leaf in jax.tree_util.tree_leaves(
+            (s16.params, s16.batch_stats, s16.gmm.means, s16.memory.feats)
+        ):
+            assert leaf.dtype != jnp.bfloat16
+
+    def test_eval_logits_close(self):
+        tr32, tr16 = self._trainers()
+        st = tr32.init_state(jax.random.PRNGKey(0))
+        imgs = jax.random.uniform(jax.random.PRNGKey(2), (2, 32, 32, 3))
+        o32 = tr32.eval_step(st, imgs)
+        o16 = tr16.eval_step(st, imgs)
+        np.testing.assert_allclose(
+            np.asarray(o32.logits), np.asarray(o16.logits), rtol=0.1, atol=0.5
+        )
