@@ -32,6 +32,10 @@ class ModelConfig:
     # dtype policy: params/activations compute dtype. Density math is always f32
     # (OoD thresholds depend on p(x) scale; see SURVEY.md §7.3.5).
     compute_dtype: str = "float32"
+    # Route density + top-T through the fused Pallas kernel
+    # (ops/fused_scoring.py). Identical numerics; needs a TPU (interpret-mode
+    # fallback on CPU is correct but slow).
+    fused_scoring: bool = False
 
     @property
     def num_prototypes(self) -> int:

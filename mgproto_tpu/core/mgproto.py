@@ -183,17 +183,51 @@ def patch_log_densities(
     return jnp.transpose(lp, (0, 3, 4, 1, 2)), feat
 
 
+def _fused_pool(
+    proto_map: jax.Array, gmm: GMMState, mine_T: int
+) -> Tuple[PooledActivations, jax.Array]:
+    """score_pool-backed equivalent of patch_log_densities + top_t_pool:
+    the [B*H*W, C*K] density matrix never hits HBM (ops/fused_scoring.py)."""
+    from mgproto_tpu.ops.fused_scoring import score_pool
+    from mgproto_tpu.ops.gaussian import DEFAULT_SIGMA_EPS
+
+    b, h, w, d = proto_map.shape
+    feat = l2_normalize(proto_map, axis=-1).reshape(b, h * w, d)
+    # the Mosaic lowering (VMEM scratch, sequential minor grid) is TPU-only;
+    # every other backend gets the correct-but-slow interpreter
+    interpret = jax.default_backend() != "tpu"
+    vals, idx = score_pool(
+        feat, gmm.means, gmm.sigmas, mine_T, DEFAULT_SIGMA_EPS, interpret
+    )
+    c, k = gmm.num_classes, gmm.k_per_class
+    top1 = idx[..., 0].reshape(b, c, k)
+    top1_feat = jnp.take_along_axis(
+        feat, idx[..., 0][..., None], axis=1
+    ).reshape(b, c, k, d)
+    pooled = PooledActivations(
+        log_act=vals.reshape(b, c, k, mine_T),
+        top1_idx=top1,
+        top1_feat=top1_feat,
+    )
+    return pooled, feat.reshape(b, h, w, d)
+
+
 def head_forward(
     proto_map: jax.Array,
     gmm: GMMState,
     labels: Optional[jax.Array],
     mine_T: int,
     prior_eps: float = 1e-10,
+    fused: bool = False,
 ) -> Tuple[jax.Array, PooledActivations, Tuple[jax.Array, jax.Array, jax.Array]]:
     """GMM head on an add-on feature map: returns (logits [B,C,T], pooled,
-    enqueue candidates). Pure function; no flax."""
-    log_prob, feat = patch_log_densities(proto_map, gmm)
-    pooled = top_t_pool(log_prob, feat, mine_T)
+    enqueue candidates). Pure function; no flax. `fused` routes the density +
+    top-T through the Pallas kernel (identical numerics, no [BHW, P] in HBM)."""
+    if fused:
+        pooled, feat = _fused_pool(proto_map, gmm, mine_T)
+    else:
+        log_prob, feat = patch_log_densities(proto_map, gmm)
+        pooled = top_t_pool(log_prob, feat, mine_T)
     act = mine_mask_activations(pooled.log_act, labels)  # [B, C, K, T]
     # exactly-zero priors (pruned slots, model.py:481-482) must contribute
     # exp(-inf)=0, not eps — eps only stabilizes small-but-live priors

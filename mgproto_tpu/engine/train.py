@@ -101,7 +101,8 @@ class Trainer:
             params, state.batch_stats, images, train=True
         )
         logits, pooled, enq = head_forward(
-            proto_map, state.gmm, labels, self.cfg.model.mine_T
+            proto_map, state.gmm, labels, self.cfg.model.mine_T,
+            fused=self.cfg.model.fused_scoring,
         )
         ce = L.cross_entropy(logits[..., 0], labels)
         mine = L.mine_loss(logits, labels) * use_mine
@@ -201,7 +202,8 @@ class Trainer:
             state.params, state.batch_stats, images, train=False
         )
         logits, _, _ = head_forward(
-            proto_map, state.gmm, None, self.cfg.model.mine_T
+            proto_map, state.gmm, None, self.cfg.model.mine_T,
+            fused=self.cfg.model.fused_scoring,
         )
         lvl0 = logits[..., 0]
         correct = (

@@ -1,0 +1,264 @@
+"""Fused Pallas kernel: Gaussian prototype scoring + top-T spatial pool.
+
+The hot op of MGProto (reference model.py:256-275 `compute_log_prob` +
+model.py:188-206 `global_max_pooling_gmm_topT`) evaluated the naive way
+materializes a [B*H*W, P] density matrix in HBM (~500 MB at the flagship
+R34-CUB shapes: 80*28*28 patches x 2000 prototypes, f32) only for top-T to
+immediately reduce it over the spatial axis. This kernel keeps each
+[HW, P_tile] density tile in VMEM: two MXU matmuls produce the tile, an
+unrolled T-pass max/argmax reduction pools it, and only [B, T, P] values +
+indices (~13 MB) ever reach HBM.
+
+Gradient contract: prototypes are CONSTANTS here — the reference detaches
+means/covs inside compute_log_prob (model.py:264-265), so the classification
+loss trains features only (means train via EM on the memory bank, which calls
+ops/gaussian.py directly and never goes through this kernel). The custom VJP
+therefore returns a gradient for the feature map alone, rebuilding the sparse
+[HW, P] selection weights tile-by-tile from the saved indices (20 compare+add
+passes) and turning them into two [HW,P_tile]x[P_tile,d] MXU matmuls:
+
+    d logN / dx = (mu - x) / sigma^2   at each selected patch
+    grad_x = w @ (mu * s) - x * (w @ s),   s = 1/sigma^2,
+    w[n, p] = sum_t g[p, t] * [idx[p, t] == n]
+
+Math identical to ops/gaussian.py's quadratic expansion; f32 throughout with
+HIGHEST matmul precision (OoD p(x) thresholds ride on the density scale,
+SURVEY.md §7.3.5).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mgproto_tpu.ops.gaussian import DEFAULT_SIGMA_EPS, precompute_diag_gaussian
+
+_NEG_INF = float("-inf")
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# --------------------------------------------------------------------- forward
+def _fwd_kernel(feat_ref, msc_ref, ivar_ref, const_ref, vals_ref, idx_ref, *, t_levels):
+    """One (batch b, prototype tile j) grid cell.
+
+    feat_ref:  [1, HW, d]   L2-normalized patch features of sample b.
+    msc_ref:   [TP, d]      mu * s for this prototype tile (s = 1/sigma^2).
+    ivar_ref:  [TP, d]      s.
+    const_ref: [1, TP]      -d/2 log(2pi) - sum log sigma - 1/2 mu.s.mu.
+    vals_ref:  [1, Tpad, TP] out: top-T log-densities (sorted desc).
+    idx_ref:   [1, Tpad, TP] out: flat spatial index of each.
+    """
+    feat = feat_ref[0]  # [HW, d]
+    hw = feat.shape[0]
+    # logN[n, p] = const_p + x.(mu*s) - 0.5 * (x*x).s
+    cross = jax.lax.dot_general(
+        feat, msc_ref[...],
+        (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # [HW, TP]
+    xquad = jax.lax.dot_general(
+        feat * feat, ivar_ref[...],
+        (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # [HW, TP]
+    dens = const_ref[0][None, :] + cross - 0.5 * xquad  # [HW, TP]
+
+    row = jax.lax.broadcasted_iota(jnp.int32, dens.shape, 0)  # [HW, TP]
+    for t in range(t_levels):
+        mx = jnp.max(dens, axis=0)  # [TP]
+        am = jnp.argmax(dens, axis=0).astype(jnp.int32)  # [TP] first-of-ties,
+        # matching lax.top_k's lowest-index tie-break in the unfused path
+        vals_ref[0, t, :] = mx
+        idx_ref[0, t, :] = am
+        dens = jnp.where(row == am[None, :], _NEG_INF, dens)
+    for t in range(t_levels, vals_ref.shape[1]):  # Tpad tail: inert filler
+        vals_ref[0, t, :] = jnp.full(dens.shape[1:], _NEG_INF, jnp.float32)
+        idx_ref[0, t, :] = jnp.zeros(dens.shape[1:], jnp.int32)
+
+
+# -------------------------------------------------------------------- backward
+def _bwd_kernel(
+    g_ref, idx_ref, feat_ref, msc_ref, ivar_ref, out_ref, acc_m, acc_s, *, t_levels
+):
+    """Accumulates grad_feat for sample b across prototype tiles j (the minor,
+    sequential grid axis): scratch accumulators persist over j and the output
+    block (mapped by b only) is written once at the last tile."""
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_m[...] = jnp.zeros_like(acc_m)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    hw = feat_ref.shape[1]
+    tp = msc_ref.shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, (hw, tp), 0)
+    w = jnp.zeros((hw, tp), jnp.float32)
+    for t in range(t_levels):
+        w = w + jnp.where(
+            row == idx_ref[0, t, :][None, :], g_ref[0, t, :][None, :], 0.0
+        )
+    acc_m[...] += jax.lax.dot_general(
+        w, msc_ref[...],
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    acc_s[...] += jax.lax.dot_general(
+        w, ivar_ref[...],
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        out_ref[0] = acc_m[...] - feat_ref[0] * acc_s[...]
+
+
+# ------------------------------------------------------------------ public API
+def _prepare(means, sigmas, eps, p_pad):
+    """Precompute (mu*s, s, const) via the SAME helper as the unfused path
+    (ops/gaussian.py precompute_diag_gaussian — single source of the density
+    numerics), then pad P. Padded slots get s=0, const=-inf: their densities
+    are -inf so they can never enter a top-T, and they contribute exactly 0 to
+    the backward matmuls."""
+    m_scaled, inv_var, const = precompute_diag_gaussian(means, sigmas, eps)
+    pad = p_pad - m_scaled.shape[0]
+    msc = jnp.pad(m_scaled, ((0, pad), (0, 0)))
+    ivar = jnp.pad(inv_var, ((0, pad), (0, 0)))
+    const = jnp.pad(const, (0, pad), constant_values=_NEG_INF)
+    return msc, ivar, const[None, :]
+
+
+def _pick_tile(p_pad: int) -> int:
+    for tile in (512, 256, 128):
+        if p_pad % tile == 0:
+            return tile
+    return p_pad
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
+)
+def score_pool(
+    feat: jax.Array,
+    means: jax.Array,
+    sigmas: jax.Array,
+    t_levels: int,
+    eps: float = DEFAULT_SIGMA_EPS,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused density + top-T pool.
+
+    Args:
+      feat:   [B, HW, d] f32 patch features (already L2-normalized).
+      means:  [..., d] prototype means (leading shape flattens to P).
+      sigmas: [..., d] prototype stds.
+      t_levels: T mining levels.
+    Returns:
+      (vals [B, P, T] f32 top-T log-densities sorted desc,
+       idx  [B, P, T] int32 flat spatial indices). Gradients flow to `feat`
+      only (prototypes are EM-trained constants here, model.py:264-265).
+    """
+    vals, idx = _score_pool_fwd_impl(feat, means, sigmas, t_levels, eps, interpret)
+    return vals, idx
+
+
+def _score_pool_fwd_impl(feat, means, sigmas, t_levels, eps, interpret):
+    b, hw, d = feat.shape
+    p = means.size // d
+    p_pad = _round_up(p, 128)
+    t_pad = _round_up(t_levels, 8)
+    tile = _pick_tile(p_pad)
+    msc, ivar, const = _prepare(means, sigmas, eps, p_pad)
+    feat = feat.astype(jnp.float32)
+
+    grid = (b, p_pad // tile)
+    vals, idx = pl.pallas_call(
+        functools.partial(_fwd_kernel, t_levels=t_levels),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, hw, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((tile, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((tile, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, tile), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, t_pad, tile), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, t_pad, tile), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t_pad, p_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, t_pad, p_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(feat, msc, ivar, const)
+    # [B, Tpad, Ppad] -> [B, P, T]
+    vals = jnp.swapaxes(vals[:, :t_levels, :p], 1, 2)
+    idx = jnp.swapaxes(idx[:, :t_levels, :p], 1, 2)
+    return vals, idx
+
+
+def _score_pool_fwd(feat, means, sigmas, t_levels, eps, interpret):
+    vals, idx = _score_pool_fwd_impl(feat, means, sigmas, t_levels, eps, interpret)
+    return (vals, idx), (feat, means, sigmas, idx)
+
+
+def _score_pool_bwd(t_levels, eps, interpret, res, cts):
+    feat, means, sigmas, idx = res
+    g_vals, _ = cts  # idx output is integer: no cotangent
+    b, hw, d = feat.shape
+    p = means.size // d
+    p_pad = _round_up(p, 128)
+    t_pad = _round_up(t_levels, 8)
+    tile = _pick_tile(p_pad)
+    msc, ivar, _ = _prepare(means, sigmas, eps, p_pad)
+    feat32 = feat.astype(jnp.float32)
+
+    # [B, P, T] -> [B, Tpad, Ppad]; padded g is 0 so padded slots are inert
+    g = jnp.swapaxes(g_vals.astype(jnp.float32), 1, 2)
+    g = jnp.pad(g, ((0, 0), (0, t_pad - t_levels), (0, p_pad - p)))
+    ix = jnp.swapaxes(idx, 1, 2)
+    ix = jnp.pad(ix, ((0, 0), (0, t_pad - t_levels), (0, p_pad - p)),
+                 constant_values=-1)
+
+    grid = (b, p_pad // tile)
+    grad_feat = pl.pallas_call(
+        functools.partial(_bwd_kernel, t_levels=t_levels),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, t_pad, tile), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, t_pad, tile), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, hw, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((tile, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((tile, d), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hw, d), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hw, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((hw, d), jnp.float32),
+            pltpu.VMEM((hw, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(g, ix, feat32, msc, ivar)
+    return (
+        grad_feat.astype(feat.dtype),
+        jnp.zeros_like(means),
+        jnp.zeros_like(sigmas),
+    )
+
+
+score_pool.defvjp(_score_pool_fwd, _score_pool_bwd)

@@ -24,12 +24,40 @@ import jax.numpy as jnp
 
 _LOG_2PI = math.log(2.0 * math.pi)
 
+# sigma regularizer shared by every density evaluation (reference model.py:272
+# uses sigma + 0 in compute_log_prob and sigma + 1e-10 in the EM path; both are
+# the identity at f32 for sigma ~ 0.4). The fused Pallas kernel
+# (ops/fused_scoring.py) uses the same precompute so the paths cannot desync.
+DEFAULT_SIGMA_EPS = 1e-10
+
+
+def precompute_diag_gaussian(means: jax.Array, sigmas: jax.Array, eps: float):
+    """Shared precompute for the quadratic expansion.
+
+    Flattens [..., d] prototypes to [P, d] and returns
+      (m_scaled [P, d] = mu / sigma^2,
+       inv_var  [P, d] = 1 / sigma^2,
+       const    [P]    = -d/2 log(2pi) - sum log sigma - 1/2 mu.(mu/sigma^2))
+    so that  log N(x) = const + x @ m_scaled.T - 1/2 (x*x) @ inv_var.T.
+    """
+    d = means.shape[-1]
+    m = means.astype(jnp.float32).reshape(-1, d)
+    s = (sigmas.astype(jnp.float32) + eps).reshape(-1, d)
+    inv_var = 1.0 / (s * s)
+    m_scaled = m * inv_var
+    const = (
+        -0.5 * d * _LOG_2PI
+        - jnp.sum(jnp.log(s), axis=-1)
+        - 0.5 * jnp.sum(m * m_scaled, axis=-1)
+    )
+    return m_scaled, inv_var, const
+
 
 def diag_gaussian_log_prob(
     x: jax.Array,
     means: jax.Array,
     sigmas: jax.Array,
-    eps: float = 1e-10,
+    eps: float = DEFAULT_SIGMA_EPS,
 ) -> jax.Array:
     """Per-sample log-density under every diagonal Gaussian prototype.
 
@@ -50,14 +78,7 @@ def diag_gaussian_log_prob(
     """
     x = x.astype(jnp.float32)
     lead = means.shape[:-1]
-    d = x.shape[-1]
-    m = means.astype(jnp.float32).reshape(-1, d)  # [P, d]
-    s = (sigmas.astype(jnp.float32) + eps).reshape(-1, d)  # [P, d]
-
-    inv_var = 1.0 / (s * s)  # [P, d]
-    log_det = jnp.sum(jnp.log(s), axis=-1)  # [P]
-    m_scaled = m * inv_var  # [P, d]
-    m_quad = jnp.sum(m * m_scaled, axis=-1)  # [P]
+    m_scaled, inv_var, const = precompute_diag_gaussian(means, sigmas, eps)
 
     # Precision.HIGHEST: keep the MXU passes at full f32 — default TPU matmul
     # precision truncates inputs to bf16, and the quadratic expansion is
@@ -68,9 +89,7 @@ def diag_gaussian_log_prob(
     cross = jnp.matmul(
         x, m_scaled.T, precision=jax.lax.Precision.HIGHEST
     )  # [N, P]  <- MXU
-    sq_maha = x_quad - 2.0 * cross + m_quad[None, :]
-
-    out = -0.5 * d * _LOG_2PI - log_det[None, :] - 0.5 * sq_maha
+    out = const[None, :] + cross - 0.5 * x_quad
     return out.reshape(x.shape[0], *lead)
 
 

@@ -1,0 +1,131 @@
+"""Fused Pallas scoring kernel vs the unfused XLA path (interpret mode on CPU).
+
+The fused kernel must reproduce ops/gaussian.py + ops/pooling.py exactly:
+same top-T values, same indices (incl. lowest-index tie-breaks), and the same
+feature gradient as differentiating through the unfused density + top_k."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mgproto_tpu.ops.fused_scoring import score_pool
+from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob
+
+B, HW, D, C, K, T = 3, 49, 16, 5, 4, 6
+
+
+def _setup(seed=0):
+    rng = np.random.default_rng(seed)
+    feat = jnp.asarray(rng.normal(size=(B, HW, D)).astype(np.float32))
+    feat = feat / jnp.linalg.norm(feat, axis=-1, keepdims=True)
+    means = jnp.asarray(rng.normal(size=(C, K, D)).astype(np.float32))
+    sigmas = jnp.full((C, K, D), 0.4, jnp.float32)
+    return feat, means, sigmas
+
+
+def _unfused(feat, means, sigmas):
+    lp = diag_gaussian_log_prob(feat.reshape(-1, D), means, sigmas)
+    lp = lp.reshape(B, HW, C * K).transpose(0, 2, 1)  # [B, P, HW]
+    vals, idx = jax.lax.top_k(lp, T)
+    return vals, idx
+
+
+def test_forward_matches_unfused():
+    feat, means, sigmas = _setup()
+    vals_f, idx_f = score_pool(feat, means, sigmas, T, 1e-10, True)
+    vals_u, idx_u = _unfused(feat, means, sigmas)
+    np.testing.assert_allclose(np.asarray(vals_f), np.asarray(vals_u), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(idx_f), np.asarray(idx_u))
+
+
+def test_forward_tie_break_lowest_index():
+    # identical patches -> tied densities; both paths must pick low indices
+    feat = jnp.ones((1, 8, D), jnp.float32) / np.sqrt(D)
+    rng = np.random.default_rng(1)
+    means = jnp.asarray(rng.normal(size=(1, 2, D)).astype(np.float32))
+    sigmas = jnp.full((1, 2, D), 0.4, jnp.float32)
+    _, idx = score_pool(feat, means, sigmas, 3, 1e-10, True)
+    np.testing.assert_array_equal(np.asarray(idx[0, :, :]), [[0, 1, 2], [0, 1, 2]])
+
+
+def test_gradient_matches_unfused():
+    feat, means, sigmas = _setup(2)
+    w = jnp.asarray(
+        np.random.default_rng(3).normal(size=(B, C * K, T)).astype(np.float32)
+    )
+
+    def loss_fused(f):
+        vals, _ = score_pool(f, means, sigmas, T, 1e-10, True)
+        return jnp.sum(vals * w)
+
+    def loss_unfused(f):
+        vals, _ = _unfused(f, means, sigmas)
+        return jnp.sum(vals * w)
+
+    gf = jax.grad(loss_fused)(feat)
+    gu = jax.grad(loss_unfused)(feat)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gu), rtol=1e-4, atol=1e-5)
+
+
+def test_prototype_gradients_are_zero():
+    """The kernel's contract: prototypes are EM-trained constants
+    (reference model.py:264-265 detaches them in compute_log_prob)."""
+    feat, means, sigmas = _setup(4)
+
+    def loss(m, s):
+        vals, _ = score_pool(feat, m, s, T, 1e-10, True)
+        return jnp.sum(vals)
+
+    gm, gs = jax.grad(loss, argnums=(0, 1))(means, sigmas)
+    assert float(jnp.abs(gm).max()) == 0.0
+    assert float(jnp.abs(gs).max()) == 0.0
+
+
+def test_padding_is_inert():
+    """P not a multiple of the tile and T not a multiple of 8: padded slots
+    must never leak into results."""
+    rng = np.random.default_rng(5)
+    feat = jnp.asarray(rng.normal(size=(2, 10, 8)).astype(np.float32))
+    means = jnp.asarray(rng.normal(size=(3, 1, 8)).astype(np.float32))  # P=3
+    sigmas = jnp.full((3, 1, 8), 0.4, jnp.float32)
+    vals, idx = score_pool(feat, means, sigmas, 5, 1e-10, True)
+    assert vals.shape == (2, 3, 5) and idx.shape == (2, 3, 5)
+    assert np.all(np.isfinite(np.asarray(vals)))
+    assert np.asarray(idx).min() >= 0 and np.asarray(idx).max() < 10
+
+
+def test_train_step_fused_matches_unfused():
+    """End-to-end: one Trainer step with fused_scoring on/off must agree."""
+    import dataclasses
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+
+    def run(fused):
+        cfg = tiny_test_config()
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, fused_scoring=fused)
+        )
+        tr = Trainer(cfg, steps_per_epoch=2)
+        st = tr.init_state(jax.random.PRNGKey(0))
+        imgs = jax.random.uniform(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        lbls = jnp.array([0, 1, 2, 3])
+        st, m = tr.train_step(st, imgs, lbls, use_mine=True, update_gmm=True)
+        return st, m
+
+    s0, m0 = run(False)
+    s1, m1 = run(True)
+    np.testing.assert_allclose(float(m1.loss), float(m0.loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s1.gmm.means), np.asarray(s0.gmm.means), rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s1.memory.length), np.asarray(s0.memory.length)
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s0.params), jax.tree_util.tree_leaves(s1.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
