@@ -19,6 +19,42 @@ import os
 from typing import Dict, List, Tuple
 
 
+def read_images_txt(root: str) -> List[Tuple[int, str]]:
+    """Raw images.txt rows: (img_id, 'class_folder/file.jpg'). Single parser
+    for every consumer (parts tables here, offline crops in data/prep.py)."""
+    out: List[Tuple[int, str]] = []
+    with open(os.path.join(root, "images.txt")) as f:
+        for line in f:
+            if line.strip():
+                sid, path = line.split(" ", 1)
+                out.append((int(sid), path.strip()))
+    return out
+
+
+def read_bounding_boxes(root: str) -> Dict[int, Tuple[float, float, float, float]]:
+    """Raw bounding_boxes.txt: img_id -> (x, y, w, h) FLOATS as stored on
+    disk. Consumers apply their own rounding (CubParts truncates to int per
+    reference local_parts.py:33-40; crops keep floats)."""
+    out: Dict[int, Tuple[float, float, float, float]] = {}
+    with open(os.path.join(root, "bounding_boxes.txt")) as f:
+        for line in f:
+            if line.strip():
+                sid, x, y, w, h = line.split()
+                out[int(sid)] = (float(x), float(y), float(w), float(h))
+    return out
+
+
+def read_train_test_split(root: str) -> Dict[int, int]:
+    """train_test_split.txt: img_id -> 1 (train) | 0 (test)."""
+    out: Dict[int, int] = {}
+    with open(os.path.join(root, "train_test_split.txt")) as f:
+        for line in f:
+            if line.strip():
+                sid, is_train = line.split()
+                out[int(sid)] = int(is_train)
+    return out
+
+
 def in_bbox(loc_yx: Tuple[int, int], bbox_yyxx: Tuple[int, int, int, int]) -> bool:
     """Is (y, x) inside (y1, y2, x1, x2)? (reference local_parts.py:10-11)."""
     y, x = loc_yx
@@ -35,20 +71,16 @@ class CubParts:
         self.root = os.path.expanduser(root)
 
         self.id_to_path: Dict[int, Tuple[str, str]] = {}
-        with open(os.path.join(self.root, "images.txt")) as f:
-            for line in f:
-                sid, path = line.split(" ", 1)
-                folder, name = path.strip().split("/", 1)
-                self.id_to_path[int(sid)] = (folder, name)
+        for sid, path in read_images_txt(self.root):
+            folder, name = path.split("/", 1)
+            self.id_to_path[sid] = (folder, name)
 
         # bbox floats truncated to int, x2/y2 = x+w, y+h
         # (reference local_parts.py:33-40)
         self.id_to_bbox: Dict[int, Tuple[int, int, int, int]] = {}
-        with open(os.path.join(self.root, "bounding_boxes.txt")) as f:
-            for line in f:
-                sid, x, y, w, h = line.split()
-                x, y, w, h = (int(float(v)) for v in (x, y, w, h))
-                self.id_to_bbox[int(sid)] = (x, y, x + w, y + h)
+        for sid, (x, y, w, h) in read_bounding_boxes(self.root).items():
+            x, y, w, h = int(x), int(y), int(w), int(h)
+            self.id_to_bbox[sid] = (x, y, x + w, y + h)
 
         self.cls_to_id: Dict[int, List[int]] = {}
         with open(os.path.join(self.root, "image_class_labels.txt")) as f:
@@ -56,11 +88,9 @@ class CubParts:
                 sid, cls = line.split()
                 self.cls_to_id.setdefault(int(cls) - 1, []).append(int(sid))
 
-        self.id_to_train: Dict[int, int] = {}
-        with open(os.path.join(self.root, "train_test_split.txt")) as f:
-            for line in f:
-                sid, is_train = line.split()
-                self.id_to_train[int(sid)] = int(is_train)
+        self.id_to_train: Dict[int, int] = dict(
+            read_train_test_split(self.root)
+        )
 
         self.part_id_to_part: Dict[int, str] = {}
         with open(os.path.join(self.root, "parts", "parts.txt")) as f:

@@ -32,16 +32,17 @@ def _read_table(path: str) -> List[List[str]]:
 
 # ------------------------------------------------------------------ CUB crop
 def _load_cub_index(cub_root: str):
-    """(names rows, img_id -> bbox, img_id -> is_train) from the CUB txts."""
-    names = _read_table(os.path.join(cub_root, "images.txt"))
-    boxes = {
-        int(r[0]): tuple(float(v) for v in r[1:5])
-        for r in _read_table(os.path.join(cub_root, "bounding_boxes.txt"))
-    }
-    split = {
-        int(r[0]): int(r[1])
-        for r in _read_table(os.path.join(cub_root, "train_test_split.txt"))
-    }
+    """(names rows, img_id -> float bbox, img_id -> is_train) from the CUB
+    txts — one shared parser with the parts tables (data/cub_parts.py)."""
+    from mgproto_tpu.data.cub_parts import (
+        read_bounding_boxes,
+        read_images_txt,
+        read_train_test_split,
+    )
+
+    names = [[str(sid), path] for sid, path in read_images_txt(cub_root)]
+    boxes = read_bounding_boxes(cub_root)
+    split = read_train_test_split(cub_root)
     return names, boxes, split
 
 
