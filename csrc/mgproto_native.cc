@@ -1,0 +1,90 @@
+// Native host-side batch-assembly kernels for the mgproto-tpu input pipeline.
+//
+// The reference's data layer decodes and converts every image on the Python
+// main thread (reference main.py:94 num_workers=0; SURVEY.md §7.3.6
+// "bottleneck-by-neglect"). Our loader already overlaps PIL decode on a
+// thread pool; this library removes the remaining per-image Python cost: the
+// uint8 HWC -> normalized float32 conversion, which in numpy is four
+// GIL-dispatched array passes ((x/255 - mean)/std) per image. Here it is one
+// fused pass using three 256-entry per-channel lookup tables, plus a
+// std::thread-parallel batched variant for whole-batch assembly.
+//
+// Exposed via ctypes (no pybind11 in the image); see mgproto_tpu/native.
+
+#include <cstddef>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Build per-channel LUTs: lut[c][v] = v * scale[c] + bias[c].
+// With scale = 1/(255*std) and bias = -mean/std this is exactly
+// (v/255 - mean)/std up to f32 rounding.
+inline void build_luts(const float* scale, const float* bias, float lut[3][256]) {
+  for (int c = 0; c < 3; ++c) {
+    for (int v = 0; v < 256; ++v) {
+      lut[c][v] = static_cast<float>(v) * scale[c] + bias[c];
+    }
+  }
+}
+
+inline void convert_px(const uint8_t* src, int64_t n_px,
+                       const float lut[3][256], float* out) {
+  for (int64_t i = 0; i < n_px; ++i) {
+    const uint8_t* p = src + 3 * i;
+    float* q = out + 3 * i;
+    q[0] = lut[0][p[0]];
+    q[1] = lut[1][p[1]];
+    q[2] = lut[2][p[2]];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fused (u8/255 - mean)/std for one [n_px, 3] interleaved HWC image.
+// scale[3] = 1/(255*std), bias[3] = -mean/std (precomputed by the caller).
+void mg_u8hwc_to_f32_norm(const uint8_t* src, int64_t n_px, const float* scale,
+                          const float* bias, float* out) {
+  float lut[3][256];
+  build_luts(scale, bias, lut);
+  convert_px(src, n_px, lut, out);
+}
+
+// Plain u8 -> f32 in [0, 1] (the push pipeline is unnormalized,
+// reference main.py:111-116).
+void mg_u8hwc_to_f32(const uint8_t* src, int64_t n, float* out) {
+  float lut[256];
+  for (int v = 0; v < 256; ++v) lut[v] = static_cast<float>(v) * (1.0f / 255.0f);
+  for (int64_t i = 0; i < n; ++i) out[i] = lut[src[i]];
+}
+
+// Batched, threaded variant: b images of identical [n_px, 3] shape from
+// independent buffers into one contiguous [b, n_px, 3] f32 output.
+void mg_batch_u8hwc_to_f32_norm(const uint8_t* const* srcs, int32_t b,
+                                int64_t n_px, const float* scale,
+                                const float* bias, float* out,
+                                int32_t nthreads) {
+  float lut[3][256];
+  build_luts(scale, bias, lut);
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > b) nthreads = b;
+  if (nthreads == 1) {
+    for (int32_t i = 0; i < b; ++i)
+      convert_px(srcs[i], n_px, lut, out + 3 * n_px * i);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int32_t t = 0; t < nthreads; ++t) {
+    threads.emplace_back([=, &lut]() {
+      for (int32_t i = t; i < b; i += nthreads)
+        convert_px(srcs[i], n_px, lut, out + 3 * n_px * i);
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

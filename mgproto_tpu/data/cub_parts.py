@@ -88,9 +88,7 @@ class CubParts:
                 sid, cls = line.split()
                 self.cls_to_id.setdefault(int(cls) - 1, []).append(int(sid))
 
-        self.id_to_train: Dict[int, int] = dict(
-            read_train_test_split(self.root)
-        )
+        self.id_to_train: Dict[int, int] = read_train_test_split(self.root)
 
         self.part_id_to_part: Dict[int, str] = {}
         with open(os.path.join(self.root, "parts", "parts.txt")) as f:

@@ -25,25 +25,22 @@ BICUBIC = Image.Resampling.BICUBIC
 BILINEAR = Image.Resampling.BILINEAR
 
 
-def _read_table(path: str) -> List[List[str]]:
-    with open(path) as f:
-        return [line.split() for line in f if line.strip()]
-
-
 # ------------------------------------------------------------------ CUB crop
 def _load_cub_index(cub_root: str):
-    """(names rows, img_id -> float bbox, img_id -> is_train) from the CUB
-    txts — one shared parser with the parts tables (data/cub_parts.py)."""
+    """([(img_id, rel_path)...], img_id -> float bbox, img_id -> is_train)
+    from the CUB txts — one shared parser with the parts tables
+    (data/cub_parts.py)."""
     from mgproto_tpu.data.cub_parts import (
         read_bounding_boxes,
         read_images_txt,
         read_train_test_split,
     )
 
-    names = [[str(sid), path] for sid, path in read_images_txt(cub_root)]
-    boxes = read_bounding_boxes(cub_root)
-    split = read_train_test_split(cub_root)
-    return names, boxes, split
+    return (
+        read_images_txt(cub_root),
+        read_bounding_boxes(cub_root),
+        read_train_test_split(cub_root),
+    )
 
 
 def crop_cub(
@@ -54,8 +51,7 @@ def crop_cub(
     (n_train, n_test)."""
     names, boxes, split = _load_cub_index(cub_root)
     counts = [0, 0]
-    for row in names[: limit if limit else len(names)]:
-        img_id, rel = int(row[0]), row[1]
+    for img_id, rel in names[: limit if limit else len(names)]:
         x, y, w, h = boxes[img_id]
         dest = "train_cropped" if split[img_id] == 1 else "test_cropped"
         out_path = os.path.join(out_root, dest, rel)
@@ -73,8 +69,7 @@ def crop_cub_masks(
     class trees (reference cropmasks.py, non-destructive)."""
     names, boxes, split = _load_cub_index(cub_root)
     n = 0
-    for row in names[: limit if limit else len(names)]:
-        img_id, rel = int(row[0]), row[1]
+    for img_id, rel in names[: limit if limit else len(names)]:
         mask_rel = rel.rsplit(".", 1)[0] + ".png"
         x, y, w, h = boxes[img_id]
         dest = "mask_train" if split[img_id] == 1 else "mask_test"

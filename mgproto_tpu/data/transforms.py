@@ -23,6 +23,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 from PIL import Image, ImageEnhance
 
+from mgproto_tpu import native
 from mgproto_tpu.utils.images import IMAGENET_MEAN, IMAGENET_STD
 
 BILINEAR = Image.BILINEAR
@@ -60,6 +61,19 @@ def to_array(img: Image.Image) -> np.ndarray:
 
 def normalize(x: np.ndarray) -> np.ndarray:
     return (x - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def _to_norm_f32(img: Image.Image) -> np.ndarray:
+    """PIL -> normalized f32 HWC: fused native LUT pass when the C++ library
+    is built (mgproto_tpu/native), numpy (x/255 - mean)/std otherwise."""
+    a = np.asarray(img.convert("RGB"), np.uint8)
+    return native.u8_to_f32_norm(a, IMAGENET_MEAN, IMAGENET_STD)
+
+
+def _to_f32(img: Image.Image) -> np.ndarray:
+    """PIL -> f32 HWC in [0, 1] (push pipeline stays unnormalized)."""
+    a = np.asarray(img.convert("RGB"), np.uint8)
+    return native.u8_to_f32(a)
 
 
 # ------------------------------------------------------------------- random
@@ -274,7 +288,7 @@ def train_transform(img_size: int) -> Transform:
         img = random_horizontal_flip(img, rng)
         img = random_affine(img, rng)
         img = random_resized_crop(img, rng, img_size)
-        return normalize(to_array(img))
+        return _to_norm_f32(img)
 
     return apply
 
@@ -283,7 +297,7 @@ def push_transform(img_size: int) -> Transform:
     """Resize-only, UNNORMALIZED (main.py:111-116)."""
 
     def apply(img: Image.Image, rng=None) -> np.ndarray:
-        return to_array(resize(img, (img_size, img_size)))
+        return _to_f32(resize(img, (img_size, img_size)))
 
     return apply
 
@@ -292,7 +306,7 @@ def test_transform(img_size: int) -> Transform:
     """Resize(shorter=img+32) + CenterCrop (main.py:128-135)."""
 
     def apply(img: Image.Image, rng=None) -> np.ndarray:
-        return normalize(to_array(center_crop(resize(img, img_size + 32), img_size)))
+        return _to_norm_f32(center_crop(resize(img, img_size + 32), img_size))
 
     return apply
 
@@ -301,6 +315,6 @@ def ood_transform(img_size: int) -> Transform:
     """Exact-resize + normalize (main.py:141-163)."""
 
     def apply(img: Image.Image, rng=None) -> np.ndarray:
-        return normalize(to_array(resize(img, (img_size, img_size))))
+        return _to_norm_f32(resize(img, (img_size, img_size)))
 
     return apply

@@ -1,0 +1,163 @@
+"""ctypes bindings for the native host-pipeline kernels (csrc/mgproto_native.cc).
+
+Auto-builds `libmgproto_native.so` with g++ on first use (cached next to this
+file); every entry point has a pure-numpy fallback so the package works
+without a toolchain. Disable with MGPROTO_NATIVE=0.
+
+The kernels fuse the per-image uint8 HWC -> normalized f32 conversion of the
+input pipeline (reference ToTensor+Normalize, main.py:98-135) into a single
+LUT pass — see csrc/mgproto_native.cc for why this is native.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_LIB_NAME = "libmgproto_native.so"
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, os.pardir, os.pardir, "csrc", "mgproto_native.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build(lib_path: str) -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    # compile to a pid-suffixed temp path and rename into place atomically:
+    # concurrent first-builds (loader workers, pytest-xdist) must never leave
+    # a half-written .so that poisons every later load
+    tmp_path = f"{lib_path}.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        src, "-o", tmp_path,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp_path, lib_path)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MGPROTO_NATIVE", "1") == "0":
+            return None
+        lib_path = os.path.join(_HERE, _LIB_NAME)
+        if not os.path.exists(lib_path) and not _build(lib_path):
+            return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.mg_u8hwc_to_f32_norm.argtypes = [
+            u8p, ctypes.c_int64, f32p, f32p, f32p
+        ]
+        lib.mg_u8hwc_to_f32.argtypes = [u8p, ctypes.c_int64, f32p]
+        lib.mg_batch_u8hwc_to_f32_norm.argtypes = [
+            ctypes.POINTER(u8p), ctypes.c_int32, ctypes.c_int64,
+            f32p, f32p, f32p, ctypes.c_int32,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _scale_bias(mean: np.ndarray, std: np.ndarray):
+    mean = np.asarray(mean, np.float32).reshape(3)
+    std = np.asarray(std, np.float32).reshape(3)
+    scale = (1.0 / (255.0 * std)).astype(np.float32)
+    bias = (-mean / std).astype(np.float32)
+    return scale, bias
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def u8_to_f32_norm(
+    img: np.ndarray, mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """[H, W, 3] u8 -> (x/255 - mean)/std f32, one fused native pass
+    (numpy fallback when the library is unavailable)."""
+    lib = _load()
+    img = np.ascontiguousarray(img, np.uint8)
+    if lib is None or img.ndim != 3 or img.shape[-1] != 3:
+        x = img.astype(np.float32) / 255.0
+        return ((x - np.asarray(mean, np.float32))
+                / np.asarray(std, np.float32)).astype(np.float32)
+    scale, bias = _scale_bias(mean, std)
+    out = np.empty(img.shape, np.float32)
+    lib.mg_u8hwc_to_f32_norm(
+        _u8p(img), img.shape[0] * img.shape[1], _f32p(scale), _f32p(bias),
+        _f32p(out),
+    )
+    return out
+
+
+def u8_to_f32(img: np.ndarray) -> np.ndarray:
+    """[...] u8 -> f32 in [0, 1]."""
+    lib = _load()
+    img = np.ascontiguousarray(img, np.uint8)
+    if lib is None:
+        return img.astype(np.float32) / 255.0
+    out = np.empty(img.shape, np.float32)
+    lib.mg_u8hwc_to_f32(_u8p(img), img.size, _f32p(out))
+    return out
+
+
+def batch_u8_to_f32_norm(
+    imgs: List[np.ndarray],
+    mean: np.ndarray,
+    std: np.ndarray,
+    nthreads: int = 0,
+) -> np.ndarray:
+    """Stack + convert + normalize a batch of same-shape [H, W, 3] u8 images
+    into one [B, H, W, 3] f32 array, threaded in native code."""
+    lib = _load()
+    shapes_ok = (
+        len(imgs) > 0
+        and all(i.ndim == 3 and i.shape == imgs[0].shape for i in imgs)
+        and imgs[0].shape[-1] == 3
+    )
+    if lib is None or not shapes_ok:
+        return np.stack([u8_to_f32_norm(i, mean, std) for i in imgs])
+    imgs = [np.ascontiguousarray(i, np.uint8) for i in imgs]
+    h, w, _ = imgs[0].shape
+    b = len(imgs)
+    out = np.empty((b, h, w, 3), np.float32)
+    scale, bias = _scale_bias(mean, std)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    ptrs = (u8p * b)(*[_u8p(i) for i in imgs])
+    if nthreads <= 0:
+        nthreads = min(b, os.cpu_count() or 1)
+    lib.mg_batch_u8hwc_to_f32_norm(
+        ptrs, b, h * w, _f32p(scale), _f32p(bias), _f32p(out), nthreads
+    )
+    return out

@@ -1,0 +1,72 @@
+"""Native C++ host-pipeline kernels (csrc/mgproto_native.cc) vs numpy.
+
+The native path must be bit-compatible with the numpy fallback to f32
+tolerance, build transparently via g++, and degrade gracefully when disabled.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mgproto_tpu import native
+from mgproto_tpu.utils.images import IMAGENET_MEAN, IMAGENET_STD
+
+
+def _ref_norm(img):
+    x = img.astype(np.float32) / 255.0
+    return (x - IMAGENET_MEAN.astype(np.float32)) / IMAGENET_STD.astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def img():
+    rng = np.random.default_rng(0)
+    return rng.integers(0, 256, size=(37, 53, 3), dtype=np.uint8)
+
+
+def test_builds_and_loads():
+    assert native.available(), "g++ build of the native library failed"
+
+
+def test_u8_to_f32_norm_matches_numpy(img):
+    out = native.u8_to_f32_norm(img, IMAGENET_MEAN, IMAGENET_STD)
+    np.testing.assert_allclose(out, _ref_norm(img), rtol=0, atol=1e-5)
+    assert out.dtype == np.float32
+
+
+def test_u8_to_f32_matches_numpy(img):
+    out = native.u8_to_f32(img)
+    np.testing.assert_allclose(out, img.astype(np.float32) / 255.0, atol=1e-7)
+
+
+def test_batch_threaded_matches_numpy():
+    rng = np.random.default_rng(1)
+    imgs = [
+        rng.integers(0, 256, size=(16, 24, 3), dtype=np.uint8) for _ in range(7)
+    ]
+    out = native.batch_u8_to_f32_norm(imgs, IMAGENET_MEAN, IMAGENET_STD, nthreads=3)
+    ref = np.stack([_ref_norm(i) for i in imgs])
+    assert out.shape == (7, 16, 24, 3)
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
+
+
+def test_non_contiguous_input(img):
+    flipped = img[:, ::-1]  # negative stride view
+    out = native.u8_to_f32_norm(flipped, IMAGENET_MEAN, IMAGENET_STD)
+    np.testing.assert_allclose(out, _ref_norm(np.ascontiguousarray(flipped)),
+                               atol=1e-5)
+
+
+def test_transforms_use_native_and_match_reference_semantics(img):
+    """test_transform output must equal Resize->CenterCrop->(x/255-m)/s."""
+    from PIL import Image
+
+    from mgproto_tpu.data import transforms as T
+
+    pil = Image.fromarray(
+        np.random.default_rng(2).integers(0, 256, (70, 90, 3), dtype=np.uint8)
+    )
+    out = T.test_transform(32)(pil)
+    ref = T.normalize(T.to_array(T.center_crop(T.resize(pil, 64), 32)))
+    np.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
+    assert out.shape == (32, 32, 3)
