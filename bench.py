@@ -46,7 +46,7 @@ def main() -> None:
             compute_dtype="bfloat16",
         )
     )
-    trainer = Trainer(cfg, steps_per_epoch=100)
+    trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
     # steady state: all class queues full + touched, so EM is fully active

@@ -70,6 +70,9 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num_workers", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
     # runtime
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host: jax.distributed.initialize() before "
+                        "device use (TPU pods auto-detect coordinator)")
     p.add_argument("--mesh_data", type=int, default=-1,
                    help="data-axis size (-1 = all devices)")
     p.add_argument("--mesh_model", type=int, default=1)

@@ -31,6 +31,12 @@ def main(argv: Optional[list] = None) -> None:
         help="checkpoint path ('auto' = latest in --model_dir)",
     )
     args = p.parse_args(argv)
+    if getattr(args, "distributed", False):
+        # before any other jax call (parallel/mesh.py docstring); strict:
+        # an explicitly requested multi-host run must fail loudly
+        from mgproto_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed(strict=True)
     cfg = config_from_args(args)
 
     _, _, test_loader, ood_loaders = build_pipelines(cfg)

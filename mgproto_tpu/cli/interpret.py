@@ -49,6 +49,12 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--purity_half_size", type=int, default=16)
     p.add_argument("--purity_top_k", type=int, default=10)
     args = p.parse_args(argv)
+    if getattr(args, "distributed", False):
+        # before any other jax call (parallel/mesh.py docstring); strict:
+        # an explicitly requested multi-host run must fail loudly
+        from mgproto_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed(strict=True)
     cfg = config_from_args(args)
 
     parts = CubParts(args.cub_root)

@@ -71,7 +71,7 @@ def run_training(
     log(describe(cfg))
     train_loader, push_loader, test_loader, ood_loaders = build_pipelines(cfg)
     steps_per_epoch = len(train_loader)
-    trainer = ShardedTrainer(cfg, steps_per_epoch)
+    trainer = ShardedTrainer(cfg, steps_per_epoch, donate=True)
     log(f"devices: {jax.device_count()}  mesh: {dict(trainer.mesh.shape)}")
     log(f"steps/epoch: {steps_per_epoch}")
 
@@ -178,6 +178,12 @@ def main(argv: Optional[list] = None) -> None:
     )
     add_train_args(p)
     args = p.parse_args(argv)
+    if args.distributed:
+        # before any other jax call (parallel/mesh.py docstring); strict:
+        # an explicitly requested multi-host run must fail loudly
+        from mgproto_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed(strict=True)
     cfg = config_from_args(args)
     run_training(
         cfg,

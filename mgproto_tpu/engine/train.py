@@ -59,14 +59,24 @@ class Trainer:
 
     All state flows through `TrainState`; nothing here mutates."""
 
-    def __init__(self, cfg: Config, steps_per_epoch: int):
+    def __init__(self, cfg: Config, steps_per_epoch: int, donate: bool = False):
         self.cfg = cfg
         self.steps_per_epoch = steps_per_epoch
+        self.donate = donate
         self.model = MGProtoFeatures(cfg=cfg.model)
         self.joint_tx = make_joint_optimizer(cfg, steps_per_epoch)
         self.warm_tx = make_warm_optimizer(cfg)
         self.proto_tx = make_mean_optimizer(cfg.em)
-        self._train_step = jax.jit(self._step, static_argnames=("warm",))
+        # donate=True reuses the incoming state's buffers (params + opt
+        # moments + memory bank, ~300 MB at flagship scale) in place instead
+        # of copying each step. The production drivers (cli.train, bench.py)
+        # enable it and always rebind `state` to the returned one; it stays
+        # off by default so interactive callers may re-step an old state.
+        self._train_step = jax.jit(
+            self._step,
+            static_argnames=("warm",),
+            donate_argnums=(0,) if donate else (),
+        )
         self._eval_step = jax.jit(self._eval)
 
     def init_state(self, rng: jax.Array) -> TrainState:

@@ -35,20 +35,24 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    strict: bool = False,
 ) -> None:
     """Multi-host bring-up (idempotent). Must run before any other jax call.
     On TPU pods all three arguments are auto-detected from the environment; on
     CPU/GPU clusters pass them explicitly. Replaces the reference's absent
     `torch.distributed` story.
 
-    With explicit arguments, failures propagate (a wrong coordinator address
-    must not silently fall back to single-host). With no arguments the call is
-    best-effort: on single-host environments with nothing to auto-detect it is
-    a no-op."""
+    With explicit arguments or strict=True, failures propagate (a worker
+    silently falling back to single-host would train a divergent model while
+    the rest of the pod hangs at the coordinator barrier). With no arguments
+    the call is best-effort: on single-host environments with nothing to
+    auto-detect it is a no-op."""
     global _distributed_initialized
     if _distributed_initialized:
         return
-    explicit = coordinator_address is not None or num_processes is not None
+    explicit = (
+        strict or coordinator_address is not None or num_processes is not None
+    )
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -50,12 +50,28 @@ class ShardedTrainer(Trainer):
     """
 
     def __init__(
-        self, cfg: Config, steps_per_epoch: int, mesh: Optional[Mesh] = None
+        self,
+        cfg: Config,
+        steps_per_epoch: int,
+        mesh: Optional[Mesh] = None,
+        donate: bool = False,
     ):
-        super().__init__(cfg, steps_per_epoch)
+        super().__init__(cfg, steps_per_epoch, donate=donate)
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.data, cfg.mesh.model
         )
+        n_data = self.mesh.shape["data"]
+        for name, b in (
+            ("train_batch_size", cfg.data.train_batch_size),
+            ("test_batch_size", cfg.data.test_batch_size),
+            ("train_push_batch_size", cfg.data.train_push_batch_size),
+        ):
+            if b % n_data != 0:
+                raise ValueError(
+                    f"data.{name}={b} must be divisible by the mesh data axis "
+                    f"({n_data} devices) so the batch shards evenly; adjust "
+                    "--batch_size or --mesh_data"
+                )
         self._repl = replicated(self.mesh)
         self._batch_sh = batch_sharding(self.mesh)
         self._state_sh = None  # built lazily from the first state seen
@@ -73,6 +89,7 @@ class ShardedTrainer(Trainer):
                 functools.partial(self._step, warm=w),
                 in_shardings=in_sh,
                 out_shardings=out_sh,
+                donate_argnums=(0,) if self.donate else (),
             )
             for w in (False, True)
         }

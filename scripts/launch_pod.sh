@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher (e.g. v5e-256): runs the same cli.train on every
+# worker; jax.distributed.initialize() auto-detects coordinator/process-id
+# from the TPU metadata (mgproto_tpu/parallel/mesh.py initialize_distributed),
+# and the global mesh spans all hosts' chips with the batch sharded over
+# 'data'. This is the multi-host story the reference lacks entirely
+# (SURVEY.md §2.3: single process, single GPU).
+#
+# Usage: scripts/launch_pod.sh <tpu-name> <zone> <data_root> [extra args...]
+# Requires: gcloud configured for the pod's project, code + data present on
+# every worker (or on a shared filesystem).
+set -euo pipefail
+
+TPU_NAME="${1:?usage: launch_pod.sh <tpu-name> <zone> <data_root> [args...]}"
+ZONE="${2:?zone}"
+DATA_ROOT="${3:?data_root}"
+shift 3 || true
+
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+
+# %q-quote every component so spaces/globs/quotes survive the remote shell's
+# re-parse on each worker
+REMOTE_CMD="$(printf '%q ' cd "$REPO_DIR")&& $(printf '%q ' \
+    python -m mgproto_tpu.cli.train \
+    --distributed \
+    --data_root "$DATA_ROOT" \
+    --model_dir ./saved_models-pod \
+    "$@")"
+
+exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command "$REMOTE_CMD"

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Single-host TPU VM launcher — the TPU-native equivalent of the reference's
+# Slurm batch script (reference run.sh:1-16: 1 node / 1 GPU / 10-day wall).
+# Run ON a TPU VM (e.g. v5e-8); all local chips form the data axis of the
+# mesh automatically (cfg.mesh.data = -1).
+#
+# Usage: scripts/launch_tpu.sh <data_root> [extra cli.train args...]
+# e.g.:  scripts/launch_tpu.sh /data/cub200_cropped --arch resnet34 \
+#            --dataset CUB --mem_sz 800 --mine_level 20
+set -euo pipefail
+
+DATA_ROOT="${1:?usage: launch_tpu.sh <data_root> [args...]}"
+shift || true
+
+cd "$(dirname "$0")/.."
+exec python -m mgproto_tpu.cli.train \
+    --data_root "$DATA_ROOT" \
+    --model_dir "./saved_models-$(date +%Y%m%d-%H%M%S)" \
+    "$@"
