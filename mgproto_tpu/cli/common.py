@@ -30,7 +30,21 @@ DATASET_PRESETS: Dict[str, Dict] = {
     "Cars": {"num_classes": 196, "sub": "stanford_cars_cropped"},
     "Dogs": {"num_classes": 120, "sub": "stanford_dogs"},
     "Pets": {"num_classes": 37, "sub": "oxford_pets"},
+    # stretch config (SURVEY.md §7.2.9): 1000-class density/EM/memory shard
+    # over the mesh's 'model' axis (--mesh_model), keeping per-chip density
+    # tiles and EM statistics local to each class shard
+    "ImageNet": {"num_classes": 1000, "sub": "imagenet"},
 }
+
+
+def maybe_init_distributed(args: argparse.Namespace) -> None:
+    """Honor --distributed before any other jax call (parallel/mesh.py
+    docstring); strict: an explicitly requested multi-host run must fail
+    loudly rather than silently degrade to single-host."""
+    if args.distributed:
+        from mgproto_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed(strict=True)
 
 
 def add_train_args(p: argparse.ArgumentParser) -> None:

@@ -13,7 +13,11 @@ from typing import Optional
 
 import jax
 
-from mgproto_tpu.cli.common import add_train_args, config_from_args
+from mgproto_tpu.cli.common import (
+    add_train_args,
+    config_from_args,
+    maybe_init_distributed,
+)
 from mgproto_tpu.cli.train import _test
 from mgproto_tpu.data import build_pipelines
 from mgproto_tpu.parallel import ShardedTrainer
@@ -31,12 +35,7 @@ def main(argv: Optional[list] = None) -> None:
         help="checkpoint path ('auto' = latest in --model_dir)",
     )
     args = p.parse_args(argv)
-    if getattr(args, "distributed", False):
-        # before any other jax call (parallel/mesh.py docstring); strict:
-        # an explicitly requested multi-host run must fail loudly
-        from mgproto_tpu.parallel.mesh import initialize_distributed
-
-        initialize_distributed(strict=True)
+    maybe_init_distributed(args)
     cfg = config_from_args(args)
 
     _, _, test_loader, ood_loaders = build_pipelines(cfg)

@@ -14,7 +14,11 @@ from typing import Optional
 
 import jax
 
-from mgproto_tpu.cli.common import add_train_args, config_from_args
+from mgproto_tpu.cli.common import (
+    add_train_args,
+    config_from_args,
+    maybe_init_distributed,
+)
 from mgproto_tpu.data import Cub2011Eval, DataLoader, ood_transform
 from mgproto_tpu.data.cub_parts import CubParts
 from mgproto_tpu.engine.interpretability import (
@@ -49,12 +53,7 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--purity_half_size", type=int, default=16)
     p.add_argument("--purity_top_k", type=int, default=10)
     args = p.parse_args(argv)
-    if getattr(args, "distributed", False):
-        # before any other jax call (parallel/mesh.py docstring); strict:
-        # an explicitly requested multi-host run must fail loudly
-        from mgproto_tpu.parallel.mesh import initialize_distributed
-
-        initialize_distributed(strict=True)
+    maybe_init_distributed(args)
     cfg = config_from_args(args)
 
     parts = CubParts(args.cub_root)

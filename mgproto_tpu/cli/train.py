@@ -21,7 +21,12 @@ from typing import Optional
 import jax
 import numpy as np
 
-from mgproto_tpu.cli.common import add_train_args, config_from_args, describe
+from mgproto_tpu.cli.common import (
+    add_train_args,
+    config_from_args,
+    describe,
+    maybe_init_distributed,
+)
 from mgproto_tpu.config import Config
 from mgproto_tpu.core.mgproto import prune_top_m
 from mgproto_tpu.data import build_pipelines
@@ -178,12 +183,7 @@ def main(argv: Optional[list] = None) -> None:
     )
     add_train_args(p)
     args = p.parse_args(argv)
-    if args.distributed:
-        # before any other jax call (parallel/mesh.py docstring); strict:
-        # an explicitly requested multi-host run must fail loudly
-        from mgproto_tpu.parallel.mesh import initialize_distributed
-
-        initialize_distributed(strict=True)
+    maybe_init_distributed(args)
     cfg = config_from_args(args)
     run_training(
         cfg,

@@ -27,10 +27,20 @@ __all__ = [
 
 def build_pipelines(cfg):
     """The reference's four loaders from one DataConfig (main.py:96-163):
-    (train, push, test, [ood...]) — ood list may be empty."""
+    (train, push, test, [ood...]) — ood list may be empty.
+
+    Under multi-host (`jax.distributed`), every loader shards its dataset by
+    process: each host loads a disjoint 1/num_processes of every global
+    batch, and eval/push gather per-shard results (parallel/multihost.py).
+    """
+    import jax
+
     from mgproto_tpu.config import Config
 
     assert isinstance(cfg, Config)
+    shard = dict(
+        shard_index=jax.process_index(), shard_count=jax.process_count()
+    )
     d, img = cfg.data, cfg.model.img_size
     train = DataLoader(
         ImageFolder(d.train_dir, train_transform(img)),
@@ -39,22 +49,26 @@ def build_pipelines(cfg):
         drop_last=True,
         num_workers=d.num_workers,
         seed=cfg.seed,
+        **shard,
     )
     push = DataLoader(
         ImageFolder(d.train_push_dir, push_transform(img)),
         d.train_push_batch_size,
         num_workers=d.num_workers,
+        **shard,
     )
     test = DataLoader(
         ImageFolder(d.test_dir, test_transform(img)),
         d.test_batch_size,
         num_workers=d.num_workers,
+        **shard,
     )
     oods = [
         DataLoader(
             ImageFolder(o, ood_transform(img)),
             d.test_batch_size,
             num_workers=d.num_workers,
+            **shard,
         )
         for o in d.ood_dirs
     ]

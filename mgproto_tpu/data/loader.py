@@ -26,13 +26,21 @@ class DataLoader:
 
     Args:
       dataset: object with __len__ and load(index, rng) -> (img, label, id).
-      batch_size: global batch size.
+      batch_size: PER-PROCESS batch size (the global batch is
+        batch_size * shard_count).
       shuffle: reshuffle each epoch (epoch advances on each __iter__).
-      drop_last: drop the trailing partial batch (train: True so jitted
-        shapes stay static; eval: False, the tail batch is padded and
-        `valid_count` marks real rows).
+      drop_last: drop the trailing partial GLOBAL batch (train: True so
+        jitted shapes stay static; eval: False, the tail is padded with
+        sentinel rows — zero image, label -1, id -1).
       num_workers: decode threads (0 = synchronous).
       seed: base seed for shuffle + augmentation streams.
+      shard_index/shard_count: multi-host data sharding. Every process
+        computes the SAME global order (seeded identically), walks it in
+        windows of batch_size*shard_count, and takes its own batch_size
+        slice of each window — so the assembled global batch is a disjoint
+        partition of the dataset, every process runs the SAME number of
+        batches (equal-shape collectives), and shard_count=1 reproduces the
+        single-host loader exactly.
     """
 
     def __init__(
@@ -44,7 +52,11 @@ class DataLoader:
         num_workers: int = 8,
         seed: int = 0,
         prefetch_batches: int = 2,
+        shard_index: int = 0,
+        shard_count: int = 1,
     ):
+        if not 0 <= shard_index < shard_count:
+            raise ValueError(f"shard_index {shard_index} not in [0, {shard_count})")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -52,13 +64,17 @@ class DataLoader:
         self.num_workers = num_workers
         self.seed = seed
         self.prefetch_batches = prefetch_batches
+        self.shard_index = shard_index
+        self.shard_count = shard_count
         self.epoch = 0
+        self._template = None  # (shape,) of a sample image, for sentinel rows
 
     def __len__(self) -> int:
         n = len(self.dataset)
+        span = self.batch_size * self.shard_count
         if self.drop_last:
-            return n // self.batch_size
-        return (n + self.batch_size - 1) // self.batch_size
+            return n // span
+        return (n + span - 1) // span
 
     def _order(self) -> np.ndarray:
         n = len(self.dataset)
@@ -69,15 +85,35 @@ class DataLoader:
         return np.arange(n)
 
     def _load_one(self, index: int, epoch: int):
+        if index < 0:  # sentinel pad row (multi-host tail alignment)
+            return None
         rng = np.random.default_rng([self.seed, epoch, int(index)])
         img, label, sid = self.dataset.load(int(index), rng)
-        return np.asarray(img, np.float32), label, sid
+        img = np.asarray(img, np.float32)
+        if self._template is None:
+            self._template = img.shape
+        return img, label, sid
+
+    def _sentinel_row(self):
+        if self._template is None:
+            # all-sentinel batch before any real row was seen: probe sample 0
+            img, _, _ = self.dataset.load(0, np.random.default_rng(0))
+            self._template = np.asarray(img, np.float32).shape
+        return np.zeros(self._template, np.float32), -1, -1
 
     def _batches_of_indices(self, order: np.ndarray):
         n = len(order)
-        stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
-        for i in range(0, stop, self.batch_size):
-            yield order[i : i + self.batch_size]
+        b, p, s = self.batch_size, self.shard_index, self.shard_count
+        span = b * s
+        if self.drop_last:
+            stop = (n // span) * span
+        else:
+            stop = ((n + span - 1) // span) * span
+            order = np.concatenate(
+                [order, np.full(stop - n, -1, order.dtype)]
+            )
+        for i in range(0, stop, span):
+            yield order[i + p * b : i + (p + 1) * b]
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         order = self._order()
@@ -85,6 +121,7 @@ class DataLoader:
         self.epoch += 1
 
         def assemble(results):
+            results = [r if r is not None else self._sentinel_row() for r in results]
             imgs = np.stack([r[0] for r in results])
             labels = np.asarray([r[1] for r in results], np.int32)
             ids = np.asarray([r[2] for r in results], np.int64)

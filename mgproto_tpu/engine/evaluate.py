@@ -34,43 +34,61 @@ def prototype_pair_distance(gmm: GMMState) -> float:
 
 def _run_eval(trainer, state, batches) -> Tuple[np.ndarray, np.ndarray, float, int]:
     """Shared loop: returns (per-sample log p(x), per-sample correct flags,
-    summed CE over batches, batch count).
+    summed CE over batches, batch count) over the GLOBAL dataset.
 
     Batches may be bare image arrays (unlabeled OoD), (images, labels), or
-    (images, labels, ids) — the loader's padded tail rows carry label -1 and
-    are dropped host-side so jitted shapes stay static."""
-    log_pxs, corrects = [], []
+    (images, labels, ids) — the loader's padded sentinel rows carry label -1
+    and are dropped host-side so jitted shapes stay static. Under multi-host,
+    each process feeds its loader shard, reads back only its addressable rows
+    (`host_local_rows`), and the per-sample arrays are allgathered so every
+    process computes identical global metrics (reference semantics: one
+    process saw everything, train_and_test.py:100-242)."""
+    from mgproto_tpu.parallel.multihost import allgather_rows, host_local_rows
+
+    log_pxs, corrects, valids = [], [], []
     ce_total, n_batches = 0.0, 0
     for batch in batches:
         if isinstance(batch, tuple):
             images, labels = batch[0], batch[1]
         else:
             images, labels = batch, None
-        images = jnp.asarray(images)
         labels_dev = None if labels is None else jnp.asarray(labels)
-        out = trainer.eval_step(state, images, labels_dev)
-        batch_log_px = np.asarray(jax.device_get(out.log_px))
-        batch_correct = np.asarray(jax.device_get(out.correct))
+        out = trainer.eval_step(state, jnp.asarray(images), labels_dev)
+        batch_log_px = host_local_rows(out.log_px)
+        batch_correct = host_local_rows(out.correct)
         if labels is None:
-            log_pxs.append(batch_log_px)
-            corrects.append(batch_correct)
-            continue
-        valid = np.asarray(labels) >= 0
-        log_pxs.append(batch_log_px[valid])
-        corrects.append(batch_correct[valid])
-        if valid.any():
-            logits = np.asarray(jax.device_get(out.logits), np.float64)[valid]
-            lbl = np.asarray(labels)[valid]
+            valid = np.ones(batch_log_px.shape[0], bool)
+        else:
+            valid = np.asarray(labels) >= 0
+            logits = host_local_rows(out.logits).astype(np.float64)
             lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1))
             lse += logits.max(-1)
-            ce_total += float(np.mean(lse - logits[np.arange(len(lbl)), lbl]))
-            n_batches += 1
-    return (
-        np.concatenate(log_pxs) if log_pxs else np.zeros((0,)),
-        np.concatenate(corrects) if corrects else np.zeros((0,), bool),
-        ce_total,
-        n_batches,
+            lbl = np.where(valid, np.asarray(labels), 0)
+            if valid.any():
+                ce_total += float(
+                    np.mean((lse - logits[np.arange(len(lbl)), lbl])[valid])
+                )
+                n_batches += 1
+        log_pxs.append(batch_log_px)
+        corrects.append(batch_correct)
+        valids.append(valid)
+    # raw per-shard concatenations have EQUAL shapes on every process (the
+    # loaders pad all shards to the same batch count, data/loader.py), so the
+    # cross-process gather is a plain row concat; the validity mask travels
+    # with the data and sentinel rows are dropped globally afterwards.
+    log_px = allgather_rows(np.concatenate(log_pxs) if log_pxs else np.zeros((0,)))
+    correct = allgather_rows(
+        np.concatenate(corrects) if corrects else np.zeros((0,), bool)
     )
+    valid = allgather_rows(
+        np.concatenate(valids) if valids else np.zeros((0,), bool)
+    ).astype(bool)
+    if jax.process_count() > 1:
+        from mgproto_tpu.parallel.multihost import allgather_sum
+
+        ce_total = allgather_sum(ce_total)
+        n_batches = int(allgather_sum(float(n_batches)))
+    return log_px[valid], correct[valid].astype(bool), ce_total, n_batches
 
 
 def evaluate(trainer, state, batches, log=print) -> Tuple[float, Dict]:

@@ -76,28 +76,36 @@ def collect_gt_activations(
     share one compiled forward across metric passes."""
     if act_fn is None:
         act_fn = make_gt_act_fn(trainer.model)
+    # per-process local jit over this process's loader shard; results are
+    # gathered globally below (parallel/multihost.py)
+    from mgproto_tpu.parallel.multihost import allgather_rows, fetch_replicated
+
+    params_h, stats_h, gmm_h = fetch_replicated(
+        (state.params, state.batch_stats, state.gmm),
+        getattr(trainer, "mesh", None),
+    )
     rng = np.random.default_rng(noise_seed)
-    accs, targets, ids = [], [], []
+    accs, targets, ids, valids = [], [], [], []
     for images, labels, img_ids in batches:
         images = np.asarray(images, np.float32)
         if use_noise:
             images = perturb_images(images, rng)
-        valid = np.asarray(labels) >= 0
         acts = act_fn(
-            state.params,
-            state.batch_stats,
-            state.gmm,
+            params_h,
+            stats_h,
+            gmm_h,
             jnp.asarray(images),
             jnp.asarray(np.maximum(labels, 0), jnp.int32),
         )
-        accs.append(np.asarray(jax.device_get(acts))[valid])
-        targets.append(np.asarray(labels)[valid])
-        ids.append(np.asarray(img_ids)[valid])
-    return (
-        np.concatenate(accs),
-        np.concatenate(targets),
-        np.concatenate(ids),
-    )
+        accs.append(np.asarray(jax.device_get(acts)))
+        targets.append(np.asarray(labels))
+        ids.append(np.asarray(img_ids))
+        valids.append(np.asarray(labels) >= 0)
+    acc = allgather_rows(np.concatenate(accs))
+    target = allgather_rows(np.concatenate(targets))
+    img_id = allgather_rows(np.concatenate(ids))
+    valid = allgather_rows(np.concatenate(valids)).astype(bool)
+    return acc[valid], target[valid], img_id[valid]
 
 
 def hit_matrix(

@@ -140,6 +140,16 @@ def push_prototypes(
     """
     scan = make_scan_fn(trainer.model)
 
+    # host-local copies of the weights/GMM: the scan below is a per-process
+    # local jit over this process's loader shard, so cross-host-sharded
+    # state must be replicated first (parallel/multihost.py)
+    from mgproto_tpu.parallel.multihost import allgather_rows, fetch_replicated
+
+    params_h, stats_h, gmm_h = fetch_replicated(
+        (state.params, state.batch_stats, state.gmm),
+        getattr(trainer, "mesh", None),
+    )
+
     all_labels: List[np.ndarray] = []
     all_ids: List[np.ndarray] = []
     all_vals: List[np.ndarray] = []
@@ -148,9 +158,9 @@ def push_prototypes(
     for images, labels, image_ids in batches:
         images = normalize(np.asarray(images, np.float32))
         val, idx, fvec = scan(
-            state.params,
-            state.batch_stats,
-            state.gmm,
+            params_h,
+            stats_h,
+            gmm_h,
             jnp.asarray(images),
             jnp.asarray(labels, jnp.int32),
         )
@@ -163,19 +173,28 @@ def push_prototypes(
     if not all_labels:
         raise ValueError("push set is empty")
 
-    labels = np.concatenate(all_labels)
-    image_ids = np.concatenate(all_ids)
-    vals = np.concatenate(all_vals)
-    idxs = np.concatenate(all_idxs)
-    fvecs = np.concatenate(all_fvecs)
+    # candidates from every process's shard (equal shapes; sentinel rows have
+    # label -1 and are never selected by _greedy_assign)
+    labels = allgather_rows(np.concatenate(all_labels))
+    image_ids = allgather_rows(np.concatenate(all_ids))
+    vals = allgather_rows(np.concatenate(all_vals))
+    idxs = allgather_rows(np.concatenate(all_idxs))
+    fvecs = allgather_rows(np.concatenate(all_fvecs))
 
     c = state.gmm.num_classes
     new_means, result = _greedy_assign(labels, image_ids, vals, idxs, fvecs, c)
 
-    means = jnp.where(
-        jnp.asarray(result.pushed)[:, :, None],
-        jnp.asarray(new_means),
+    # write-back inside jit: state.gmm.means may be a cross-host-sharded
+    # global array (outside-jit jnp.where cannot touch those); new_means /
+    # pushed are identical on every process after the gather, so they enter
+    # as replicated operands and the output keeps the means' sharding
+    def _write_back(g_means, nm, pm):
+        return jnp.where(pm[:, :, None], nm, g_means)
+
+    means = jax.jit(_write_back)(
         state.gmm.means,
+        jnp.asarray(new_means),
+        jnp.asarray(result.pushed),
     )
     new_state = state.replace(gmm=state.gmm._replace(means=means))
 

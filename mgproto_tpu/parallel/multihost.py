@@ -1,0 +1,86 @@
+"""Multi-host (multi-process) helpers.
+
+Under `jax.distributed` each process addresses only its own chips, so two
+host-side idioms that are trivial on one host need care:
+
+  * reading back a data-sharded array (`jax.device_get` of a global array
+    whose shards live on other hosts raises "not fully addressable") —
+    `host_local_rows` extracts exactly the rows this process contributed;
+  * computing dataset-level metrics (accuracy, OoD percentiles, push
+    candidates) over per-process shards — `allgather_rows` concatenates
+    equal-shaped host-local arrays across processes (the loaders guarantee
+    equal shapes: every process runs the same number of identically padded
+    batches, data/loader.py).
+
+Everything degenerates to a no-op/device_get on a single process, which is
+how the test suite exercises the call sites (a real pod exercises the other
+branch; no multi-process simulation exists in CI).
+
+Reference: none — the reference is single-process (SURVEY.md §2.3); this is
+the scaffolding its NCCL/torch.distributed story never grew.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def host_local_rows(arr: jax.Array) -> np.ndarray:
+    """Rows of a leading-axis-sharded global array that live on THIS process,
+    in ascending global-row order. Single process: the whole array."""
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(arr))
+    by_start = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        by_start.setdefault(start, np.asarray(s.data))  # dedupe replicas
+    return np.concatenate(
+        [by_start[k] for k in sorted(by_start)], axis=0
+    )
+
+
+def allgather_rows(x: np.ndarray) -> np.ndarray:
+    """Concatenate equal-shaped per-process host arrays across all processes
+    (row-major in process order). Single process: identity."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(np.asarray(x))
+    return np.concatenate(list(stacked), axis=0)
+
+
+def allgather_sum(x: float) -> float:
+    """Sum a host-side scalar across processes. Single process: identity."""
+    if jax.process_count() == 1:
+        return float(x)
+    from jax.experimental import multihost_utils
+
+    return float(np.sum(multihost_utils.process_allgather(np.float64(x))))
+
+
+def fetch_replicated(tree: Any, mesh=None) -> Any:
+    """Host-local numpy copy of a (possibly cross-host-sharded) pytree.
+
+    Sharded leaves are first replicated by an SPMD identity (XLA all-gathers
+    over ICI/DCN), making every leaf fully addressable; then device_get.
+    Used by host-driven passes (push scan, interpretability) that re-run
+    their own local jits over per-process batches."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    needs_gather = any(
+        isinstance(l, jax.Array) and not l.is_fully_addressable for l in leaves
+    )
+    if needs_gather:
+        if mesh is None:
+            raise ValueError("fetch_replicated needs the mesh for sharded input")
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        tree = jax.jit(
+            lambda t: t,
+            out_shardings=jax.tree_util.tree_map(lambda _: rep, tree),
+        )(tree)
+    return jax.device_get(tree)

@@ -61,16 +61,25 @@ class ShardedTrainer(Trainer):
             cfg.mesh.data, cfg.mesh.model
         )
         n_data = self.mesh.shape["data"]
+        nproc = jax.process_count()
+        if n_data % nproc != 0:
+            raise ValueError(
+                f"mesh data axis ({n_data}) must be divisible by the process "
+                f"count ({nproc}) so every host owns whole data shards"
+            )
+        local_chips = n_data // nproc
         for name, b in (
             ("train_batch_size", cfg.data.train_batch_size),
             ("test_batch_size", cfg.data.test_batch_size),
             ("train_push_batch_size", cfg.data.train_push_batch_size),
         ):
-            if b % n_data != 0:
+            # batch sizes are per-process (the loaders shard by process and
+            # put_batch assembles the global batch of b * nproc rows)
+            if b % local_chips != 0:
                 raise ValueError(
-                    f"data.{name}={b} must be divisible by the mesh data axis "
-                    f"({n_data} devices) so the batch shards evenly; adjust "
-                    "--batch_size or --mesh_data"
+                    f"data.{name}={b} (per process) must be divisible by this "
+                    f"process's data-axis share ({local_chips} of {n_data} "
+                    "devices); adjust --batch_size or --mesh_data"
                 )
         self._repl = replicated(self.mesh)
         self._batch_sh = batch_sharding(self.mesh)

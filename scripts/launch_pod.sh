@@ -16,7 +16,9 @@ ZONE="${2:?zone}"
 DATA_ROOT="${3:?data_root}"
 shift 3 || true
 
-REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+# repo location ON THE WORKERS (may differ from the launching machine's
+# checkout); override with MGPROTO_REMOTE_DIR
+REPO_DIR="${MGPROTO_REMOTE_DIR:-$(cd "$(dirname "$0")/.." && pwd)}"
 
 # %q-quote every component so spaces/globs/quotes survive the remote shell's
 # re-parse on each worker

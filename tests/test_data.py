@@ -194,3 +194,108 @@ def test_cub2011_eval(tmp_path):
     img, label, img_id = test.load(0)
     assert label == 0  # 1-based -> 0-based
     assert img_id == 3  # official CUB id preserved
+
+
+class TestLoaderSharding:
+    """Multi-host data sharding semantics (loader shard_index/shard_count):
+    disjoint per-process partitions of each global batch, equal batch counts,
+    sentinel padding, and single-shard equivalence."""
+
+    class _IdxDataset:
+        def __init__(self, n, shape=(4, 4, 3)):
+            self.n, self.shape = n, shape
+
+        def __len__(self):
+            return self.n
+
+        def load(self, i, rng):
+            img = np.full(self.shape, float(i), np.float32)
+            return img, i % 5, i
+
+    def _collect(self, loader):
+        out = []
+        for imgs, labels, ids in loader:
+            out.append((imgs, labels, ids))
+        return out
+
+    def test_disjoint_partition_and_equal_counts(self):
+        from mgproto_tpu.data.loader import DataLoader
+
+        ds = self._IdxDataset(23)
+        shards = [
+            DataLoader(ds, batch_size=3, shuffle=True, drop_last=True,
+                       num_workers=0, seed=7, shard_index=p, shard_count=2)
+            for p in range(2)
+        ]
+        got = [self._collect(s) for s in shards]
+        assert len(got[0]) == len(got[1]) == len(shards[0]) == 23 // 6
+        seen = []
+        for batches in got:
+            for _, _, ids in batches:
+                seen.extend(ids.tolist())
+        assert len(seen) == len(set(seen))  # disjoint across shards
+
+    def test_global_batch_is_contiguous_window(self):
+        """Process p's batch g must be rows [g*B*S + p*B, ...) of the global
+        order, so assembling shards reconstructs the single-host batch."""
+        from mgproto_tpu.data.loader import DataLoader
+
+        ds = self._IdxDataset(24)
+        single = DataLoader(ds, batch_size=6, num_workers=0)
+        parts = [
+            DataLoader(ds, batch_size=3, num_workers=0,
+                       shard_index=p, shard_count=2)
+            for p in range(2)
+        ]
+        g_single = self._collect(single)
+        g_parts = [self._collect(p) for p in parts]
+        for g, (_, _, ids_global) in enumerate(g_single):
+            assembled = np.concatenate(
+                [g_parts[0][g][2], g_parts[1][g][2]]
+            )
+            np.testing.assert_array_equal(np.sort(assembled), np.sort(ids_global))
+
+    def test_sentinel_padding_tail(self):
+        from mgproto_tpu.data.loader import DataLoader
+
+        ds = self._IdxDataset(7)
+        loaders = [
+            DataLoader(ds, batch_size=4, num_workers=0,
+                       shard_index=p, shard_count=2)
+            for p in range(2)
+        ]
+        got = [self._collect(l) for l in loaders]
+        assert len(got[0]) == len(got[1]) == 1
+        all_ids = np.concatenate([got[0][0][2], got[1][0][2]])
+        assert (all_ids == -1).sum() == 1  # 8 slots, 7 samples
+        labels = np.concatenate([got[0][0][1], got[1][0][1]])
+        assert (labels[all_ids == -1] == -1).all()
+        imgs = np.concatenate([got[0][0][0], got[1][0][0]])
+        assert (imgs[all_ids == -1] == 0).all()
+
+    def test_all_sentinel_shard_batch(self):
+        """A shard whose slice of the last window is entirely padding must
+        still yield a correctly-shaped zero batch."""
+        from mgproto_tpu.data.loader import DataLoader
+
+        ds = self._IdxDataset(2)
+        loader = DataLoader(ds, batch_size=4, num_workers=0,
+                            shard_index=1, shard_count=2)
+        (imgs, labels, ids), = self._collect(loader)
+        assert imgs.shape == (4, 4, 4, 3)
+        assert (labels == -1).all() and (ids == -1).all() and (imgs == 0).all()
+
+    def test_single_shard_matches_unsharded(self):
+        from mgproto_tpu.data.loader import DataLoader
+
+        ds = self._IdxDataset(10)
+        a = self._collect(DataLoader(ds, batch_size=4, shuffle=True,
+                                     num_workers=0, seed=3))
+        b = self._collect(DataLoader(ds, batch_size=4, shuffle=True,
+                                     num_workers=0, seed=3,
+                                     shard_index=0, shard_count=1))
+        assert len(a) == len(b)
+        for (ia, la, da), (ib, lb, db) in zip(a, b):
+            np.testing.assert_array_equal(da, db)
+            np.testing.assert_array_equal(la, lb)
+            np.testing.assert_allclose(ia, ib)

@@ -120,3 +120,25 @@ def test_multi_step_memory_accumulates(cfg):
     total = int(jax.device_get(state.memory.length).sum())
     assert total > 0
     assert int(jax.device_get(state.step)) == 3
+
+
+def test_imagenet_scale_class_sharding():
+    """The ImageNet-1K stretch shape (SURVEY.md §7.2.9): 1000 classes sharded
+    over the model axis; density/EM/memory shards stay class-local."""
+    from mgproto_tpu.parallel import ShardedTrainer, make_mesh
+
+    cfg = tiny_test_config(
+        num_classes=1000, prototypes_per_class=2, proto_dim=8,
+        img_size=32, mem_capacity=8, mine_T=3,
+    )
+    mesh = make_mesh(data=2, model=4)
+    tr = ShardedTrainer(cfg, steps_per_epoch=2, mesh=mesh)
+    st = tr.init_state(jax.random.PRNGKey(0))
+    imgs = jax.random.uniform(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    lbls = jnp.arange(8, dtype=jnp.int32) * 100
+    st, m = tr.train_step(st, imgs, lbls, use_mine=True, update_gmm=True)
+    assert np.isfinite(float(m.loss))
+    assert int(st.memory.length.sum()) > 0
+    assert st.gmm.means.sharding.spec == jax.sharding.PartitionSpec("model")
+    out = tr.eval_step(st, imgs, lbls)
+    assert out.logits.shape == (8, 1000)
