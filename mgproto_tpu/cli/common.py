@@ -81,6 +81,15 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--push_every", type=int, default=10)
     p.add_argument("--prune_top_m", type=int, default=8)
     p.add_argument("--no_pretrained", action="store_true")
+    # default matches ModelConfig so pre-existing f32 checkpoints evaluate
+    # under the numerics they trained with; launch_tpu.sh opts into bf16
+    p.add_argument("--compute_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="trunk compute dtype (params/density stay f32)")
+    p.add_argument("--fused_scoring", action="store_true",
+                   help="Pallas fused density+top-T kernel (TPU)")
+    p.add_argument("--remat", action="store_true",
+                   help="checkpoint backbone blocks (HBM for FLOPs)")
     p.add_argument("--num_workers", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
     # runtime
@@ -118,6 +127,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             mine_T=args.mine_level,
             mem_capacity=args.mem_sz,
             pretrained=not args.no_pretrained,
+            compute_dtype=args.compute_dtype,
+            fused_scoring=args.fused_scoring,
+            remat=args.remat,
         ),
         em=EMConfig(),
         optim=OptimConfig(),

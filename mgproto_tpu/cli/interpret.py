@@ -65,7 +65,12 @@ def main(argv: Optional[list] = None) -> None:
         args.cub_root, train=False, transform=ood_transform(cfg.model.img_size)
     )
     loader = DataLoader(
-        dataset, cfg.data.test_batch_size, num_workers=cfg.data.num_workers
+        dataset,
+        cfg.data.test_batch_size,
+        num_workers=cfg.data.num_workers,
+        # per-process shard: collect_gt_activations allgathers rows globally
+        shard_index=jax.process_index(),
+        shard_count=jax.process_count(),
     )
 
     trainer = ShardedTrainer(cfg, steps_per_epoch=1)

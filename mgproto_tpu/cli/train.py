@@ -119,6 +119,15 @@ def run_training(
             )
         if last is not None:
             m = jax.device_get(last._asdict())
+            if not np.isfinite(float(m["loss"])):
+                # failure detection the reference lacks (SURVEY.md §5.2/§5.3):
+                # stop with state intact rather than training on NaNs; the
+                # last good checkpoint in model_dir is the resume point
+                raise RuntimeError(
+                    f"non-finite loss {float(m['loss'])} at epoch {epoch} "
+                    f"(step {int(state.step)}); resume from the last "
+                    f"checkpoint in {cfg.model_dir} with --resume auto"
+                )
             log(
                 "\tloss: {loss:.4f}  ce: {cross_entropy:.4f}  mine: {mine:.4f}"
                 "  aux: {aux:.4f}  acc: {accuracy:.4f}  mem: {full_mem_ratio:.3f}".format(

@@ -36,6 +36,10 @@ class ModelConfig:
     # (ops/fused_scoring.py). Identical numerics; needs a TPU (interpret-mode
     # fallback on CPU is correct but slow).
     fused_scoring: bool = False
+    # jax.checkpoint the backbone blocks (ResNet/DenseNet): backward
+    # recomputes block internals instead of storing activations — enables
+    # larger per-chip batches at ~1/3 extra FLOPs.
+    remat: bool = False
 
     @property
     def num_prototypes(self) -> int:

@@ -141,7 +141,15 @@ class MGProtoFeatures(nn.Module):
         # thresholds depend on the p(x) scale; SURVEY.md §7.3.5).
         dtype = jnp.dtype(self.cfg.compute_dtype)
         dtype = None if dtype == jnp.float32 else dtype
-        self.features = build_backbone(self.cfg.arch, dtype=dtype)
+        kw = {"dtype": dtype}
+        if self.cfg.remat:
+            if not self.cfg.arch.startswith(("resnet", "densenet")):
+                raise ValueError(
+                    "remat is implemented for resnet/densenet blocks only "
+                    f"(got arch={self.cfg.arch!r})"
+                )
+            kw["remat"] = True
+        self.features = build_backbone(self.cfg.arch, **kw)
         self.add_on = AddOnLayers(
             proto_dim=self.cfg.proto_dim,
             add_on_type=self.cfg.add_on_type,

@@ -117,6 +117,12 @@ def _greedy_assign(
     return new_means, PushResult(pushed, out_img, out_idx, out_lp)
 
 
+@jax.jit
+def _write_back(g_means, nm, pm):
+    """Module-level jit (compiles once across push epochs)."""
+    return jnp.where(pm[:, :, None], nm, g_means)
+
+
 def push_prototypes(
     trainer,
     state: TrainState,
@@ -188,10 +194,7 @@ def push_prototypes(
     # global array (outside-jit jnp.where cannot touch those); new_means /
     # pushed are identical on every process after the gather, so they enter
     # as replicated operands and the output keeps the means' sharding
-    def _write_back(g_means, nm, pm):
-        return jnp.where(pm[:, :, None], nm, g_means)
-
-    means = jax.jit(_write_back)(
+    means = _write_back(
         state.gmm.means,
         jnp.asarray(new_means),
         jnp.asarray(result.pushed),

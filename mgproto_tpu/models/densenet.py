@@ -59,6 +59,7 @@ class DenseNetFeatures(nn.Module):
     bn_size: int = 4
     stem_pool: bool = False  # reference removes pool0 (densenet_features.py:116)
     dtype: Any = None
+    remat: bool = False  # jax.checkpoint each dense layer (see resnet.py)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -68,10 +69,15 @@ class DenseNetFeatures(nn.Module):
         if self.stem_pool:
             x = max_pool(x, 3, 2, 1)
 
+        layer_cls = (
+            nn.remat(DenseLayer, static_argnums=(2,))
+            if self.remat
+            else DenseLayer
+        )
         num_features = self.num_init_features
         for bi, num_layers in enumerate(self.block_config):
             for li in range(num_layers):
-                x = DenseLayer(
+                x = layer_cls(
                     growth_rate=self.growth_rate,
                     bn_size=self.bn_size,
                     name=f"denseblock{bi + 1}_denselayer{li + 1}",

@@ -94,6 +94,10 @@ class ResNetFeatures(nn.Module):
     layers: Sequence[int]
     stem_pool: bool = False  # reference skips it (resnet_features.py:199)
     dtype: Any = None
+    # jax.checkpoint each residual block: backward recomputes block internals
+    # instead of storing them — HBM for FLOPs, the standard remat trade for
+    # larger batches (scope names are preserved, so checkpoints interchange)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -103,6 +107,11 @@ class ResNetFeatures(nn.Module):
         if self.stem_pool:
             x = max_pool(x, 3, 2, 1)
 
+        block_cls = (
+            nn.remat(self.block_cls, static_argnums=(2,))
+            if self.remat
+            else self.block_cls
+        )
         inplanes = 64
         for li, (planes, blocks) in enumerate(
             zip((64, 128, 256, 512), self.layers)
@@ -111,7 +120,7 @@ class ResNetFeatures(nn.Module):
             for bi in range(blocks):
                 s = stride if bi == 0 else 1
                 needs_ds = s != 1 or inplanes != planes * self.block_cls.expansion
-                x = self.block_cls(
+                x = block_cls(
                     planes=planes,
                     stride=s,
                     has_downsample=needs_ds and bi == 0,

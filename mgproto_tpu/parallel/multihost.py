@@ -62,6 +62,23 @@ def allgather_sum(x: float) -> float:
     return float(np.sum(multihost_utils.process_allgather(np.float64(x))))
 
 
+_REPLICATING_JITS: dict = {}
+
+
+def _replicating_identity(mesh):
+    """Per-mesh cached identity jit with replicated out_shardings (jit's own
+    cache then handles distinct tree structures) — a fresh lambda per call
+    would retrace every push/interpret invocation."""
+    fn = _REPLICATING_JITS.get(mesh)
+    if fn is None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        fn = jax.jit(lambda t: t, out_shardings=rep)
+        _REPLICATING_JITS[mesh] = fn
+    return fn
+
+
 def fetch_replicated(tree: Any, mesh=None) -> Any:
     """Host-local numpy copy of a (possibly cross-host-sharded) pytree.
 
@@ -76,11 +93,5 @@ def fetch_replicated(tree: Any, mesh=None) -> Any:
     if needs_gather:
         if mesh is None:
             raise ValueError("fetch_replicated needs the mesh for sharded input")
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        rep = NamedSharding(mesh, PartitionSpec())
-        tree = jax.jit(
-            lambda t: t,
-            out_shardings=jax.tree_util.tree_map(lambda _: rep, tree),
-        )(tree)
+        tree = _replicating_identity(mesh)(tree)
     return jax.device_get(tree)

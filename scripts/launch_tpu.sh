@@ -13,7 +13,10 @@ DATA_ROOT="${1:?usage: launch_tpu.sh <data_root> [args...]}"
 shift || true
 
 cd "$(dirname "$0")/.."
+# bf16 trunk is the TPU-optimal default for fresh runs; trailing user args
+# override any of these
 exec python -m mgproto_tpu.cli.train \
     --data_root "$DATA_ROOT" \
     --model_dir "./saved_models-$(date +%Y%m%d-%H%M%S)" \
+    --compute_dtype bfloat16 \
     "$@"

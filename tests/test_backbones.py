@@ -121,3 +121,40 @@ def test_converter_handles_bbn_inat_key_renames():
     assert "layer4.2.conv1.weight" in out
     assert "layer4.3.bn1.weight" in out
     assert not any(k.startswith("classifier") for k in out)
+
+
+def test_remat_preserves_outputs_params_and_grads():
+    """remat=True must change only the backward-pass schedule: identical
+    params tree, outputs, and gradients (models/resnet.py block remat)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mgproto_tpu.models import build_backbone
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 32, 32, 3)).astype(np.float32)
+    )
+    outs, grads = [], []
+    for remat in (False, True):
+        net = build_backbone("resnet18", remat=remat)
+        v = net.init(jax.random.PRNGKey(0), x, train=False)
+
+        def loss(params):
+            y, _ = net.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                x, train=True, mutable=["batch_stats"],
+            )
+            return jnp.sum(y**2)
+
+        outs.append(net.apply(v, x, train=False))
+        grads.append(jax.grad(loss)(v["params"]))
+    np.testing.assert_allclose(
+        np.asarray(outs[0]), np.asarray(outs[1]), rtol=1e-5, atol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads[0]), jax.tree_util.tree_leaves(grads[1])
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
