@@ -98,6 +98,12 @@ def run_training(
             raise FileNotFoundError(resume)
 
     img_dir = os.path.join(cfg.model_dir, "img")
+    # persisted so eval/interpret adopt the training-time trunk numerics
+    # (p(x)/OoD thresholds are dtype-sensitive, SURVEY.md §7.3.5)
+    run_meta = {
+        "compute_dtype": cfg.model.compute_dtype,
+        "arch": cfg.model.arch,
+    }
     push_ds = push_loader.dataset
     accu = 0.0
 
@@ -123,10 +129,15 @@ def run_training(
                 # failure detection the reference lacks (SURVEY.md §5.2/§5.3):
                 # stop with state intact rather than training on NaNs; the
                 # last good checkpoint in model_dir is the resume point
+                last_ckpt = latest_checkpoint(cfg.model_dir)
+                hint = (
+                    f"resume from {last_ckpt} with --resume auto"
+                    if last_ckpt
+                    else "no checkpoint was saved yet; adjust the config"
+                )
                 raise RuntimeError(
                     f"non-finite loss {float(m['loss'])} at epoch {epoch} "
-                    f"(step {int(state.step)}); resume from the last "
-                    f"checkpoint in {cfg.model_dir} with --resume auto"
+                    f"(step {int(state.step)}); {hint}"
                 )
             log(
                 "\tloss: {loss:.4f}  ce: {cross_entropy:.4f}  mine: {mine:.4f}"
@@ -145,7 +156,8 @@ def run_training(
             )
         metrics.write(int(state.step), {"epoch": epoch, **test_results})
         save_state_w_condition(
-            cfg.model_dir, state, epoch, "nopush", accu, target_accu
+            cfg.model_dir, state, epoch, "nopush", accu, target_accu,
+            metadata=run_meta,
         )
 
         if epoch >= cfg.schedule.push_start and epoch in cfg.schedule.push_epochs():
@@ -165,7 +177,8 @@ def run_training(
                 int(state.step), {"epoch": epoch, "stage": "push", **test_results}
             )
             save_state_w_condition(
-                cfg.model_dir, state, epoch, "push", accu, target_accu
+                cfg.model_dir, state, epoch, "push", accu, target_accu,
+                metadata=run_meta,
             )
 
     # pruning (reference main.py:285-287); top_m can't exceed K per class
@@ -177,7 +190,8 @@ def run_training(
         int(state.step), {"epoch": last_epoch, "stage": "prune", **test_results}
     )
     save_state_w_condition(
-        cfg.model_dir, state, last_epoch, "prune", accu, target_accu
+        cfg.model_dir, state, last_epoch, "prune", accu, target_accu,
+        metadata=run_meta,
     )
 
     log("training done")

@@ -152,8 +152,14 @@ def test_remat_preserves_outputs_params_and_grads():
     np.testing.assert_allclose(
         np.asarray(outs[0]), np.asarray(outs[1]), rtol=1e-5, atol=1e-5
     )
+    # checkpoint-interchange guarantee: same tree STRUCTURE, not just values
+    assert jax.tree_util.tree_structure(grads[0]) == jax.tree_util.tree_structure(
+        grads[1]
+    )
     for a, b in zip(
-        jax.tree_util.tree_leaves(grads[0]), jax.tree_util.tree_leaves(grads[1])
+        jax.tree_util.tree_leaves(grads[0]),
+        jax.tree_util.tree_leaves(grads[1]),
+        strict=True,
     ):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
