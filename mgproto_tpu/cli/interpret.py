@@ -53,6 +53,9 @@ def main(argv: Optional[list] = None) -> None:
                    help="box half-size for consistency/stability (purity uses 16)")
     p.add_argument("--purity_half_size", type=int, default=16)
     p.add_argument("--purity_top_k", type=int, default=10)
+    p.add_argument("--export_csv", default="",
+                   help="also write the per-prototype top-K patch CSV "
+                        "(method-agnostic purity interchange format)")
     args = p.parse_args(argv)
     maybe_init_distributed(args)
     cfg = config_from_args(args)
@@ -122,6 +125,20 @@ def main(argv: Optional[list] = None) -> None:
         )
         results["purity"] = mean
         results["purity_std"] = std
+    if args.export_csv and jax.process_index() == 0:
+        # any metric selection (clean activations are already collected and
+        # allgathered); process 0 only — every process holds the full data
+        # and concurrent writers would corrupt a shared-filesystem path
+        from mgproto_tpu.engine.interpretability import (
+            export_prototype_patches_csv,
+        )
+
+        results["csv_rows"] = export_prototype_patches_csv(
+            args.export_csv, trainer, state, None, c,
+            half_size=args.purity_half_size, top_k=args.purity_top_k,
+            activations=clean,
+        )
+        results["csv"] = args.export_csv
     print(json.dumps(results))
 
 

@@ -108,6 +108,22 @@ def collect_gt_activations(
     return acc[valid], target[valid], img_id[valid]
 
 
+def peak_box(
+    act_map: np.ndarray, img_size: int, half_size: int
+) -> Tuple[int, int, int, int]:
+    """(y1, y2, x1, x2) box of side 2*half_size around the upsampled
+    activation argmax, clipped to the image (reference
+    interpretability.py:108-120 region arithmetic)."""
+    up = upsample_activation(act_map, (img_size, img_size))
+    my, mx = np.unravel_index(np.argmax(up), up.shape)
+    return (
+        max(0, int(my) - half_size),
+        min(img_size, int(my) + half_size),
+        max(0, int(mx) - half_size),
+        min(img_size, int(mx) + half_size),
+    )
+
+
 def hit_matrix(
     act_maps: np.ndarray,  # [N, K, h, w] one class's images
     part_labels: Sequence[Sequence[Sequence[int]]],  # per image [(pid, x, y)]
@@ -128,16 +144,7 @@ def hit_matrix(
             enumerate(range(n)) if rows is None else enumerate(rows)
         )
         for out_row, img_idx in row_iter:
-            up = upsample_activation(
-                act_maps[img_idx, k], (img_size, img_size)
-            )
-            my, mx = np.unravel_index(np.argmax(up), up.shape)
-            region = (
-                max(0, my - half_size),
-                min(img_size, my + half_size),
-                max(0, mx - half_size),
-                min(img_size, mx + half_size),
-            )
+            region = peak_box(act_maps[img_idx, k], img_size, half_size)
             for pid, x, y in part_labels[img_idx]:
                 if in_bbox((y, x), region):
                     out[k, out_row, pid] = 1
@@ -157,6 +164,15 @@ def _per_class_annotations(
         labels.append(pl)
         masks.append(mask)
     return labels, np.stack(masks)
+
+
+def _topk_rows(class_acts: np.ndarray, top_k: int) -> np.ndarray:
+    """[kk, K] image rows of each prototype's top-K peak activations —
+    the ONE selection rule shared by evaluate_purity and the CSV export
+    (stable sort: ties break toward the earlier image)."""
+    peak = class_acts.max(axis=(2, 3))  # [N, K]
+    order = np.argsort(-peak, axis=0, kind="stable")
+    return order[: min(top_k, class_acts.shape[0])]
 
 
 def _iter_class_hits(
@@ -183,9 +199,7 @@ def _iter_class_hits(
                 class_acts, labels, parts.part_num, img_size, half_size
             ), masks
         else:
-            peak = class_acts.max(axis=(2, 3))  # [N, K]
-            order = np.argsort(-peak, axis=0, kind="stable")  # best first
-            kk = min(top_k, idx.size)
+            order = _topk_rows(class_acts, top_k)
             # one single-prototype hit_matrix per k: scoring only that
             # prototype's top-K images (not K x K work)
             hits = np.stack(
@@ -196,7 +210,7 @@ def _iter_class_hits(
                         parts.part_num,
                         img_size,
                         half_size,
-                        rows=list(order[:kk, k]),
+                        rows=list(order[:, k]),
                     )[0]
                     for k in range(class_acts.shape[1])
                 ]
@@ -307,5 +321,94 @@ def evaluate_purity(
     ):
         for k in range(hits.shape[0]):
             purity.append(hits[k].mean(axis=0).max())
+    arr = np.asarray(purity)
+    return float(arr.mean() * 100.0), float(arr.std() * 100.0)
+
+
+# ------------------------------------------------------- CSV export (parity)
+def export_prototype_patches_csv(
+    path: str,
+    trainer,
+    state,
+    batches,
+    num_classes: int,
+    half_size: int = 16,
+    top_k: int = 10,
+    activations: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+) -> int:
+    """Write each prototype's top-K activated patches as CSV rows
+    `class,k,rank,img_id,ymin,ymax,xmin,xmax` (coordinates on the model's
+    input grid) — the reference's method-agnostic purity interchange format
+    (reference cub_csv.py:225-266 `get_proto_patches_cub` /
+    eval_prototypes_cub_parts_csv input). Returns the number of rows."""
+    import csv as _csv
+
+    img_size = trainer.cfg.model.img_size
+    acts, targets, img_ids = (
+        activations
+        if activations is not None
+        else collect_gt_activations(trainer, state, batches)
+    )
+    rows = 0
+    with open(path, "w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(
+            ["class", "k", "rank", "img_id", "ymin", "ymax", "xmin", "xmax"]
+        )
+        for c in range(num_classes):
+            idx = np.nonzero(targets == c)[0]
+            if idx.size == 0:
+                continue
+            class_acts = acts[idx]
+            class_ids = img_ids[idx]
+            order = _topk_rows(class_acts, top_k)
+            for k in range(class_acts.shape[1]):
+                for rank, n in enumerate(order[:, k]):
+                    y1, y2, x1, x2 = peak_box(
+                        class_acts[n, k], img_size, half_size
+                    )
+                    w.writerow(
+                        [c, k, rank, int(class_ids[n]), y1, y2, x1, x2]
+                    )
+                    rows += 1
+    return rows
+
+
+def purity_from_csv(
+    csvfile: str, parts: CubParts, img_size: int
+) -> Tuple[float, float]:
+    """Recompute purity from an exported patch CSV — works for ANY
+    part-prototype method that emits the same rows (reference
+    cub_csv.py:55-222 `eval_prototypes_cub_parts_csv` capability). Must agree
+    with `evaluate_purity` when fed this framework's own export."""
+    import csv as _csv
+    from collections import defaultdict
+
+    by_proto = defaultdict(list)
+    with open(csvfile, newline="") as f:
+        reader = _csv.DictReader(f)
+        for row in reader:
+            by_proto[(int(row["class"]), int(row["k"]))].append(
+                (
+                    int(row["img_id"]),
+                    (
+                        int(row["ymin"]),
+                        int(row["ymax"]),
+                        int(row["xmin"]),
+                        int(row["xmax"]),
+                    ),
+                )
+            )
+    purity = []
+    for (_c, _k), entries in sorted(by_proto.items()):
+        hits = np.zeros((len(entries), parts.part_num))
+        for r, (img_id, box) in enumerate(entries):
+            labels, _ = parts.scaled_part_labels(
+                img_id, parts.orig_wh(img_id), img_size
+            )
+            for pid, x, y in labels:
+                if in_bbox((y, x), box):
+                    hits[r, pid] = 1
+        purity.append(hits.mean(axis=0).max())
     arr = np.asarray(purity)
     return float(arr.mean() * 100.0), float(arr.std() * 100.0)

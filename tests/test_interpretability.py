@@ -148,3 +148,29 @@ def test_consistency_extremes(setup):
         half_size=IMG_SIZE, top_k=2,
     )
     assert purity == pytest.approx(100.0)
+
+
+def test_purity_csv_round_trip(setup, tmp_path):
+    """Exported patch CSV re-scored by purity_from_csv must reproduce
+    evaluate_purity exactly (the reference's method-agnostic CSV contract,
+    cub_csv.py:55-266)."""
+    from mgproto_tpu.engine.interpretability import (
+        collect_gt_activations,
+        export_prototype_patches_csv,
+        purity_from_csv,
+    )
+
+    trainer, state, parts, loader = setup
+    acts = collect_gt_activations(trainer, state, iter(loader))
+    direct = evaluate_purity(
+        trainer, state, None, parts, NUM_CLASSES, half_size=8, top_k=2,
+        activations=acts,
+    )
+    csv_path = str(tmp_path / "patches.csv")
+    n_rows = export_prototype_patches_csv(
+        csv_path, trainer, state, None, NUM_CLASSES, half_size=8, top_k=2,
+        activations=acts,
+    )
+    assert n_rows > 0
+    via_csv = purity_from_csv(csv_path, parts, IMG_SIZE)
+    assert via_csv == pytest.approx(direct, abs=1e-9)
