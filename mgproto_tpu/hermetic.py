@@ -1,0 +1,62 @@
+"""Hermetic CPU pinning for tests and driver dry runs.
+
+Single home for the relay workaround shared by `tests/conftest.py` and
+`__graft_entry__.dryrun_multichip`: steer jax to an n-device virtual CPU
+backend and away from the remote TPU relay, BEFORE the first backend init.
+
+Why each knob (see tests/conftest.py for the fuller story):
+  * PALLAS_AXON_POOL_IPS="" — the axon sitecustomize registers a remote TPU
+    PJRT plugin in every python process when this is set; a wedged relay then
+    hangs the backend handshake. Clearing it here is belt-and-braces (the
+    sitecustomize runs at interpreter startup, before any of our code).
+  * JAX_PLATFORMS=cpu + jax.config.update — steer an already-imported jax to
+    the CPU backend.
+  * --xla_force_host_platform_device_count=n — fake an n-device mesh on one
+    host (SURVEY.md §4's "multi-node without a cluster" story).
+
+This module must stay importable without jax side effects: it imports only
+`os` at module level; jax is touched lazily inside the function.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def pin_cpu_devices(n_devices: int) -> None:
+    """Pin this process to a >= n_devices virtual CPU backend.
+
+    Safe to call more than once; raises if a conflicting (smaller) device
+    count was already baked into XLA_FLAGS by an earlier backend init.
+    """
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+        )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def assert_cpu_devices(n_devices: int) -> None:
+    """Fail fast (clearly) if the pin did not take effect — e.g. the backend
+    was already initialized on another platform before pin_cpu_devices ran."""
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform if devices else "none"
+    assert platform == "cpu" and len(devices) >= n_devices, (
+        f"hermetic CPU pin failed: platform={platform}, "
+        f"n_devices={len(devices)} (need >= {n_devices} cpu) — the jax "
+        "backend was initialized before pin_cpu_devices() could take effect"
+    )

@@ -13,16 +13,6 @@ can run. The settings below are belt-and-braces for when the relay is healthy:
 they steer an already-imported jax to CPU before the first backend init.
 """
 
-import os
+from mgproto_tpu.hermetic import pin_cpu_devices
 
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_cpu_devices(8)
