@@ -6,7 +6,20 @@ steady state: joint phase, mine loss on, memory enqueue on, and the EM update
 fully active every iteration (reference update_interval=1, model.py:171, with
 all 200 class queues full — the post-epoch-35 regime).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Both scoring paths are measured head to head (XLA matmul+top_k vs the fused
+Pallas density kernel) and reported separately; the headline value is the
+winner. An MFU estimate comes from the compiled step's XLA cost analysis
+divided by the chip's peak bf16 FLOPs.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+On unrecoverable failure it prints a JSON diagnostic line
+{"error", "attempts", "errors"} instead of a bare traceback.
+
+Fault tolerance: the TPU relay this environment tunnels through refuses or
+drops connections transiently (observed: `remote_compile: Connection refused`
+mid-run after a successful backend init). Every measurement is wrapped in
+retry-with-exponential-backoff, and each scoring path fails independently so
+one broken path cannot zero out the whole bench.
 
 `vs_baseline` compares against an ESTIMATED single-A100 throughput of the
 reference PyTorch implementation (never measured in-repo, BASELINE.md:
@@ -14,27 +27,65 @@ reference PyTorch implementation (never measured in-repo, BASELINE.md:
 bounded in practice by the reference's python-loop memory enqueue
 (reference model.py:228-252) and python-loop EM over 200 classes
 (model.py:281-298). The driver north star is >=6x that on a v5e-8
-(BASELINE.json.north_star); this bench runs on ONE chip.
+(BASELINE.json.north_star); this bench runs on ONE chip, so the per-chip
+share of the north star is 6*350/8 = 262.5 img/s/chip.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 A100_EST_IMAGES_PER_SEC = 350.0
+NORTH_STAR_PER_CHIP = 6 * A100_EST_IMAGES_PER_SEC / 8  # v5e-8 star, per chip
 
-BATCH = 80
-WARMUP = 3
-ITERS = 10
+# env overrides exist so CI can smoke-test the harness at toy sizes on CPU;
+# the driver runs the defaults (flagship shapes) on the real chip
+BATCH = int(os.environ.get("BENCH_BATCH", 80))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
+ITERS = int(os.environ.get("BENCH_ITERS", 10))
+
+MAX_ATTEMPTS = 6
+BACKOFF_S = (5, 10, 20, 40, 60)  # >= 5 attempts spread over >= 2 minutes
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900))
+DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", 2400))  # whole-run cap
+_START = time.monotonic()
+
+# Each measurement attempt runs in a CHILD process: SIGALRM cannot interrupt a
+# native PJRT call blocked on a wedged relay (python signal handlers only run
+# at bytecode boundaries), and a half-initialized backend poisons every later
+# in-process attempt. A subprocess gives a hard kill on hang and a fresh
+# backend per retry.
+
+# peak dense bf16 FLOP/s by TPU generation (public spec sheets)
+_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
 
 
-def run_config(fused: bool) -> float:
-    """Steady-state images/sec for one scoring-path configuration."""
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return 197e12  # default to v5e-class
+
+
+def run_config(fused: bool) -> dict:
+    """Steady-state throughput for one scoring path. Returns
+    {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from mgproto_tpu.config import Config, ModelConfig
     from mgproto_tpu.engine.train import Trainer
 
@@ -45,8 +96,6 @@ def run_config(fused: bool) -> float:
             pretrained=False,
             # bf16 trunk on the MXU; params/BN-stats/density/losses stay f32
             compute_dtype="bfloat16",
-            # XLA matmul+top_k vs the fused Pallas kernel — measured head to
-            # head below, best wins
             fused_scoring=fused,
         )
     )
@@ -76,10 +125,29 @@ def run_config(fused: bool) -> float:
         host.randint(0, cfg.model.num_classes, size=(BATCH,)), jnp.int32
     )
 
+    # ONE compile, used for both the timed loop and the MFU cost analysis
+    # (AOT executables are not inserted into the jit dispatch cache, so mixing
+    # lower().compile() with trainer.train_step would compile twice).
+    use_mine_arr = jnp.asarray(1.0, jnp.float32)
+    update_gmm_arr = jnp.asarray(True, bool)
+    compiled = trainer._train_step.lower(
+        state, images, labels, use_mine_arr, update_gmm_arr, warm=False
+    ).compile()
+
+    flops = None
+    try:  # best-effort: some PJRT plugins return no cost model
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            f = ca.get("flops")
+            if f and f > 0:
+                flops = float(f)
+    except Exception:
+        flops = None
+
     def step(s):
-        s, m = trainer.train_step(
-            s, images, labels, use_mine=True, update_gmm=True, warm=False
-        )
+        s, m = compiled(s, images, labels, use_mine_arr, update_gmm_arr)
         # keep EM active every iteration (enqueue alone re-marks only the
         # label classes)
         return s.replace(
@@ -89,7 +157,8 @@ def run_config(fused: bool) -> float:
     # NB: a host readback (device_get of a scalar) is the sync point; under
     # tunneled device platforms block_until_ready can return before the device
     # actually finishes, which inflates throughput ~1000x.
-    for _ in range(WARMUP):
+    metrics = None
+    for _ in range(max(WARMUP, 1)):  # >=1: the sync below needs a metrics
         state, metrics = step(state)
     float(jax.device_get(metrics.loss))
 
@@ -99,22 +168,110 @@ def run_config(fused: bool) -> float:
     float(jax.device_get(metrics.loss))
     int(jax.device_get(state.step))
     dt = time.perf_counter() - t0
-    return BATCH * ITERS / dt
+    return {
+        "imgs_per_sec": BATCH * ITERS / dt,
+        "step_time_s": dt / ITERS,
+        "flops_per_step": flops,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def robust_measure(fused: bool) -> tuple:
+    """(result dict or None, last error string or None, attempts used).
+
+    Retries with exponential backoff on ANY failure — the observed transients
+    (backend-init refusal, mid-run `remote_compile: Connection refused`
+    surfacing as JaxRuntimeError) are not reliably distinguishable from the
+    error type alone, and a false-positive retry only costs time. Each attempt
+    is a fresh child process (see the note by ATTEMPT_TIMEOUT_S)."""
+    last_err = None
+    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--measure",
+           "fused" if fused else "unfused"]
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S
+            )
+            if proc.returncode == 0 and proc.stdout.strip():
+                return (
+                    json.loads(proc.stdout.strip().splitlines()[-1]),
+                    None,
+                    attempt,
+                )
+            tail = (proc.stderr or proc.stdout or "").strip()[-600:]
+            last_err = f"child rc={proc.returncode}: {tail}"
+        except subprocess.TimeoutExpired:
+            last_err = (
+                f"attempt killed after {ATTEMPT_TIMEOUT_S}s (relay hang?)"
+            )
+        except Exception as e:
+            last_err = f"{type(e).__name__}: {e}"
+        print(f"[bench] attempt {attempt} failed: {last_err}", file=sys.stderr)
+        if time.monotonic() - _START > DEADLINE_S:
+            last_err += " [deadline exceeded, no more retries]"
+            return None, last_err, attempt
+        if attempt < MAX_ATTEMPTS:
+            time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
+    return None, last_err, MAX_ATTEMPTS
 
 
 def main() -> None:
-    value = max(run_config(fused=False), run_config(fused=True))
-    print(
-        json.dumps(
-            {
-                "metric": "mgproto_r34_cub_train_step_throughput",
-                "value": round(value, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(value / A100_EST_IMAGES_PER_SEC, 3),
-            }
+    results = {}
+    errors = {}
+    attempts_total = 0
+    for name, fused in (("unfused", False), ("fused", True)):
+        result, err, attempts = robust_measure(fused)
+        attempts_total += attempts
+        if result is not None:
+            results[name] = result
+        else:
+            errors[name] = err
+
+    if not results:
+        print(
+            json.dumps(
+                {
+                    "error": "all scoring paths failed after retries",
+                    "attempts": attempts_total,
+                    "errors": errors,
+                }
+            )
         )
-    )
+        raise SystemExit(1)
+
+    winner = max(results, key=lambda k: results[k]["imgs_per_sec"])
+    best = results[winner]
+    value = best["imgs_per_sec"]
+    flops = best["flops_per_step"]
+    peak = _peak_flops(best["device_kind"])
+    mfu = (flops / best["step_time_s"] / peak) if flops else None
+
+    out = {
+        "metric": "mgproto_r34_cub_train_step_throughput",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / A100_EST_IMAGES_PER_SEC, 3),
+        "winner": winner,
+        "unfused_imgs_per_sec": round(
+            results.get("unfused", {}).get("imgs_per_sec", 0.0), 2
+        ),
+        "fused_imgs_per_sec": round(
+            results.get("fused", {}).get("imgs_per_sec", 0.0), 2
+        ),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_step": flops,
+        "device_kind": best["device_kind"],
+        "north_star_frac_per_chip": round(value / NORTH_STAR_PER_CHIP, 3),
+        "attempts": attempts_total,
+    }
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--measure":
+        # child mode: one measurement, result JSON on the last stdout line
+        print(json.dumps(run_config(fused=(sys.argv[2] == "fused"))))
+    else:
+        main()

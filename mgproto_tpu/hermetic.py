@@ -27,8 +27,10 @@ import re
 def pin_cpu_devices(n_devices: int) -> None:
     """Pin this process to a >= n_devices virtual CPU backend.
 
-    Safe to call more than once; raises if a conflicting (smaller) device
-    count was already baked into XLA_FLAGS by an earlier backend init.
+    Safe to call more than once (a smaller existing device-count flag is
+    rewritten in place). NOTE: env rewrites are no-ops once the backend has
+    initialized — callers that must be certain follow up with
+    `assert_cpu_devices(n_devices)`.
     """
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     os.environ["JAX_PLATFORMS"] = "cpu"
