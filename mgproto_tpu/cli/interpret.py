@@ -98,7 +98,7 @@ def main(argv: Optional[list] = None) -> None:
         cfg = cfg.replace(model=_dc.replace(cfg.model, compute_dtype=ckpt_dtype))
 
     trainer = ShardedTrainer(cfg, steps_per_epoch=1)
-    state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
+    state = trainer.init_state(jax.random.PRNGKey(cfg.seed), for_restore=True)
     state = trainer.prepare(restore_checkpoint(path, state))
     print(f"loaded {path}")
 

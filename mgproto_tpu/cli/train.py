@@ -80,22 +80,26 @@ def run_training(
     log(f"devices: {jax.device_count()}  mesh: {dict(trainer.mesh.shape)}")
     log(f"steps/epoch: {steps_per_epoch}")
 
-    state = trainer.init_state(jax.random.PRNGKey(cfg.seed))
-    start_epoch = 0
+    resume_path = None
     if resume:
-        path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
-        if path:
-            meta = load_metadata(path) or {}
-            state = trainer.prepare(restore_checkpoint(path, state))
-            if meta.get("stage") == "prune":
-                log(f"run already complete ({path}); nothing to resume")
-                metrics.close()
-                log.close()
-                return state, float(meta.get("accuracy", 0.0))
-            start_epoch = int(meta.get("epoch", -1)) + 1
-            log(f"resumed {path} -> epoch {start_epoch}")
-        elif resume != "auto":
+        resume_path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
+        if not resume_path and resume != "auto":
             raise FileNotFoundError(resume)
+    # a restore target skips the pretrained trunk load (about to be overwritten)
+    state = trainer.init_state(
+        jax.random.PRNGKey(cfg.seed), for_restore=bool(resume_path)
+    )
+    start_epoch = 0
+    if resume_path:
+        meta = load_metadata(resume_path) or {}
+        state = trainer.prepare(restore_checkpoint(resume_path, state))
+        if meta.get("stage") == "prune":
+            log(f"run already complete ({resume_path}); nothing to resume")
+            metrics.close()
+            log.close()
+            return state, float(meta.get("accuracy", 0.0))
+        start_epoch = int(meta.get("epoch", -1)) + 1
+        log(f"resumed {resume_path} -> epoch {start_epoch}")
 
     img_dir = os.path.join(cfg.model_dir, "img")
     # persisted so eval/interpret adopt the training-time trunk numerics

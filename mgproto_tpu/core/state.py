@@ -133,10 +133,12 @@ def create_train_state(
     joint_tx: Optional[optax.GradientTransformation] = None,
     warm_tx: Optional[optax.GradientTransformation] = None,
     proto_tx: Optional[optax.GradientTransformation] = None,
+    for_restore: bool = False,
 ) -> Tuple[TrainState, MGProtoFeatures]:
     """Initialize model, GMM, memory and all optimizer states. Callers that
     already hold the model/transforms (engine.Trainer) pass them in so there
-    is exactly one construction site."""
+    is exactly one construction site. `for_restore=True` skips the pretrained
+    trunk load: the state is only a restore target."""
     m = cfg.model
     model = model or MGProtoFeatures(cfg=m)
     joint_tx = joint_tx or make_joint_optimizer(cfg, steps_per_epoch)
@@ -147,7 +149,21 @@ def create_train_state(
     dummy = jnp.zeros((1, m.img_size, m.img_size, 3), jnp.float32)
     variables = model.init(k_init, dummy, train=False)
 
-    params: Dict[str, Any] = {"net": variables["params"]}
+    net_params = dict(variables["params"])
+    batch_stats = dict(variables.get("batch_stats", {}))
+    if m.pretrained and not for_restore:
+        # reference model.py:492: every backbone starts from torchvision /
+        # BBN-iNat weights; converted once on host, cached as npz
+        from mgproto_tpu.models.pretrained import (
+            load_pretrained_trunk,
+            merge_pretrained_trunk,
+        )
+
+        net_params, batch_stats = merge_pretrained_trunk(
+            net_params, batch_stats, load_pretrained_trunk(m.arch)
+        )
+
+    params: Dict[str, Any] = {"net": net_params}
     if cfg.loss.aux_loss in PROXY_BASED:
         params["proxies"] = init_proxies(k_proxy, m.num_classes, m.sz_embedding)
 
@@ -157,7 +173,7 @@ def create_train_state(
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
-        batch_stats=variables.get("batch_stats", {}),
+        batch_stats=batch_stats,
         gmm=gmm,
         memory=memory,
         opt_state=joint_tx.init(params),

@@ -79,7 +79,10 @@ class Trainer:
         )
         self._eval_step = jax.jit(self._eval)
 
-    def init_state(self, rng: jax.Array) -> TrainState:
+    def init_state(self, rng: jax.Array, for_restore: bool = False) -> TrainState:
+        """`for_restore=True` builds a restore TARGET: skips the pretrained
+        trunk load (every weight is about to be overwritten by the orbax
+        restore, and eval hosts need not carry the torch .pth)."""
         state, _ = create_train_state(
             self.cfg,
             self.steps_per_epoch,
@@ -88,6 +91,7 @@ class Trainer:
             joint_tx=self.joint_tx,
             warm_tx=self.warm_tx,
             proto_tx=self.proto_tx,
+            for_restore=for_restore,
         )
         return state
 

@@ -1,0 +1,174 @@
+"""Pretrained-trunk wiring: locate a torch checkpoint, convert, cache, merge.
+
+The reference constructs every backbone with `pretrained=True`
+(/root/reference/model.py:492, resnet_features.py:228-252 — torchvision
+model-zoo weights, plus the BBN-iNaturalist R50 variant): CUB-class accuracy
+is unreachable from random init. This module is the production consumer of
+`models/convert.py`:
+
+    create_train_state(pretrained=True)
+      -> load_pretrained_trunk(arch)
+           1. converted-cache hit?   ~/.cache/mgproto_tpu/converted/{arch}.npz
+           2. else find a torch .pth in the search path, convert, write cache
+      -> merge_pretrained_trunk(...)  — swap the 'features' subtree of the
+         fresh init with the converted {params, batch_stats}
+
+Search path for .pth files (first hit wins):
+    $MGPROTO_PRETRAINED_DIR
+    $TORCH_HOME/hub/checkpoints        (default ~/.cache/torch/hub/checkpoints)
+    ~/.cache/mgproto_tpu/pretrained
+
+This environment has no egress, so there is deliberately NO download step:
+a missing checkpoint raises FileNotFoundError naming every directory
+searched and the filename patterns tried, which is the actionable message
+(drop the torchvision file in one of those dirs).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+from mgproto_tpu.models.convert import convert_backbone, load_torch_checkpoint
+
+# torchvision publishes files as "{arch}-{hash}.pth". Exception: this repo's
+# resnet50 IS the BBN-iNaturalist variant (layer4 has 4 blocks, reference
+# resnet_features.py:276-287), so plain torchvision resnet50 files are
+# deliberately NOT matched — their 3-block layer4 cannot populate this trunk
+# and would die deep in the converter instead of with an actionable error.
+_ARCH_PATTERNS = {
+    "resnet50": ["*BBN*iNaturalist*res50*.pth", "*iNat*res50*.pth"],
+}
+
+
+def _search_dirs() -> List[str]:
+    dirs = []
+    env = os.environ.get("MGPROTO_PRETRAINED_DIR")
+    if env:
+        dirs.append(env)
+    torch_home = os.environ.get(
+        "TORCH_HOME", os.path.join(os.path.expanduser("~"), ".cache", "torch")
+    )
+    dirs.append(os.path.join(torch_home, "hub", "checkpoints"))
+    dirs.append(
+        os.path.join(os.path.expanduser("~"), ".cache", "mgproto_tpu", "pretrained")
+    )
+    return dirs
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "MGPROTO_CONVERTED_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "mgproto_tpu", "converted"),
+    )
+
+
+def _patterns(arch: str) -> List[str]:
+    if arch in _ARCH_PATTERNS:
+        return _ARCH_PATTERNS[arch]
+    return [f"{arch}-*.pth", f"{arch}.pth"]
+
+
+def find_torch_checkpoint(arch: str) -> Optional[str]:
+    """First .pth on the search path matching this arch's filename patterns."""
+    for d in _search_dirs():
+        for pat in _patterns(arch):
+            hits = sorted(glob.glob(os.path.join(d, pat)))
+            if hits:
+                return hits[0]
+    return None
+
+
+def _flatten(tree: Dict) -> Dict[str, np.ndarray]:
+    return {
+        k: np.asarray(v) for k, v in flatten_dict(dict(tree), sep="/").items()
+    }
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    return unflatten_dict(flat, sep="/")
+
+
+def load_pretrained_trunk(arch: str, cache: bool = True) -> Dict[str, Any]:
+    """{'params': ..., 'batch_stats': ...} for the trunk, from the converted
+    cache or by converting a located torch checkpoint."""
+    cache_path = os.path.join(_cache_dir(), f"{arch}.npz")
+    if cache and os.path.exists(cache_path):
+        with np.load(cache_path) as z:
+            return _unflatten({k: z[k] for k in z.files})
+
+    pth = find_torch_checkpoint(arch)
+    if pth is None:
+        searched = "\n  ".join(_search_dirs())
+        pats = ", ".join(_patterns(arch))
+        note = ""
+        if arch == "resnet50":
+            note = (
+                "\nNOTE: this trunk is the BBN-iNaturalist R50 variant "
+                "(4-block layer4); plain torchvision resnet50 files are "
+                "incompatible and not accepted."
+            )
+        raise FileNotFoundError(
+            f"no pretrained checkpoint for {arch!r}: tried patterns [{pats}] "
+            f"in:\n  {searched}\n(this environment has no egress — place the "
+            f"torchvision/BBN .pth file in one of those directories, e.g. "
+            f"$MGPROTO_PRETRAINED_DIR){note}"
+        )
+    variables = convert_backbone(arch, load_torch_checkpoint(pth))
+    if cache:
+        os.makedirs(_cache_dir(), exist_ok=True)
+        # pid-unique tmp + atomic rename: concurrent processes (multi-host
+        # startup) may convert simultaneously without corrupting the cache
+        tmp = f"{cache_path}.{os.getpid()}.tmp.npz"  # .npz: savez must not append
+        np.savez(tmp, **_flatten(variables))
+        os.replace(tmp, cache_path)
+    return variables
+
+
+def merge_pretrained_trunk(
+    net_params: Dict[str, Any],
+    batch_stats: Dict[str, Any],
+    trunk: Dict[str, Any],
+    feature_key: str = "features",
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Replace the trunk subtree of a fresh init with converted weights.
+
+    Validates that the converted tree has exactly the structure+shapes the
+    model initialized — a mismatch means the arch and the checkpoint disagree
+    and must fail loudly, not train silently from a half-merged net."""
+
+    def _check(name: str, init_tree: Any, new_tree: Any) -> None:
+        init_flat = _flatten(init_tree)
+        new_flat = _flatten(new_tree)
+        if init_flat.keys() != new_flat.keys():
+            missing = sorted(init_flat.keys() - new_flat.keys())[:5]
+            extra = sorted(new_flat.keys() - init_flat.keys())[:5]
+            raise ValueError(
+                f"pretrained {name} tree mismatch: missing={missing} "
+                f"extra={extra}"
+            )
+        for k, v in init_flat.items():
+            if v.shape != new_flat[k].shape:
+                raise ValueError(
+                    f"pretrained {name}[{k}] shape {new_flat[k].shape} != "
+                    f"model's {v.shape}"
+                )
+
+    _check("params", net_params[feature_key], trunk["params"])
+    cast = lambda ref, new: jax.tree_util.tree_map(
+        lambda r, n: np.asarray(n, dtype=r.dtype), ref, new
+    )
+    net_params = dict(net_params)
+    net_params[feature_key] = cast(net_params[feature_key], trunk["params"])
+    new_stats = dict(batch_stats)
+    if trunk.get("batch_stats"):
+        _check("batch_stats", batch_stats[feature_key], trunk["batch_stats"])
+        new_stats[feature_key] = cast(
+            batch_stats[feature_key], trunk["batch_stats"]
+        )
+    return net_params, new_stats

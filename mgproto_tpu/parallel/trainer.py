@@ -121,8 +121,8 @@ class ShardedTrainer(Trainer):
             self._build_jits(sh)
         return jax.device_put(state, sh)
 
-    def init_state(self, rng: jax.Array) -> TrainState:
-        return self.prepare(super().init_state(rng))
+    def init_state(self, rng: jax.Array, for_restore: bool = False) -> TrainState:
+        return self.prepare(super().init_state(rng, for_restore=for_restore))
 
     def put_batch(self, batch: Any) -> Any:
         """Host batch -> data-sharded device arrays (multi-host aware)."""

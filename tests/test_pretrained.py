@@ -1,0 +1,167 @@
+"""Pretrained-trunk wiring: create_train_state(pretrained=True) must start
+from converted torch weights (reference model.py:492 constructs every
+backbone pretrained=True; resnet_features.py:228-252)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import Config, ModelConfig
+
+REFERENCE = "/root/reference"
+HAS_REFERENCE = os.path.isdir(os.path.join(REFERENCE, "models"))
+
+
+def _reference_trunk_state(tmp_path):
+    """Random-init reference torch trunk saved as a fake torchvision file."""
+    torch = pytest.importorskip("torch")
+    sys.path.insert(0, REFERENCE)
+    try:
+        from models import resnet_features
+
+        torch.manual_seed(0)
+        ref = resnet_features.resnet18_features(pretrained=False)
+    finally:
+        sys.path.remove(REFERENCE)
+    path = tmp_path / "resnet18-deadbeef.pth"
+    torch.save(ref.state_dict(), str(path))
+    return str(path), {k: v.numpy() for k, v in ref.state_dict().items()}
+
+
+def _env(monkeypatch, tmp_path):
+    monkeypatch.setenv("MGPROTO_PRETRAINED_DIR", str(tmp_path / "pth"))
+    monkeypatch.setenv("MGPROTO_CONVERTED_DIR", str(tmp_path / "converted"))
+    (tmp_path / "pth").mkdir(exist_ok=True)
+
+
+def _small_cfg() -> Config:
+    return Config(
+        model=ModelConfig(
+            arch="resnet18",
+            img_size=64,
+            num_classes=4,
+            prototypes_per_class=2,
+            proto_dim=8,
+            sz_embedding=8,
+            mine_T=4,
+            mem_capacity=8,
+            pretrained=True,
+        )
+    )
+
+
+@pytest.mark.skipif(not HAS_REFERENCE, reason="reference repo not mounted")
+def test_create_train_state_pretrained_loads_converted_trunk(
+    tmp_path, monkeypatch
+):
+    import jax
+
+    from mgproto_tpu.core.state import create_train_state
+    from mgproto_tpu.models.convert import convert_backbone
+
+    _env(monkeypatch, tmp_path)
+    pth, torch_state = _reference_trunk_state(tmp_path / "pth")
+
+    state, _ = create_train_state(_small_cfg(), 1, jax.random.PRNGKey(0))
+    want = convert_backbone("resnet18", torch_state)
+
+    got_p = jax.tree_util.tree_map(np.asarray, state.params["net"]["features"])
+    got_s = jax.tree_util.tree_map(np.asarray, state.batch_stats["features"])
+    for name, got, want_tree in (
+        ("params", got_p, want["params"]),
+        ("batch_stats", got_s, want["batch_stats"]),
+    ):
+        assert jax.tree_util.tree_structure(got) == jax.tree_util.tree_structure(
+            jax.tree_util.tree_map(np.asarray, want_tree)
+        ), name
+        for a, b in zip(
+            jax.tree_util.tree_leaves(got),
+            jax.tree_util.tree_leaves(want_tree),
+            strict=True,
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # head stays randomly initialized (only the trunk is pretrained)
+    assert "add_on" in state.params["net"]
+    # converted cache was written; a second load works with the .pth deleted
+    os.remove(pth)
+    state2, _ = create_train_state(_small_cfg(), 1, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(
+        np.asarray(
+            jax.tree_util.tree_leaves(state2.params["net"]["features"])[0]
+        ),
+        np.asarray(jax.tree_util.tree_leaves(got_p)[0]),
+    )
+
+
+def test_missing_checkpoint_raises_with_search_paths(tmp_path, monkeypatch):
+    import jax
+
+    from mgproto_tpu.core.state import create_train_state
+
+    _env(monkeypatch, tmp_path)
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    monkeypatch.setenv("HOME", str(tmp_path / "home"))  # ~/.cache fallback dir
+    with pytest.raises(FileNotFoundError) as e:
+        create_train_state(_small_cfg(), 1, jax.random.PRNGKey(0))
+    msg = str(e.value)
+    assert "resnet18" in msg and str(tmp_path / "pth") in msg
+
+
+def test_for_restore_skips_pretrained_load(tmp_path, monkeypatch):
+    """Restore targets (eval/resume) must not require the torch .pth."""
+    import jax
+
+    from mgproto_tpu.core.state import create_train_state
+
+    _env(monkeypatch, tmp_path)  # no .pth anywhere
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    monkeypatch.setenv("HOME", str(tmp_path / "home"))
+    state, _ = create_train_state(
+        _small_cfg(), 1, jax.random.PRNGKey(0), for_restore=True
+    )
+    assert "features" in state.params["net"]
+
+
+def test_resnet50_only_accepts_bbn_inat_files(tmp_path, monkeypatch):
+    """This repo's resnet50 is the BBN-iNat [3,4,6,4] variant (reference
+    resnet_features.py:276-287): plain torchvision resnet50 files have a
+    3-block layer4 the converter can never map, so they must be REJECTED at
+    the search stage with an actionable message, not die in the converter."""
+    from mgproto_tpu.models.pretrained import find_torch_checkpoint
+
+    _env(monkeypatch, tmp_path)
+    monkeypatch.setenv("HOME", str(tmp_path / "home"))
+    d = tmp_path / "pth"
+    (d / "resnet50-11ad3fa6.pth").write_bytes(b"")  # plain torchvision
+    assert find_torch_checkpoint("resnet50") is None
+    (d / "BBN.iNaturalist2017.res50.180epoch.best_model.pth").write_bytes(b"")
+    hit = find_torch_checkpoint("resnet50")
+    assert "iNaturalist" in hit
+
+
+def test_trunk_shape_mismatch_fails_loudly(tmp_path, monkeypatch):
+    """A checkpoint for the wrong arch must raise, not half-merge."""
+    import jax
+
+    from mgproto_tpu.core.state import create_train_state
+    from mgproto_tpu.models.pretrained import merge_pretrained_trunk
+
+    cfg = _small_cfg().replace(
+        model=ModelConfig(
+            arch="resnet18", img_size=64, num_classes=4,
+            prototypes_per_class=2, proto_dim=8, sz_embedding=8, mine_T=4,
+            mem_capacity=8, pretrained=False,
+        )
+    )
+    state, _ = create_train_state(cfg, 1, jax.random.PRNGKey(0))
+    trunk = {
+        "params": {"bogus": np.zeros((1,))},
+        "batch_stats": {},
+    }
+    with pytest.raises(ValueError, match="tree mismatch"):
+        merge_pretrained_trunk(
+            dict(state.params["net"]), dict(state.batch_stats), trunk
+        )
