@@ -83,8 +83,8 @@ def run_training(
     resume_path = None
     if resume:
         resume_path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
-        if not resume_path and resume != "auto":
-            raise FileNotFoundError(resume)
+        if resume != "auto" and not os.path.exists(resume_path):
+            raise FileNotFoundError(resume_path)
     # a restore target skips the pretrained trunk load (about to be overwritten)
     state = trainer.init_state(
         jax.random.PRNGKey(cfg.seed), for_restore=bool(resume_path)

@@ -96,13 +96,24 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
 
 def load_pretrained_trunk(arch: str, cache: bool = True) -> Dict[str, Any]:
     """{'params': ..., 'batch_stats': ...} for the trunk, from the converted
-    cache or by converting a located torch checkpoint."""
+    cache or by converting a located torch checkpoint.
+
+    The cache records its source .pth path+mtime and is invalidated when the
+    currently-resolved source differs — replacing the checkpoint file must
+    not silently train from stale converted weights."""
     cache_path = os.path.join(_cache_dir(), f"{arch}.npz")
+    pth = find_torch_checkpoint(arch)
     if cache and os.path.exists(cache_path):
         with np.load(cache_path) as z:
-            return _unflatten({k: z[k] for k in z.files})
-
-    pth = find_torch_checkpoint(arch)
+            src = str(z["__source__"]) if "__source__" in z.files else ""
+            mtime = float(z["__mtime__"]) if "__mtime__" in z.files else -1.0
+            fresh = pth is None or (
+                src == pth and abs(mtime - os.path.getmtime(pth)) < 1e-6
+            )
+            if fresh:
+                return _unflatten(
+                    {k: z[k] for k in z.files if not k.startswith("__")}
+                )
     if pth is None:
         searched = "\n  ".join(_search_dirs())
         pats = ", ".join(_patterns(arch))
@@ -125,7 +136,12 @@ def load_pretrained_trunk(arch: str, cache: bool = True) -> Dict[str, Any]:
         # pid-unique tmp + atomic rename: concurrent processes (multi-host
         # startup) may convert simultaneously without corrupting the cache
         tmp = f"{cache_path}.{os.getpid()}.tmp.npz"  # .npz: savez must not append
-        np.savez(tmp, **_flatten(variables))
+        np.savez(
+            tmp,
+            __source__=np.asarray(pth),
+            __mtime__=np.asarray(os.path.getmtime(pth)),
+            **_flatten(variables),
+        )
         os.replace(tmp, cache_path)
     return variables
 

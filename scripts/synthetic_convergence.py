@@ -1,0 +1,162 @@
+"""Convergence evidence: train the FULL pipeline on a generated dataset.
+
+This environment ships neither CUB-200 nor pretrained weights (zero egress),
+so paper-scale accuracy cannot be reproduced here. What CAN be demonstrated —
+and what this script produces — is end-to-end training evidence on the real
+driver (`cli.train.run_training`): warm→joint phases, mine loss, memory-bank
+fill, EM prototype learning, push projection, and top-M pruning, with test
+accuracy climbing from chance to near-perfect on a separable synthetic
+ImageFolder. Artifacts (metrics.jsonl + summary) land in --out for the repo's
+evidence/ directory.
+
+Usage:  python scripts/synthetic_convergence.py --out evidence/synthetic \
+            [--workdir /tmp/mgproto_synth] [--epochs 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+
+import numpy as np
+
+
+def make_dataset(root: str, num_classes: int, per_class: int, test_per_class: int,
+                 img: int = 64, seed: int = 0) -> None:
+    """Class-separable synthetic ImageFolder: each class is a distinct
+    oriented sinusoidal texture + tinted blob, plus per-image noise/jitter."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    for split, n in (("train", per_class), ("test", test_per_class)):
+        for c in range(num_classes):
+            d = os.path.join(root, split, f"class_{c:03d}")
+            os.makedirs(d, exist_ok=True)
+            angle = np.pi * c / num_classes
+            freq = 2.0 + 1.5 * (c % 4)
+            tint = np.array(
+                [
+                    0.5 + 0.5 * np.cos(2 * np.pi * c / num_classes),
+                    0.5 + 0.5 * np.sin(2 * np.pi * c / num_classes),
+                    0.5 + 0.5 * np.cos(2 * np.pi * c / num_classes + 2.0),
+                ]
+            )
+            yy, xx = np.mgrid[0:img, 0:img] / img
+            for i in range(n):
+                phase = rng.uniform(0, 2 * np.pi)
+                wave = np.sin(
+                    2 * np.pi * freq * (np.cos(angle) * xx + np.sin(angle) * yy)
+                    + phase
+                )
+                cx, cy = rng.uniform(0.3, 0.7, size=2)
+                blob = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 0.02))
+                base = 0.45 + 0.25 * wave[..., None] * tint + 0.3 * blob[..., None] * tint
+                noisy = base + rng.normal(0, 0.06, size=(img, img, 3))
+                arr = (np.clip(noisy, 0, 1) * 255).astype(np.uint8)
+                Image.fromarray(arr).save(os.path.join(d, f"{i:04d}.png"))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="evidence/synthetic")
+    p.add_argument("--workdir", default="/tmp/mgproto_synth")
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--per_class", type=int, default=40)
+    args = p.parse_args()
+
+    from mgproto_tpu.hermetic import pin_cpu_devices
+
+    pin_cpu_devices(1)  # evidence runs hermetically; TPU relay not required
+
+    from mgproto_tpu.cli.train import run_training
+    from mgproto_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        ScheduleConfig,
+    )
+
+    data_root = os.path.join(args.workdir, "data")
+    model_dir = os.path.join(args.workdir, "run")
+    shutil.rmtree(args.workdir, ignore_errors=True)
+    make_dataset(data_root, args.classes, args.per_class, test_per_class=16)
+
+    cfg = Config(
+        model=ModelConfig(
+            arch="tiny",
+            img_size=64,
+            num_classes=args.classes,
+            prototypes_per_class=5,
+            proto_dim=16,
+            sz_embedding=8,
+            mine_T=4,
+            mem_capacity=64,
+            pretrained=False,
+        ),
+        schedule=ScheduleConfig(
+            num_train_epochs=args.epochs,
+            num_warm_epochs=1,
+            mine_start=2,
+            update_gmm_start=2,
+            # proportional to the reference's 100/120-epoch push schedule and
+            # its 8-of-10 prune (settings.py:51-52, main.py:285)
+            push_start=max(int(args.epochs * 0.8), 1),
+            push_every=5,
+            prune_top_m=4,
+        ),
+        data=DataConfig(
+            dataset="synthetic",
+            train_dir=os.path.join(data_root, "train"),
+            test_dir=os.path.join(data_root, "test"),
+            train_push_dir=os.path.join(data_root, "train"),
+            ood_dirs=(),
+            train_batch_size=16,
+            test_batch_size=32,
+            train_push_batch_size=32,
+            num_workers=2,
+        ),
+        model_dir=model_dir,
+    )
+
+    _, accuracy = run_training(cfg, render_push=False, target_accu=0.3)
+
+    os.makedirs(args.out, exist_ok=True)
+    shutil.copy(
+        os.path.join(model_dir, "metrics.jsonl"),
+        os.path.join(args.out, "metrics.jsonl"),
+    )
+    # trajectory + best pre-push accuracy (the reference's own headline
+    # number, R50_104nopush0.8224, is a NOPUSH checkpoint: eval_purity.py:55)
+    trajectory, by_stage = [], {}
+    with open(os.path.join(model_dir, "metrics.jsonl")) as f:
+        for line in f:
+            row = json.loads(line)
+            if "acc" in row:
+                trajectory.append(round(row["acc"], 4))
+                by_stage.setdefault(row.get("stage", "nopush"), []).append(
+                    round(row["acc"], 4)
+                )
+    summary = {
+        "what": "full-pipeline convergence on separable synthetic ImageFolder",
+        "driver": "mgproto_tpu.cli.train.run_training (warm/joint, mine, EM, "
+                  "push, prune all exercised)",
+        "arch": "tiny",
+        "classes": args.classes,
+        "epochs": args.epochs,
+        "chance_accuracy": 1.0 / args.classes,
+        "best_nopush_test_accuracy": max(by_stage.get("nopush", [0.0])),
+        "post_push_test_accuracy": by_stage.get("push", []),
+        "post_prune_test_accuracy": by_stage.get("prune", []),
+        "final_test_accuracy": accuracy,
+        "test_accuracy_trajectory": trajectory,
+    }
+    with open(os.path.join(args.out, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -78,3 +78,57 @@ def test_clear_updated():
     mem = _push_np(mem, [[1.0]], [0])
     mem = clear_updated(mem)
     assert not np.asarray(mem.updated).any()
+
+
+def test_overflow_onto_full_bank_replaces_with_batch_head():
+    """Single push larger than capacity onto a FULL bank: every old entry is
+    evicted and the retained set is a capacity-subset of the batch (reference
+    memory.py:51-53,60-62 keeps a RANDOM capacity-subset and overwrites the
+    whole buffer; ours keeps the deterministic batch head — same cardinality,
+    same subset-of-batch contract, jit-friendly)."""
+    mem = init_memory(num_classes=1, capacity=3, dim=1)
+    mem = _push_np(mem, [[10.0], [11.0], [12.0]], [0] * 3)  # fill
+    assert _stored_set(mem, 0) == {(10.0,), (11.0,), (12.0,)}
+    mem = _push_np(mem, [[float(v)] for v in range(5)], [0] * 5)  # overflow
+    assert np.asarray(mem.length).tolist() == [3]
+    assert _stored_set(mem, 0) == {(0.0,), (1.0,), (2.0,)}  # batch head only
+
+
+def test_partial_fill_plus_overflowing_push_keeps_newest():
+    """L + B > cap with B < cap (reference memory.py:66: keep the LAST cap of
+    concat(existing, batch)): newest existing entries survive, oldest are
+    evicted, all batch rows kept."""
+    mem = init_memory(num_classes=1, capacity=4, dim=1)
+    mem = _push_np(mem, [[0.0], [1.0], [2.0]], [0] * 3)  # L=3
+    mem = _push_np(mem, [[10.0], [11.0], [12.0]], [0] * 3)  # B=3 -> evict 2
+    assert np.asarray(mem.length).tolist() == [4]
+    assert _stored_set(mem, 0) == {(2.0,), (10.0,), (11.0,), (12.0,)}
+
+
+def test_fifo_retained_set_matches_reference_oracle():
+    """Randomized push sequences (per-class batch sizes <= cap, so the
+    reference's random-subsample branch never fires): after every push the
+    retained SET per class must equal a numpy oracle of the reference's
+    shift-FIFO (memory.py:56-67, 'last cap of concat(existing, batch)')."""
+    rng = np.random.RandomState(0)
+    C, CAP = 3, 5
+    mem = init_memory(num_classes=C, capacity=CAP, dim=1)
+    oracle = [[] for _ in range(C)]  # left-compacted lists, newest at tail
+    counter = 0.0
+    for _ in range(20):
+        n = rng.randint(1, 2 * C + 1)
+        classes = rng.randint(0, C, size=n)
+        # cap per-class batch counts at CAP (keeps the oracle deterministic)
+        for c in range(C):
+            idx = np.where(classes == c)[0]
+            classes[idx[CAP:]] = -1  # dropped as invalid
+        feats = np.arange(counter, counter + n, dtype=np.float32)[:, None]
+        counter += n
+        mem = _push_np(mem, feats, classes, valid=classes >= 0)
+        for c in range(C):
+            batch_c = [tuple(f) for f, cc in zip(feats, classes) if cc == c]
+            oracle[c] = (oracle[c] + batch_c)[-CAP:]  # reference retained set
+        for c in range(C):
+            assert _stored_set(mem, c) == set(map(tuple, np.round(oracle[c], 4))), (
+                f"class {c} diverged from reference FIFO"
+            )

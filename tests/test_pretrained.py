@@ -96,6 +96,33 @@ def test_create_train_state_pretrained_loads_converted_trunk(
     )
 
 
+@pytest.mark.skipif(not HAS_REFERENCE, reason="reference repo not mounted")
+def test_replaced_pth_invalidates_converted_cache(tmp_path, monkeypatch):
+    """Swapping the source .pth must trigger reconversion, not a stale-cache
+    hit (cache records source path+mtime)."""
+    import os as _os
+
+    import torch
+
+    from mgproto_tpu.models.pretrained import load_pretrained_trunk
+
+    _env(monkeypatch, tmp_path)
+    monkeypatch.setenv("HOME", str(tmp_path / "home"))
+    pth, torch_state = _reference_trunk_state(tmp_path / "pth")
+    first = load_pretrained_trunk("resnet18")
+
+    new_state = {
+        k: torch.from_numpy(np.asarray(v)) for k, v in torch_state.items()
+    }
+    new_state["conv1.weight"] = new_state["conv1.weight"] + 1.0
+    torch.save(new_state, pth)
+    _os.utime(pth, (_os.path.getmtime(pth) + 10, _os.path.getmtime(pth) + 10))
+    second = load_pretrained_trunk("resnet18")
+    a = np.asarray(first["params"]["conv1"]["kernel"])
+    b = np.asarray(second["params"]["conv1"]["kernel"])
+    np.testing.assert_array_equal(b, a + 1.0)
+
+
 def test_missing_checkpoint_raises_with_search_paths(tmp_path, monkeypatch):
     import jax
 
