@@ -12,9 +12,11 @@ host-side idioms that are trivial on one host need care:
     equal shapes: every process runs the same number of identically padded
     batches, data/loader.py).
 
-Everything degenerates to a no-op/device_get on a single process, which is
-how the test suite exercises the call sites (a real pod exercises the other
-branch; no multi-process simulation exists in CI).
+Everything degenerates to a no-op/device_get on a single process. The REAL
+branches are exercised in CI by tests/test_multiprocess.py: two coordinated
+`jax.distributed` CPU processes (4 virtual devices each) drive allgather,
+put_batch, fetch_replicated, a sharded train step, and the loader's
+shard_index>0 path end to end.
 
 Reference: none — the reference is single-process (SURVEY.md §2.3); this is
 the scaffolding its NCCL/torch.distributed story never grew.

@@ -13,6 +13,11 @@ can run. The settings below are belt-and-braces for when the relay is healthy:
 they steer an already-imported jax to CPU before the first backend init.
 """
 
-from mgproto_tpu.hermetic import pin_cpu_devices
+import os
 
-pin_cpu_devices(8)
+if os.environ.get("MGPROTO_TEST_TPU") != "1":
+    from mgproto_tpu.hermetic import pin_cpu_devices
+
+    pin_cpu_devices(8)
+# MGPROTO_TEST_TPU=1 skips the pin so tests/test_tpu_execution.py can reach a
+# real chip: MGPROTO_TEST_TPU=1 python -m pytest tests/test_tpu_execution.py

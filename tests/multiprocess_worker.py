@@ -1,0 +1,136 @@
+"""Worker for tests/test_multiprocess.py: one jax.distributed CPU process.
+
+Run as:  python tests/multiprocess_worker.py <pid> <nprocs> <port> <data_dir>
+
+Exercises the REAL multi-process branches that single-process CI can only
+no-op through (parallel/multihost.py, sharding.put_batch's
+make_array_from_process_local_data path, the loader's shard_index>0 slices):
+each check prints a CHECK line; the parent asserts on them plus rc=0.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    pid, nprocs, port, data_dir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.device_count() == 4 * nprocs, jax.device_count()
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    from mgproto_tpu.parallel.multihost import (
+        allgather_rows,
+        allgather_sum,
+        host_local_rows,
+    )
+
+    # --- allgather_rows / allgather_sum real (cross-process) branches
+    local = np.full((2, 3), pid, np.float32)
+    g = allgather_rows(local)
+    assert g.shape == (2 * nprocs, 3), g.shape
+    for p in range(nprocs):
+        assert (g[2 * p : 2 * p + 2] == p).all(), g
+    assert allgather_sum(float(pid + 1)) == float(
+        sum(range(1, nprocs + 1))
+    )
+    print(f"CHECK allgather ok pid={pid}", flush=True)
+
+    # --- put_batch (make_array_from_process_local_data) + host_local_rows
+    from mgproto_tpu.parallel.mesh import make_mesh
+    from mgproto_tpu.parallel.sharding import put_batch
+
+    mesh = make_mesh(data=2 * nprocs, model=2)
+    local_rows = np.arange(4, dtype=np.float32).reshape(4, 1) + 100.0 * pid
+    global_arr = put_batch(local_rows, mesh)
+    assert global_arr.shape == (4 * nprocs, 1)
+    assert not global_arr.is_fully_addressable
+    back = host_local_rows(global_arr)
+    np.testing.assert_array_equal(back, local_rows)
+    print(f"CHECK put_batch/host_local_rows ok pid={pid}", flush=True)
+
+    # --- fetch_replicated: cross-host sharded tree -> full host copy
+    from mgproto_tpu.parallel.multihost import fetch_replicated
+
+    full = fetch_replicated(global_arr, mesh=mesh)
+    assert full.shape == (4 * nprocs, 1)
+    np.testing.assert_array_equal(full[4 * pid : 4 * pid + 4], local_rows)
+    print(f"CHECK fetch_replicated ok pid={pid}", flush=True)
+
+    # --- one REAL sharded train step over the global 2-process mesh
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.parallel import ShardedTrainer
+
+    cfg = tiny_test_config()
+    trainer = ShardedTrainer(cfg, steps_per_epoch=2, mesh=mesh)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(pid)  # per-process local shard of the batch
+    images = rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3).astype(
+        np.float32
+    )
+    labels = rng.randint(0, cfg.model.num_classes, size=(4,)).astype(np.int32)
+    state, m = trainer.train_step(
+        state, images, labels, use_mine=True, update_gmm=True, warm=False
+    )
+    loss = float(jax.device_get(m.loss))
+    assert np.isfinite(loss), loss
+    out = trainer.eval_step(state, images)
+    jax.block_until_ready(out)
+    # SPMD determinism: every process computes the identical global loss
+    losses = allgather_rows(np.asarray([[loss]], np.float32))
+    assert np.allclose(losses, losses[0]), losses
+    print(f"CHECK sharded_step ok pid={pid} loss={loss:.4f}", flush=True)
+
+    # --- loader shard_index>0: disjoint per-process slices covering the set
+    from mgproto_tpu.data import DataLoader, ImageFolder
+    from mgproto_tpu.data.transforms import test_transform
+
+    ds = ImageFolder(data_dir, test_transform(32))
+    loader = DataLoader(
+        ds,
+        batch_size=4,
+        num_workers=2,
+        shard_index=pid,
+        shard_count=nprocs,
+    )
+    ids = np.concatenate([b[2] for b in loader])
+    ids = ids[ids >= 0]  # drop sentinel padding
+    # allgather_rows requires equal shapes: pad local ids to dataset size
+    # (shards may carry different numbers of real rows on the last span)
+    padded = np.full((len(ds), 1), -1, np.int64)
+    padded[: len(ids), 0] = ids
+    all_ids = allgather_rows(padded).ravel()
+    all_ids = all_ids[all_ids >= 0]
+    assert len(set(all_ids.tolist())) == len(all_ids), "shards overlap"
+    assert set(all_ids.tolist()) == set(range(len(ds))), "shards missed rows"
+    print(f"CHECK loader_shard ok pid={pid} rows={len(ids)}", flush=True)
+
+    print(f"WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Two-process jax.distributed CI (SURVEY.md §4's "multi-node without a
+cluster"): launches 2 coordinated CPU processes (4 virtual devices each) and
+drives the REAL multi-process branches of parallel/multihost.py,
+sharding.put_batch, ShardedTrainer, and the loader's shard_index>0 path —
+all of which single-process CI can only exercise as identity no-ops
+(multihost.py:15-17)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multiprocess_worker.py")
+NPROCS = 2
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _make_dataset(root) -> int:
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    n = 0
+    for c in range(2):
+        d = os.path.join(root, f"class_{c}")
+        os.makedirs(d)
+        for i in range(9):  # 18 total: odd vs batch*shards -> padding path too
+            arr = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.png"))
+            n += 1
+    return n
+
+
+def test_two_process_distributed_end_to_end(tmp_path):
+    data_dir = str(tmp_path / "data")
+    n = _make_dataset(data_dir)
+    assert n == 18
+    port = _free_port()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the worker pins its own 4-device CPU backend; scrub any inherited pin
+    env.pop("XLA_FLAGS", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", WORKER, str(pid), str(NPROCS), str(port),
+             data_dir],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        for pid in range(NPROCS)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} rc={p.returncode}\n{out[-3000:]}"
+        for check in ("allgather", "put_batch/host_local_rows",
+                      "fetch_replicated", "sharded_step", "loader_shard"):
+            assert f"CHECK {check} ok pid={pid}" in out, (
+                f"worker {pid} missing {check}\n{out[-3000:]}"
+            )
+        assert f"WORKER_OK {pid}" in out

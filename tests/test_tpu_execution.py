@@ -1,0 +1,128 @@
+"""On-hardware execution checks (skipped off-TPU; CI proves lowering only —
+tests/test_tpu_lowering.py — and interpret-mode numerics; THIS file is the
+proof the Mosaic kernel actually executes and agrees on a real chip).
+
+Run on a TPU host:  MGPROTO_TEST_TPU=1 python -m pytest tests/test_tpu_execution.py
+(the flag stops conftest.py from pinning the suite to the virtual CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+requires_tpu = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="needs a real TPU backend"
+)
+
+
+def _flagship_shapes():
+    """R34-CUB flagship head shapes (reference settings.py:1-5, 14x14 latent
+    grid per models/resnet.py conv_info): B=8 keeps the density matrix
+    [B*196, 2000] real while the test stays seconds-fast."""
+    rng = np.random.RandomState(0)
+    b, hw, d, c, k, t = 8, 196, 64, 200, 10, 20
+    feat = rng.normal(size=(b, hw, d)).astype(np.float32)
+    feat /= np.linalg.norm(feat, axis=-1, keepdims=True)
+    means = rng.normal(size=(c, k, d)).astype(np.float32)
+    means /= np.linalg.norm(means, axis=-1, keepdims=True)
+    sigmas = np.full((c, k, d), 1.0 / np.sqrt(2 * np.pi), np.float32)
+    return jnp.asarray(feat), jnp.asarray(means), jnp.asarray(sigmas), t
+
+
+@requires_tpu
+def test_fused_kernel_matches_unfused_on_device():
+    """Mosaic execution == XLA matmul+top_k numerics at flagship shapes
+    (values bit-domain f32; indices may differ only where densities tie)."""
+    from mgproto_tpu.ops.fused_scoring import score_pool
+    from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob
+
+    feat, means, sigmas, t = _flagship_shapes()
+    b, hw, d = feat.shape
+
+    vals_f, idx_f = jax.jit(
+        lambda f: score_pool(f, means, sigmas, t, 1e-10, False)
+    )(feat)
+
+    def unfused(f):
+        lp = diag_gaussian_log_prob(f.reshape(-1, d), means, sigmas)
+        lp = lp.reshape(b, hw, -1).transpose(0, 2, 1)  # [B, P, HW]
+        return jax.lax.top_k(lp, t)
+
+    vals_u, idx_u = jax.jit(unfused)(feat)
+    np.testing.assert_allclose(
+        np.asarray(vals_f), np.asarray(vals_u), rtol=1e-5, atol=1e-5
+    )
+    # indices: compare via gathered values (ties may legally reorder)
+    lp_full = np.asarray(
+        jax.jit(lambda f: unfused(f)[0])(feat)
+    )
+    np.testing.assert_allclose(
+        np.asarray(vals_f), lp_full, rtol=1e-5, atol=1e-5
+    )
+
+
+def _backward_parity(interpret: bool):
+    """Shared by the TPU test and the CPU (interpret-mode) regression test.
+
+    Gradient ROUTING follows the selected indices, and near-equal densities
+    at the top-T boundary may legally swap between the kernel and XLA top_k
+    (both selections are valid within float error), which makes elementwise
+    gradient comparison at T < HW inherently tie-fragile. Running with
+    T = HW selects every patch, so the gradient is selection-independent and
+    compares the VJP math + kernel numerics alone; the strict forward test
+    above covers top-T selection values."""
+    from mgproto_tpu.ops.fused_scoring import score_pool
+    from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob
+
+    feat, means, sigmas, _ = _flagship_shapes()
+    b, hw, d = feat.shape
+    t = hw
+
+    def loss_fused(f):
+        v, _ = score_pool(f, means, sigmas, t, 1e-10, interpret)
+        return jnp.sum(v)
+
+    def loss_unfused(f):
+        lp = diag_gaussian_log_prob(f.reshape(-1, d), means, sigmas)
+        v, _ = jax.lax.top_k(lp.reshape(b, hw, -1).transpose(0, 2, 1), t)
+        return jnp.sum(v)
+
+    g_f = np.asarray(jax.jit(jax.grad(loss_fused))(feat))
+    g_u = np.asarray(jax.jit(jax.grad(loss_unfused))(feat))
+    np.testing.assert_allclose(g_f, g_u, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_backward_parity_interpret_cpu():
+    _backward_parity(interpret=jax.default_backend() != "tpu")
+
+
+@requires_tpu
+def test_fused_kernel_backward_matches_on_device():
+    _backward_parity(interpret=False)
+
+
+@requires_tpu
+def test_full_train_step_runs_on_device():
+    """One bf16 fused-scoring train step on the chip: finite loss."""
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+
+    cfg = tiny_test_config()
+    cfg = cfg.replace(
+        model=cfg.model.__class__(
+            **{**cfg.model.__dict__, "compute_dtype": "bfloat16",
+               "fused_scoring": True}
+        )
+    )
+    trainer = Trainer(cfg, steps_per_epoch=2)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(
+        rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3), jnp.float32
+    )
+    labels = jnp.asarray(rng.randint(0, cfg.model.num_classes, 4), jnp.int32)
+    state, m = trainer.train_step(
+        state, imgs, labels, use_mine=True, update_gmm=True, warm=False
+    )
+    assert np.isfinite(float(jax.device_get(m.loss)))
