@@ -20,4 +20,7 @@ if os.environ.get("MGPROTO_TEST_TPU") != "1":
 
     pin_cpu_devices(8)
 # MGPROTO_TEST_TPU=1 skips the pin so tests/test_tpu_execution.py can reach a
-# real chip: MGPROTO_TEST_TPU=1 python -m pytest tests/test_tpu_execution.py
+# real chip. The pin (and therefore the flag) is PROCESS-WIDE: a jax process
+# is either on the virtual CPU mesh or on the TPU, never both, so under the
+# flag run ONLY that file — the rest of the suite requires the 8-device pin:
+#   MGPROTO_TEST_TPU=1 python -m pytest tests/test_tpu_execution.py

@@ -53,12 +53,18 @@ def test_fused_kernel_matches_unfused_on_device():
     np.testing.assert_allclose(
         np.asarray(vals_f), np.asarray(vals_u), rtol=1e-5, atol=1e-5
     )
-    # indices: compare via gathered values (ties may legally reorder)
-    lp_full = np.asarray(
-        jax.jit(lambda f: unfused(f)[0])(feat)
-    )
+    # indices: ties may legally reorder between implementations, so validate
+    # idx_f by GATHERING the densities it points at — they must reproduce the
+    # returned values (catches correct-values-garbage-indices regressions,
+    # which would corrupt push projection and mining)
+    def full_densities(f):
+        lp = diag_gaussian_log_prob(f.reshape(-1, d), means, sigmas)
+        return lp.reshape(b, hw, -1).transpose(0, 2, 1)  # [B, P, HW]
+
+    lp_full = np.asarray(jax.jit(full_densities)(feat))
+    gathered = np.take_along_axis(lp_full, np.asarray(idx_f), axis=-1)
     np.testing.assert_allclose(
-        np.asarray(vals_f), lp_full, rtol=1e-5, atol=1e-5
+        np.asarray(vals_f), gathered, rtol=1e-5, atol=1e-5
     )
 
 
@@ -108,11 +114,12 @@ def test_full_train_step_runs_on_device():
     from mgproto_tpu.config import tiny_test_config
     from mgproto_tpu.engine.train import Trainer
 
+    import dataclasses
+
     cfg = tiny_test_config()
     cfg = cfg.replace(
-        model=cfg.model.__class__(
-            **{**cfg.model.__dict__, "compute_dtype": "bfloat16",
-               "fused_scoring": True}
+        model=dataclasses.replace(
+            cfg.model, compute_dtype="bfloat16", fused_scoring=True
         )
     )
     trainer = Trainer(cfg, steps_per_epoch=2)
