@@ -82,6 +82,8 @@ def _peak_flops(device_kind: str) -> float:
 def run_config(fused: bool) -> dict:
     """Steady-state throughput for one scoring path. Returns
     {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}."""
+    if BATCH <= 0 or ITERS <= 0:
+        raise ValueError(f"BENCH_BATCH={BATCH} / BENCH_ITERS={ITERS} must be > 0")
     import jax
     import jax.numpy as jnp
     import numpy as np

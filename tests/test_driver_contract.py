@@ -42,11 +42,35 @@ def test_dryrun_multichip_is_hermetic_and_green():
     assert "dryrun_multichip(4)" in proc.stdout and "ok" in proc.stdout
 
 
+def test_entry_returns_jittable_fn_and_args():
+    """entry() must hand the driver a (fn, example_args) pair that jit-lowers
+    cleanly (the driver compile-checks it single-chip)."""
+    code = (
+        "import jax, __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "jax.jit(fn).lower(*args)\n"
+        "print('ENTRY_LOWER_OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-u", "-c", code],
+        capture_output=True, text=True, timeout=600, env=_driver_env(),
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "ENTRY_LOWER_OK" in proc.stdout
+
+
 def test_bench_emits_contract_json_at_toy_size():
     """bench.py end to end on CPU at toy sizes: one parseable JSON line with
     the driver-contract keys and a positive value."""
     env = _driver_env()
-    env.update(BENCH_BATCH="4", BENCH_WARMUP="0", BENCH_ITERS="1")
+    # keep bench's internal retry deadline below this test's subprocess
+    # timeout so a transient child failure surfaces as bench's own
+    # diagnostic JSON instead of an opaque TimeoutExpired
+    env.update(
+        BENCH_BATCH="4", BENCH_WARMUP="0", BENCH_ITERS="1",
+        BENCH_DEADLINE_S="600",
+    )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=900, env=env, cwd=REPO,

@@ -40,16 +40,14 @@ def test_fused_kernel_matches_unfused_on_device():
     feat, means, sigmas, t = _flagship_shapes()
     b, hw, d = feat.shape
 
+    def full_densities(f):
+        lp = diag_gaussian_log_prob(f.reshape(-1, d), means, sigmas)
+        return lp.reshape(b, hw, -1).transpose(0, 2, 1)  # [B, P, HW]
+
     vals_f, idx_f = jax.jit(
         lambda f: score_pool(f, means, sigmas, t, 1e-10, False)
     )(feat)
-
-    def unfused(f):
-        lp = diag_gaussian_log_prob(f.reshape(-1, d), means, sigmas)
-        lp = lp.reshape(b, hw, -1).transpose(0, 2, 1)  # [B, P, HW]
-        return jax.lax.top_k(lp, t)
-
-    vals_u, idx_u = jax.jit(unfused)(feat)
+    vals_u, _ = jax.jit(lambda f: jax.lax.top_k(full_densities(f), t))(feat)
     np.testing.assert_allclose(
         np.asarray(vals_f), np.asarray(vals_u), rtol=1e-5, atol=1e-5
     )
@@ -57,10 +55,6 @@ def test_fused_kernel_matches_unfused_on_device():
     # idx_f by GATHERING the densities it points at — they must reproduce the
     # returned values (catches correct-values-garbage-indices regressions,
     # which would corrupt push projection and mining)
-    def full_densities(f):
-        lp = diag_gaussian_log_prob(f.reshape(-1, d), means, sigmas)
-        return lp.reshape(b, hw, -1).transpose(0, 2, 1)  # [B, P, HW]
-
     lp_full = np.asarray(jax.jit(full_densities)(feat))
     gathered = np.take_along_axis(lp_full, np.asarray(idx_f), axis=-1)
     np.testing.assert_allclose(
