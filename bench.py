@@ -82,8 +82,10 @@ def _peak_flops(device_kind: str) -> float:
 def run_config(fused: bool) -> dict:
     """Steady-state throughput for one scoring path. Returns
     {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}."""
-    if BATCH <= 0 or ITERS <= 0:
-        raise ValueError(f"BENCH_BATCH={BATCH} / BENCH_ITERS={ITERS} must be > 0")
+    if os.environ.get("BENCH_FAIL_INJECT"):
+        # deterministic, instant child failure for the contract tests: fires
+        # before any jax/model work so the retry ladder is cheap to exercise
+        raise RuntimeError("BENCH_FAIL_INJECT: simulated child failure")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -218,6 +220,19 @@ def robust_measure(fused: bool) -> tuple:
 
 
 def main() -> None:
+    if BATCH <= 0 or ITERS <= 0:
+        # deterministic misconfig: report immediately, don't retry 12 children
+        print(
+            json.dumps(
+                {
+                    "error": f"invalid BENCH_BATCH={BATCH} / BENCH_ITERS="
+                             f"{ITERS}: both must be > 0",
+                    "attempts": 0,
+                    "errors": {},
+                }
+            )
+        )
+        raise SystemExit(1)
     results = {}
     errors = {}
     attempts_total = 0

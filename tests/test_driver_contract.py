@@ -64,12 +64,12 @@ def test_bench_emits_contract_json_at_toy_size():
     """bench.py end to end on CPU at toy sizes: one parseable JSON line with
     the driver-contract keys and a positive value."""
     env = _driver_env()
-    # keep bench's internal retry deadline below this test's subprocess
-    # timeout so a transient child failure surfaces as bench's own
+    # keep bench's per-attempt AND whole-run budgets below this test's
+    # subprocess timeout so a hung/failing child surfaces as bench's own
     # diagnostic JSON instead of an opaque TimeoutExpired
     env.update(
         BENCH_BATCH="4", BENCH_WARMUP="0", BENCH_ITERS="1",
-        BENCH_DEADLINE_S="600",
+        BENCH_ATTEMPT_TIMEOUT_S="300", BENCH_DEADLINE_S="600",
     )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],
@@ -88,11 +88,11 @@ def test_bench_failure_emits_diagnostic_json():
     """When every attempt dies, bench must print a diagnostic JSON line, not
     a traceback (BENCH_r02's failure mode)."""
     env = _driver_env()
-    # a negative batch crashes every measurement child immediately; the tiny
-    # deadline stops the retry ladder after the first attempt per path
+    # the inject hook crashes every measurement child instantly (before any
+    # jax/model work); the tiny deadline stops the ladder after one attempt
     env.update(
-        BENCH_BATCH="-1", BENCH_WARMUP="0", BENCH_ITERS="1",
-        BENCH_ATTEMPT_TIMEOUT_S="60", BENCH_DEADLINE_S="1",
+        BENCH_FAIL_INJECT="1", BENCH_BATCH="4", BENCH_WARMUP="0",
+        BENCH_ITERS="1", BENCH_ATTEMPT_TIMEOUT_S="60", BENCH_DEADLINE_S="1",
     )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],
@@ -101,3 +101,18 @@ def test_bench_failure_emits_diagnostic_json():
     assert proc.returncode == 1, (proc.stderr or proc.stdout)[-3000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert "error" in out and out["attempts"] >= 1 and "errors" in out
+    assert "BENCH_FAIL_INJECT" in json.dumps(out["errors"])
+
+
+def test_bench_rejects_misconfig_without_retrying():
+    """Deterministic misconfig (non-positive batch) must fail in seconds with
+    a diagnostic JSON, not grind through 12 retried children."""
+    env = _driver_env()
+    env.update(BENCH_BATCH="-1")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "invalid BENCH_BATCH" in out["error"] and out["attempts"] == 0

@@ -78,7 +78,7 @@ def _build_reference():
 
 def _ours_from_reference(ref):
     """Map every reference weight into our model's variables."""
-    from mgproto_tpu.config import Config, ModelConfig
+    from mgproto_tpu.config import ModelConfig
     from mgproto_tpu.core.mgproto import GMMState, MGProtoFeatures
     from mgproto_tpu.models.convert import convert_backbone
 
@@ -164,3 +164,68 @@ def test_full_forward_matches_reference(with_labels, fused):
     want_px = np.log(np.exp(want[:, :, 0]).sum(-1))
     got_px = np.asarray(log_px(got_logits[:, :, 0]))
     np.testing.assert_allclose(got_px, want_px, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.skipif(not HAS_REFERENCE, reason="reference repo not mounted")
+def test_training_gradient_matches_reference():
+    """The TRAINING SIGNAL itself: d(CE + 0.2*mine)/d(weights) must agree
+    between torch autograd through the reference forward and jax.grad through
+    ours (same weights, eval-mode BN for determinism). Prototypes receive no
+    gradient in either (reference detaches means/covs, model.py:264-265)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    from mgproto_tpu.core import losses as L
+    from mgproto_tpu.core.mgproto import head_forward
+
+    ref = _build_reference()
+    model, variables, gmm = _ours_from_reference(ref)
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(B, 3, IMG, IMG).astype(np.float32)
+    labels_np = rng.randint(0, C, size=(B,))
+    gt = torch.from_numpy(labels_np)
+
+    # ---- torch side (reference)
+    ref.zero_grad()
+    out, _ = ref(torch.from_numpy(x), gt)
+    mine_t = sum(
+        F.cross_entropy(out[:, :, k], gt) for k in range(1, out.shape[2])
+    ) / (out.shape[2] - 1)
+    loss_t = F.cross_entropy(out[:, :, 0], gt) + 0.2 * mine_t
+    loss_t.backward()
+    want_conv1 = ref.features.conv1.weight.grad.numpy()  # [O, I, kh, kw]
+    want_addon = ref.add_on_layers[0].weight.grad.numpy()
+    assert ref.prototype_means.grad is None  # detached in compute_log_prob
+
+    # ---- jax side (ours)
+    x_nhwc = jnp.asarray(np.transpose(x, (0, 2, 3, 1)))
+    labels = jnp.asarray(labels_np)
+    stats = variables["batch_stats"]
+
+    def loss_fn(params):
+        proto_map, _ = model.apply(
+            {"params": params, "batch_stats": stats}, x_nhwc, train=False
+        )
+        logits, _, _ = head_forward(proto_map, gmm, labels, MINE_T)
+        return L.cross_entropy(logits[..., 0], labels) + 0.2 * L.mine_loss(
+            logits, labels
+        )
+
+    loss_j, grads = jax.value_and_grad(loss_fn)(variables["params"])
+    np.testing.assert_allclose(float(loss_j), float(loss_t), rtol=1e-4)
+
+    got_conv1 = np.transpose(
+        np.asarray(grads["features"]["conv1"]["kernel"]), (3, 2, 0, 1)
+    )
+    got_addon = np.transpose(
+        np.asarray(grads["add_on"]["conv0"]["kernel"]), (3, 2, 0, 1)
+    )
+    scale = np.abs(want_conv1).max()
+    np.testing.assert_allclose(
+        got_conv1, want_conv1, rtol=1e-3, atol=1e-4 * scale
+    )
+    np.testing.assert_allclose(
+        got_addon, want_addon, rtol=1e-3,
+        atol=1e-4 * np.abs(want_addon).max(),
+    )
