@@ -192,9 +192,17 @@ def robust_measure(fused: bool) -> tuple:
     cmd = [sys.executable, "-u", os.path.abspath(__file__), "--measure",
            "fused" if fused else "unfused"]
     for attempt in range(1, MAX_ATTEMPTS + 1):
+        # enforce the whole-run cap BEFORE spending, and never hand a child
+        # more than the remaining budget — otherwise a wedged relay overruns
+        # DEADLINE_S by up to ATTEMPT_TIMEOUT_S per scoring path
+        remaining = DEADLINE_S - (time.monotonic() - _START)
+        if remaining <= 0:
+            last_err = (last_err or "") + " [deadline exceeded, not attempted]"
+            return None, last_err.strip(), attempt - 1
         try:
             proc = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S
+                cmd, capture_output=True, text=True,
+                timeout=min(ATTEMPT_TIMEOUT_S, remaining),
             )
             if proc.returncode == 0 and proc.stdout.strip():
                 return (
@@ -204,9 +212,9 @@ def robust_measure(fused: bool) -> tuple:
                 )
             tail = (proc.stderr or proc.stdout or "").strip()[-600:]
             last_err = f"child rc={proc.returncode}: {tail}"
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             last_err = (
-                f"attempt killed after {ATTEMPT_TIMEOUT_S}s (relay hang?)"
+                f"attempt killed after {e.timeout:.0f}s (relay hang?)"
             )
         except Exception as e:
             last_err = f"{type(e).__name__}: {e}"

@@ -124,6 +124,11 @@ def evaluate_with_ood(
     exceeds that threshold (train_and_test.py:213,227) — a C-fold asymmetry
     kept for behavior parity. Reported `fpr` per OoD set = fraction of OoD
     samples predicted in-distribution at the ID-`percentile` operating point.
+
+    Beyond the reference: `AUROC_i` per OoD set — the threshold-free metric
+    the paper's OoD tables report. Computed on the log p(x) scores (rank
+    statistics are monotone-invariant, so log vs exp and the C-fold
+    asymmetry don't matter here).
     """
     id_log_px, correct, _, _ = _run_eval(trainer, state, id_batches)
     acc = float(correct.mean()) if correct.size else 0.0
@@ -140,4 +145,33 @@ def evaluate_with_ood(
         fpr = float((mean_px > ood_thresh).mean()) if mean_px.size else 0.0
         results[f"FPR95_{i}"] = fpr
         log(f"\tFPR95_{i}: \t{fpr}")
+        if ood_log_px.size:
+            auroc = binary_auroc(id_log_px, ood_log_px)
+            results[f"AUROC_{i}"] = auroc
+            log(f"\tAUROC_{i}: \t{auroc}")
     return acc, results
+
+
+def binary_auroc(pos_scores: np.ndarray, neg_scores: np.ndarray) -> float:
+    """AUROC = P(pos > neg) + 0.5 P(pos == neg), via the Mann-Whitney U
+    statistic on midranks (exact tie handling, no sklearn dependency)."""
+    pos = np.asarray(pos_scores, np.float64).ravel()
+    neg = np.asarray(neg_scores, np.float64).ravel()
+    if not pos.size or not neg.size:
+        return float("nan")
+    both = np.concatenate([pos, neg])
+    order = np.argsort(both, kind="mergesort")
+    ranks = np.empty_like(both)
+    ranks[order] = np.arange(1, both.size + 1, dtype=np.float64)
+    # midranks for ties
+    sorted_vals = both[order]
+    i = 0
+    while i < sorted_vals.size:
+        j = i
+        while j + 1 < sorted_vals.size and sorted_vals[j + 1] == sorted_vals[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    u = ranks[: pos.size].sum() - pos.size * (pos.size + 1) / 2.0
+    return float(u / (pos.size * neg.size))

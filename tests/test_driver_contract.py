@@ -90,9 +90,12 @@ def test_bench_failure_emits_diagnostic_json():
     env = _driver_env()
     # the inject hook crashes every measurement child instantly (before any
     # jax/model work); the tiny deadline stops the ladder after one attempt
+    # deadline 5s: long enough that the first attempt certainly starts
+    # (the pre-attempt deadline check would otherwise zero it out), short
+    # enough to stop the ladder after one attempt per path
     env.update(
         BENCH_FAIL_INJECT="1", BENCH_BATCH="4", BENCH_WARMUP="0",
-        BENCH_ITERS="1", BENCH_ATTEMPT_TIMEOUT_S="60", BENCH_DEADLINE_S="1",
+        BENCH_ITERS="1", BENCH_ATTEMPT_TIMEOUT_S="60", BENCH_DEADLINE_S="5",
     )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],

@@ -66,9 +66,12 @@ def test_evaluate_with_ood(setup):
     acc, res = evaluate_with_ood(
         trainer, state, id_b, [ood1, ood2], log=lambda *_: None
     )
-    assert set(res) == {"acc", "ood_thresh", "FPR95_1", "FPR95_2"}
+    assert set(res) == {
+        "acc", "ood_thresh", "FPR95_1", "FPR95_2", "AUROC_1", "AUROC_2"
+    }
     assert res["ood_thresh"] > 0
     assert 0.0 <= res["FPR95_1"] <= 1.0 and 0.0 <= res["FPR95_2"] <= 1.0
+    assert 0.0 <= res["AUROC_1"] <= 1.0 and 0.0 <= res["AUROC_2"] <= 1.0
 
 
 def test_ood_threshold_separates(setup):
@@ -82,3 +85,34 @@ def test_ood_threshold_separates(setup):
         trainer, state, b, [[x[0] for x in b]], log=lambda *_: None
     )
     assert res["FPR95_1"] == pytest.approx(0.0)
+
+
+def test_binary_auroc_exact():
+    from mgproto_tpu.engine.evaluate import binary_auroc
+
+    assert binary_auroc([3, 4, 5], [0, 1, 2]) == 1.0  # perfect separation
+    assert binary_auroc([0, 1, 2], [3, 4, 5]) == 0.0  # perfectly wrong
+    assert binary_auroc([1, 1, 1], [1, 1, 1]) == 0.5  # all ties -> chance
+    # hand-computed with one tie: pairs (2>1), (2=2 -> 0.5), (5>1), (5>2)
+    assert binary_auroc([2, 5], [1, 2]) == pytest.approx((1 + 0.5 + 2) / 4)
+
+
+def test_binary_auroc_matches_bruteforce():
+    from mgproto_tpu.engine.evaluate import binary_auroc
+
+    rng = np.random.RandomState(0)
+    pos = np.round(rng.normal(0.5, 1.0, size=37), 1)  # rounding makes ties
+    neg = np.round(rng.normal(0.0, 1.0, size=53), 1)
+    want = np.mean(
+        [(p > n) + 0.5 * (p == n) for p in pos for n in neg]
+    )
+    assert binary_auroc(pos, neg) == pytest.approx(float(want))
+
+
+def test_ood_auroc_identical_distributions_is_half(setup):
+    cfg, trainer, state = setup
+    b = _batches(cfg, n_batches=3, seed=3)
+    _, res = evaluate_with_ood(
+        trainer, state, b, [[x[0] for x in b]], log=lambda *_: None
+    )
+    assert res["AUROC_1"] == pytest.approx(0.5)  # same data as ID and OoD
