@@ -213,9 +213,12 @@ def robust_measure(fused: bool) -> tuple:
             tail = (proc.stderr or proc.stdout or "").strip()[-600:]
             last_err = f"child rc={proc.returncode}: {tail}"
         except subprocess.TimeoutExpired as e:
-            last_err = (
-                f"attempt killed after {e.timeout:.0f}s (relay hang?)"
+            cause = (
+                "whole-run deadline capped the attempt"
+                if e.timeout < ATTEMPT_TIMEOUT_S
+                else "relay hang?"
             )
+            last_err = f"attempt killed after {e.timeout:.0f}s ({cause})"
         except Exception as e:
             last_err = f"{type(e).__name__}: {e}"
         print(f"[bench] attempt {attempt} failed: {last_err}", file=sys.stderr)

@@ -1,0 +1,166 @@
+"""Push-projection parity with the ACTUAL reference push.py: same weights,
+same images -> same projected prototype means and same image assignments.
+
+This drives the real /root/reference/push.py `push_prototypes` (scan ->
+sort-by-distance -> global image dedup -> mean overwrite -> rendering) against
+our `engine/push.py` two-pass redesign, pinning: spatial argmax selection
+(reference argmin of distance = -p, push.py:135), candidate ordering
+(push.py:172 sort by min_distance), greedy one-image-per-prototype dedup
+ACROSS the whole prototype set (push.py:164,177-179 `has_pushed_img` is
+global), and the f-vector write-back (push.py:193-198)."""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from test_forward_parity import (  # same-weights model pair (same test dir)
+    C,
+    IMG,
+    K,
+    _build_reference,
+    _ours_from_reference,
+    _stub_torchvision,
+)
+
+REFERENCE = "/root/reference"
+HAS_REFERENCE = os.path.isdir(os.path.join(REFERENCE, "models"))
+PER_CLASS = 6
+
+
+def _make_images(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    paths, labels = [], []
+    for c in range(C):
+        for i in range(PER_CLASS):
+            arr = (rng.rand(IMG, IMG, 3) * 255).astype(np.uint8)
+            p = str(tmp_path / f"c{c}_i{i}.png")
+            Image.fromarray(arr).save(p)
+            paths.append(p)
+            labels.append(c)
+    return paths, np.asarray(labels, np.int64)
+
+
+class _FakeDataset:
+    """Provides the `.transform` the reference execute pass re-applies when it
+    re-opens each chosen image from disk (push.py:163,181-182)."""
+
+    def __init__(self, transform):
+        self.transform = transform
+
+
+class _FakeLoader(list):
+    def __init__(self, items, transform):
+        super().__init__(items)
+        self.dataset = _FakeDataset(transform)
+
+
+class _Shim:
+    """Stands in for torch.nn.DataParallel: push only touches `.module` and
+    `.eval()` (push.py:27,31-33)."""
+
+    def __init__(self, module):
+        self.module = module
+
+    def eval(self):
+        self.module.eval()
+
+
+@pytest.mark.skipif(not HAS_REFERENCE, reason="reference repo not mounted")
+def test_push_matches_reference(tmp_path, monkeypatch):
+    torch = pytest.importorskip("torch")
+    monkeypatch.setattr(
+        torch.Tensor, "cuda", lambda self, *a, **k: self, raising=False
+    )
+    import matplotlib
+
+    matplotlib.use("Agg")
+
+    _stub_torchvision()
+    sys.path.insert(0, REFERENCE)
+    try:
+        import push as ref_push
+    finally:
+        sys.path.remove(REFERENCE)
+
+    ref = _build_reference()
+    model, variables, gmm = _ours_from_reference(ref)
+    means_before = np.array(ref.prototype_means.detach().numpy())
+
+    paths, labels = _make_images(tmp_path)
+
+    def transform(im):
+        arr = np.asarray(im, np.float32) / 255.0
+        return torch.from_numpy(arr.transpose(2, 0, 1))
+
+    # batches of 8, reference loader item layout: ((imgs, labels), (paths,))
+    from PIL import Image
+
+    items = []
+    bs = 8
+    for s in range(0, len(paths), bs):
+        imgs = torch.stack(
+            [transform(Image.open(p).convert("RGB")) for p in paths[s : s + bs]]
+        )
+        ys = torch.from_numpy(labels[s : s + bs])
+        items.append(((imgs, ys), (list(paths[s : s + bs]),)))
+
+    save_dir = str(tmp_path / "render")
+    os.makedirs(save_dir, exist_ok=True)
+    ref_push.push_prototypes(
+        _FakeLoader(items, transform),
+        _Shim(ref),
+        class_specific=True,
+        preprocess_input_function=None,
+        root_dir_for_saving_prototypes=save_dir,
+        epoch_number=0,
+        prototype_img_filename_prefix="p",
+        prototype_self_act_filename_prefix="a",
+        proto_bound_boxes_filename_prefix="b",
+        log=lambda *_: None,
+    )
+    want_means = ref.prototype_means.detach().numpy()
+    assert not np.allclose(want_means, means_before)  # push actually moved them
+
+    # ---- ours: same weights, same images, ids = file order
+    from mgproto_tpu.core.state import TrainState
+    from mgproto_tpu.engine.push import push_prototypes
+
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params={"net": variables["params"]},
+        batch_stats=variables["batch_stats"],
+        gmm=gmm,
+        memory=None,
+        opt_state=None,
+        warm_opt_state=None,
+        proto_opt_state=None,
+    )
+    trainer = types.SimpleNamespace(model=model)
+
+    def batches():
+        for s in range(0, len(paths), bs):
+            imgs = np.stack(
+                [
+                    np.asarray(Image.open(p).convert("RGB"), np.float32) / 255.0
+                    for p in paths[s : s + bs]
+                ]
+            )
+            yield imgs, labels[s : s + bs], np.arange(s, s + imgs.shape[0])
+
+    new_state, result = push_prototypes(
+        trainer, state, batches(), save_dir=None, normalize=lambda x: x
+    )
+    got_means = np.asarray(new_state.gmm.means)
+
+    assert result.pushed.all()  # plenty of images per class
+    # mean equality IS assignment parity: with random images every candidate
+    # f-vector is distinct, so identical means imply identical (image, patch)
+    # choices under the same global dedup order
+    np.testing.assert_allclose(got_means, want_means, rtol=1e-4, atol=1e-5)
