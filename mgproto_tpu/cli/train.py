@@ -69,6 +69,14 @@ def run_training(
     render_push: bool = True,
 ):
     """Run the full schedule; returns (final_state, last_test_accuracy)."""
+    # resolve --resume FIRST: a typo'd path must fail fast, before any
+    # data-pipeline or device work happens
+    resume_path = None
+    if resume:
+        resume_path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
+        if resume != "auto" and not os.path.exists(resume_path):
+            raise FileNotFoundError(resume_path)
+
     os.makedirs(cfg.model_dir, exist_ok=True)
     log = Logger(os.path.join(cfg.model_dir, "train.log"))
     metrics = MetricsWriter(os.path.join(cfg.model_dir, "metrics.jsonl"))
@@ -80,11 +88,6 @@ def run_training(
     log(f"devices: {jax.device_count()}  mesh: {dict(trainer.mesh.shape)}")
     log(f"steps/epoch: {steps_per_epoch}")
 
-    resume_path = None
-    if resume:
-        resume_path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
-        if resume != "auto" and not os.path.exists(resume_path):
-            raise FileNotFoundError(resume_path)
     # a restore target skips the pretrained trunk load (about to be overwritten)
     state = trainer.init_state(
         jax.random.PRNGKey(cfg.seed), for_restore=bool(resume_path)

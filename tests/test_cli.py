@@ -88,3 +88,34 @@ def test_config_from_args_presets():
     assert "stanford_cars_cropped" in cfg.data.train_dir
     assert cfg.data.train_dir.endswith("train_cropped_augmented")
     assert cfg.data.train_push_dir.endswith("train_cropped")
+
+
+def test_resume_with_missing_explicit_path_raises(tmp_path):
+    cfg = tiny_test_config().replace(
+        data=DataConfig(
+            dataset="synthetic",
+            train_dir=str(tmp_path / "nope"),
+            test_dir=str(tmp_path / "nope"),
+            train_push_dir=str(tmp_path / "nope"),
+            train_batch_size=2,
+            test_batch_size=2,
+            train_push_batch_size=2,
+            num_workers=0,
+        ),
+        model_dir=str(tmp_path / "run"),
+    )
+    # the explicit-resume validation fires before any data/model work
+    with pytest.raises(FileNotFoundError, match="definitely_missing"):
+        run_training(cfg, resume=str(tmp_path / "definitely_missing"))
+
+
+def test_launch_scripts_parse():
+    """bash -n every shipped shell script: the two cluster launchers
+    (PARITY.md row 20) plus scripts/test.sh."""
+    import subprocess
+
+    for script in ("scripts/launch_tpu.sh", "scripts/launch_pod.sh",
+                   "scripts/test.sh"):
+        path = os.path.join(os.path.dirname(os.path.dirname(__file__)), script)
+        proc = subprocess.run(["bash", "-n", path], capture_output=True)
+        assert proc.returncode == 0, (script, proc.stderr)

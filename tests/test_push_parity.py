@@ -21,7 +21,6 @@ import jax.numpy as jnp
 from test_forward_parity import (  # same-weights model pair (same test dir)
     C,
     IMG,
-    K,
     _build_reference,
     _ours_from_reference,
     _stub_torchvision,
