@@ -80,6 +80,11 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--push_start", type=int, default=100)
     p.add_argument("--push_every", type=int, default=10)
     p.add_argument("--prune_top_m", type=int, default=8)
+    p.add_argument(
+        "--prune_renormalize", action="store_true",
+        help="renormalize kept priors after pruning (beyond-parity; "
+             "preserves per-class mixture mass, recompute OoD thresholds)",
+    )
     p.add_argument("--no_pretrained", action="store_true")
     # default matches ModelConfig so pre-existing f32 checkpoints evaluate
     # under the numerics they trained with; launch_tpu.sh opts into bf16
@@ -141,6 +146,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             push_start=args.push_start,
             push_every=args.push_every,
             prune_top_m=args.prune_top_m,
+            prune_renormalize=args.prune_renormalize,
         ),
         loss=LossConfig(aux_loss=args.aux_loss),
         data=DataConfig(

@@ -191,7 +191,11 @@ def run_training(
     # pruning (reference main.py:285-287); top_m can't exceed K per class
     last_epoch = max(cfg.schedule.num_train_epochs - 1, start_epoch)
     top_m = min(cfg.schedule.prune_top_m, cfg.model.prototypes_per_class)
-    state = state.replace(gmm=prune_top_m(state.gmm, top_m))
+    state = state.replace(
+        gmm=prune_top_m(
+            state.gmm, top_m, renormalize=cfg.schedule.prune_renormalize
+        )
+    )
     accu, test_results = _test(trainer, state, test_loader, ood_loaders, log)
     metrics.write(
         int(state.step), {"epoch": last_epoch, "stage": "prune", **test_results}

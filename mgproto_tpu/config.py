@@ -86,6 +86,10 @@ class ScheduleConfig:
     push_start: int = 100
     push_every: int = 10
     prune_top_m: int = 8  # main.py:285
+    # beyond-parity: renormalize kept priors after pruning (preserves each
+    # class's mixture mass; see core/mgproto.py:prune_top_m). Default False =
+    # reference-exact.
+    prune_renormalize: bool = False
 
     def push_epochs(self) -> Sequence[int]:
         return [

@@ -272,7 +272,9 @@ def head_forward(
     return logits, pooled, enq
 
 
-def prune_top_m(gmm: GMMState, top_m: int) -> GMMState:
+def prune_top_m(
+    gmm: GMMState, top_m: int, renormalize: bool = False
+) -> GMMState:
     """Keep each class's top-M prototypes by prior; zero the rest.
 
     Reference `prune_prototypes_topM` (model.py:467-482): the per-class
@@ -281,12 +283,24 @@ def prune_top_m(gmm: GMMState, top_m: int) -> GMMState:
     slots get prior 0 in the classifier weights, and priors are NOT
     renormalized. Density for pruned slots still gets computed here (they
     contribute exp(-inf)=0 via the zero prior), matching the reference where
-    pruned columns stay in the weight matrix as zeros."""
+    pruned columns stay in the weight matrix as zeros.
+
+    `renormalize=True` (beyond-parity opt-in) rescales the kept priors to
+    sum to 1 per class, preserving each class's mixture mass. When priors
+    are still near-uniform (short runs / frequent pruning) the reference
+    semantics shift class log-likelihoods by the removed mass and can
+    collapse accuracy; renormalizing recovers most of it (measured on the
+    evidence run: prune-4-of-5 at epoch 29 gives 0.13 reference vs 0.43
+    renormalized vs 0.52 unpruned — evidence/README.md). Note it changes the
+    absolute p(x) scale, so recompute OoD thresholds afterwards."""
     if not (1 <= top_m <= gmm.k_per_class):
         raise ValueError(f"top_m {top_m} not in [1, {gmm.k_per_class}]")
     thresh = jax.lax.top_k(gmm.priors, top_m)[0][:, -1]  # [C] kth largest
     keep = gmm.priors >= thresh[:, None]  # [C, K]
-    return gmm._replace(priors=jnp.where(keep, gmm.priors, 0.0), keep=keep)
+    priors = jnp.where(keep, gmm.priors, 0.0)
+    if renormalize:
+        priors = priors / jnp.maximum(priors.sum(-1, keepdims=True), 1e-12)
+    return gmm._replace(priors=priors, keep=keep)
 
 
 def log_px(logits_level0: jax.Array) -> jax.Array:

@@ -188,3 +188,33 @@ def test_prune_keeps_ties():
     )
     pruned = prune_top_m(gmm, 2)
     assert np.asarray(pruned.keep).sum() == 3  # tie at 0.3 keeps both
+
+
+def test_prune_renormalize_preserves_class_mass():
+    """Opt-in renormalization: kept priors sum to 1 per class; the default
+    stays reference-exact (no renormalization, core/mgproto.py)."""
+    from mgproto_tpu.core.mgproto import GMMState, prune_top_m
+
+    priors = jnp.asarray(
+        np.random.RandomState(1).dirichlet(np.ones(5), size=3), jnp.float32
+    )
+    gmm = GMMState(
+        means=jnp.zeros((3, 5, 4)),
+        sigmas=jnp.ones((3, 5, 4)),
+        priors=priors,
+        keep=jnp.ones((3, 5), bool),
+    )
+    ref = prune_top_m(gmm, 3)
+    assert np.all(np.asarray(ref.priors.sum(-1)) < 1.0)  # mass removed
+
+    ren = prune_top_m(gmm, 3, renormalize=True)
+    np.testing.assert_allclose(np.asarray(ren.priors.sum(-1)), 1.0, rtol=1e-6)
+    # same keep set, same relative weights among kept slots
+    np.testing.assert_array_equal(np.asarray(ren.keep), np.asarray(ref.keep))
+    kept = np.asarray(ref.keep)
+    ratio = np.asarray(ren.priors)[kept] / np.asarray(ref.priors)[kept]
+    per_class = ratio.reshape(3, -1)
+    np.testing.assert_allclose(
+        per_class, np.broadcast_to(per_class[:, :1], per_class.shape),
+        rtol=1e-5,
+    )
