@@ -58,6 +58,50 @@ def make_dataset(root: str, num_classes: int, per_class: int, test_per_class: in
                 Image.fromarray(arr).save(os.path.join(d, f"{i:04d}.png"))
 
 
+def compare_prune_styles(cfg) -> dict:
+    """Restore the last pre-prune checkpoint and measure test accuracy
+    unpruned vs reference-prune vs renormalized-prune (the measurement behind
+    core/mgproto.py:prune_top_m's renormalize option)."""
+    import jax
+
+    from mgproto_tpu.cli.train import _labeled
+    from mgproto_tpu.core.mgproto import prune_top_m
+    from mgproto_tpu.data import build_pipelines
+    from mgproto_tpu.engine import evaluate
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.utils.checkpoint import (
+        list_checkpoints,
+        restore_checkpoint,
+    )
+
+    # (epoch, stage, acc, path) tuples, already sorted by epoch
+    nopush = [c for c in list_checkpoints(cfg.model_dir) if c[1] == "nopush"]
+    if not nopush:
+        return {}
+    path = nopush[-1][-1]
+    _, _, test_loader, _ = build_pipelines(cfg)
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0), for_restore=True)
+    state = restore_checkpoint(path, state)
+
+    def acc_of(s):
+        a, _ = evaluate(trainer, s, _labeled(test_loader), log=lambda *_: None)
+        return round(a, 4)
+
+    top_m = min(cfg.schedule.prune_top_m, cfg.model.prototypes_per_class)
+    return {
+        "checkpoint": os.path.basename(path),
+        "top_m": top_m,
+        "unpruned": acc_of(state),
+        "prune_reference": acc_of(
+            state.replace(gmm=prune_top_m(state.gmm, top_m))
+        ),
+        "prune_renormalized": acc_of(
+            state.replace(gmm=prune_top_m(state.gmm, top_m, renormalize=True))
+        ),
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="evidence/synthetic")
@@ -152,6 +196,7 @@ def main() -> None:
         "post_prune_test_accuracy": by_stage.get("prune", []),
         "final_test_accuracy": accuracy,
         "test_accuracy_trajectory": trajectory,
+        "prune_comparison": compare_prune_styles(cfg),
     }
     with open(os.path.join(args.out, "summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
