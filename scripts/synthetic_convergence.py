@@ -109,6 +109,8 @@ def main() -> None:
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--classes", type=int, default=8)
     p.add_argument("--per_class", type=int, default=40)
+    p.add_argument("--arch", default="tiny")
+    p.add_argument("--batch", type=int, default=16)
     args = p.parse_args()
 
     from mgproto_tpu.hermetic import pin_cpu_devices
@@ -130,7 +132,7 @@ def main() -> None:
 
     cfg = Config(
         model=ModelConfig(
-            arch="tiny",
+            arch=args.arch,
             img_size=64,
             num_classes=args.classes,
             prototypes_per_class=5,
@@ -157,7 +159,7 @@ def main() -> None:
             test_dir=os.path.join(data_root, "test"),
             train_push_dir=os.path.join(data_root, "train"),
             ood_dirs=(),
-            train_batch_size=16,
+            train_batch_size=args.batch,
             test_batch_size=32,
             train_push_batch_size=32,
             num_workers=2,
@@ -187,7 +189,7 @@ def main() -> None:
         "what": "full-pipeline convergence on separable synthetic ImageFolder",
         "driver": "mgproto_tpu.cli.train.run_training (warm/joint, mine, EM, "
                   "push, prune all exercised)",
-        "arch": "tiny",
+        "arch": args.arch,
         "classes": args.classes,
         "epochs": args.epochs,
         "chance_accuracy": 1.0 / args.classes,

@@ -24,7 +24,6 @@ import jax.numpy as jnp
 from test_forward_parity import (
     C,
     IMG,
-    K,
     _build_reference,
     _ours_from_reference,
 )
