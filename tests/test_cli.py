@@ -119,3 +119,26 @@ def test_launch_scripts_parse():
         path = os.path.join(os.path.dirname(os.path.dirname(__file__)), script)
         proc = subprocess.run(["bash", "-n", path], capture_output=True)
         assert proc.returncode == 0, (script, proc.stderr)
+
+
+def test_packaging_entry_points_resolve():
+    """pyproject.toml's console scripts must point at real callables and the
+    package-discovery glob must match the actual package name."""
+    import importlib
+    import tomllib
+
+    root = os.path.dirname(os.path.dirname(__file__))
+    with open(os.path.join(root, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    scripts = meta["project"]["scripts"]
+    assert set(scripts) == {
+        "mgproto-train", "mgproto-eval", "mgproto-interpret", "mgproto-prep"
+    }
+    for target in scripts.values():
+        mod_name, fn_name = target.split(":")
+        assert callable(getattr(importlib.import_module(mod_name), fn_name))
+    include = meta["tool"]["setuptools"]["packages"]["find"]["include"]
+    assert any(
+        pat == "mgproto_tpu" or pat.startswith("mgproto_tpu")
+        for pat in include
+    )
