@@ -19,8 +19,13 @@ import argparse
 import json
 import os
 import shutil
+import sys
 
 import numpy as np
+
+# runnable as `python scripts/synthetic_convergence.py` from anywhere: put the
+# repo root (the package's parent) ahead of the script's own directory
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def make_dataset(root: str, num_classes: int, per_class: int, test_per_class: int,

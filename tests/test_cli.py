@@ -138,7 +138,29 @@ def test_packaging_entry_points_resolve():
         mod_name, fn_name = target.split(":")
         assert callable(getattr(importlib.import_module(mod_name), fn_name))
     include = meta["tool"]["setuptools"]["packages"]["find"]["include"]
-    assert any(
-        pat == "mgproto_tpu" or pat.startswith("mgproto_tpu")
-        for pat in include
+    assert any(pat.startswith("mgproto_tpu") for pat in include)
+
+
+def test_synthetic_convergence_script_importable_standalone(tmp_path):
+    """The script must be runnable from any cwd without PYTHONPATH: its
+    module level bootstraps the repo root onto sys.path. Executing the module
+    level via runpy (run_name != __main__ skips main()) and THEN importing
+    mgproto_tpu proves the bootstrap itself — a bare `--help` would exit
+    inside argparse before the package import and test nothing."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(__file__))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    script = os.path.join(root, "scripts", "synthetic_convergence.py")
+    code = (
+        "import runpy; "
+        f"runpy.run_path({script!r}, run_name='bootstrap_probe'); "
+        "import mgproto_tpu; print('bootstrap-ok')"
     )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "bootstrap-ok" in proc.stdout
