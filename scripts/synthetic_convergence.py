@@ -107,6 +107,57 @@ def compare_prune_styles(cfg) -> dict:
     }
 
 
+def build_config(workdir: str, arch: str, classes: int, epochs: int,
+                 batch: int, ood_dirs=()):
+    """The evidence Config shared by this script and synthetic_ood.py —
+    the OoD evaluation must restore checkpoints under the EXACT training-time
+    model config."""
+    from mgproto_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        ScheduleConfig,
+    )
+
+    data_root = os.path.join(workdir, "data")
+    return Config(
+        model=ModelConfig(
+            arch=arch,
+            img_size=64,
+            num_classes=classes,
+            prototypes_per_class=5,
+            proto_dim=16,
+            sz_embedding=8,
+            mine_T=4,
+            mem_capacity=64,
+            pretrained=False,
+        ),
+        schedule=ScheduleConfig(
+            num_train_epochs=epochs,
+            num_warm_epochs=1,
+            mine_start=2,
+            update_gmm_start=2,
+            # proportional to the reference's 100/120-epoch push schedule and
+            # its 8-of-10 prune (settings.py:51-52, main.py:285)
+            push_start=max(int(epochs * 0.8), 1),
+            push_every=5,
+            prune_top_m=4,
+        ),
+        data=DataConfig(
+            dataset="synthetic",
+            train_dir=os.path.join(data_root, "train"),
+            test_dir=os.path.join(data_root, "test"),
+            train_push_dir=os.path.join(data_root, "train"),
+            ood_dirs=tuple(ood_dirs),
+            train_batch_size=batch,
+            test_batch_size=32,
+            train_push_batch_size=32,
+            num_workers=2,
+        ),
+        model_dir=os.path.join(workdir, "run"),
+    )
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="evidence/synthetic")
@@ -123,53 +174,14 @@ def main() -> None:
     pin_cpu_devices(1)  # evidence runs hermetically; TPU relay not required
 
     from mgproto_tpu.cli.train import run_training
-    from mgproto_tpu.config import (
-        Config,
-        DataConfig,
-        ModelConfig,
-        ScheduleConfig,
-    )
 
     data_root = os.path.join(args.workdir, "data")
     model_dir = os.path.join(args.workdir, "run")
     shutil.rmtree(args.workdir, ignore_errors=True)
     make_dataset(data_root, args.classes, args.per_class, test_per_class=16)
 
-    cfg = Config(
-        model=ModelConfig(
-            arch=args.arch,
-            img_size=64,
-            num_classes=args.classes,
-            prototypes_per_class=5,
-            proto_dim=16,
-            sz_embedding=8,
-            mine_T=4,
-            mem_capacity=64,
-            pretrained=False,
-        ),
-        schedule=ScheduleConfig(
-            num_train_epochs=args.epochs,
-            num_warm_epochs=1,
-            mine_start=2,
-            update_gmm_start=2,
-            # proportional to the reference's 100/120-epoch push schedule and
-            # its 8-of-10 prune (settings.py:51-52, main.py:285)
-            push_start=max(int(args.epochs * 0.8), 1),
-            push_every=5,
-            prune_top_m=4,
-        ),
-        data=DataConfig(
-            dataset="synthetic",
-            train_dir=os.path.join(data_root, "train"),
-            test_dir=os.path.join(data_root, "test"),
-            train_push_dir=os.path.join(data_root, "train"),
-            ood_dirs=(),
-            train_batch_size=args.batch,
-            test_batch_size=32,
-            train_push_batch_size=32,
-            num_workers=2,
-        ),
-        model_dir=model_dir,
+    cfg = build_config(
+        args.workdir, args.arch, args.classes, args.epochs, args.batch
     )
 
     _, accuracy = run_training(cfg, render_push=False, target_accu=0.3)
