@@ -1,0 +1,116 @@
+"""Analytic performance model: flagship-step FLOPs -> TPU roofline.
+
+Measures the EXACT flop count of the production train/eval step (the same
+`Trainer._train_step` bench.py times) via XLA's compiled cost analysis, then
+derives the v5e roofline: images/sec/chip at a given MFU, and the MFU needed
+to hit the driver north star (>=6x an estimated single-A100 350 img/s on a
+v5e-8, i.e. 262.5 img/s/chip — BASELINE.json / bench.py).
+
+Runs on the CPU backend (hermetic — no TPU relay needed): XLA's flop count
+is backend-portable arithmetic (convs/matmuls dominate and count identically),
+while `bytes accessed` is NOT (CPU fusion differs from TPU), so bytes are
+reported as a caveated upper bound only. On-device MFU from real step time is
+bench.py's job; this script pre-registers what to expect.
+
+Usage: python scripts/perf_model.py [--batch 80] [--arch resnet34] [--smoke]
+Prints one JSON line; paste-ready for PERF.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# single source for the comparison constants: the on-device bench harness
+# (its module level is import-safe — stdlib imports and constants only)
+from bench import NORTH_STAR_PER_CHIP, _PEAK_BF16  # noqa: E402
+
+V5E_PEAK_BF16 = _PEAK_BF16["v5e"]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=80)
+    p.add_argument("--arch", default="resnet34")
+    p.add_argument("--classes", type=int, default=200)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes: validates the harness in seconds")
+    args = p.parse_args()
+
+    from mgproto_tpu.hermetic import pin_cpu_devices
+
+    pin_cpu_devices(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from mgproto_tpu.config import Config, ModelConfig, tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+
+    if args.smoke:
+        cfg = tiny_test_config()
+        batch = 4
+    else:
+        cfg = Config(
+            model=ModelConfig(
+                arch=args.arch,
+                num_classes=args.classes,
+                pretrained=False,
+                compute_dtype="bfloat16",
+                fused_scoring=False,
+            )
+        )
+        batch = args.batch
+
+    trainer = Trainer(cfg, steps_per_epoch=100)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    imgs = jnp.zeros((batch, cfg.model.img_size, cfg.model.img_size, 3),
+                     jnp.float32)
+    lbls = jnp.zeros((batch,), jnp.int32)
+
+    def flops_of(compiled) -> float:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) if ca else 0.0
+        if flops <= 0.0:
+            # the flop count IS this script's output — fail fast, don't
+            # print a plausible-looking zero (bench.py degrades gracefully
+            # because for it MFU is a best-effort extra; here it's the point)
+            raise SystemExit(
+                "cost_analysis returned no usable flop count on this backend"
+            )
+        return flops
+
+    train_flops = flops_of(
+        trainer._train_step.lower(
+            state, imgs, lbls, jnp.asarray(1.0, jnp.float32),
+            jnp.asarray(True, bool), warm=False,
+        ).compile()
+    )
+    eval_flops = flops_of(trainer._eval_step.lower(state, imgs, lbls).compile())
+
+    per_img = train_flops / batch
+    out = {
+        "arch": cfg.model.arch,
+        "batch": batch,
+        "train_flops_per_step": train_flops,
+        "train_gflops_per_image": round(per_img / 1e9, 2),
+        "eval_gflops_per_image": round(eval_flops / batch / 1e9, 2),
+        "v5e_imgs_per_sec_chip_at_mfu": {
+            f"{int(m * 100)}%": round(V5E_PEAK_BF16 * m / per_img, 1)
+            for m in (0.2, 0.4, 0.6)
+        } if per_img else {},
+        f"mfu_needed_for_north_star_{NORTH_STAR_PER_CHIP}_imgs_s_chip": round(
+            NORTH_STAR_PER_CHIP * per_img / V5E_PEAK_BF16, 4
+        ) if per_img else None,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
