@@ -43,15 +43,32 @@ A100_EST_IMAGES_PER_SEC = 350.0
 NORTH_STAR_PER_CHIP = 6 * A100_EST_IMAGES_PER_SEC / 8  # v5e-8 star, per chip
 
 # env overrides exist so CI can smoke-test the harness at toy sizes on CPU;
-# the driver runs the defaults (flagship shapes) on the real chip
-BATCH = int(os.environ.get("BENCH_BATCH", 80))
-WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
-ITERS = int(os.environ.get("BENCH_ITERS", 10))
+# the driver runs the defaults (flagship shapes) on the real chip. Parsing
+# must not throw at import time — the contract is a JSON diagnostic, never a
+# bare traceback (and scripts/perf_model.py imports this module for its
+# constants).
+_ENV_ERRORS: list = []
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        _ENV_ERRORS.append(f"{name}={raw!r} is not an integer")
+        return default
+
+
+BATCH = _env_int("BENCH_BATCH", 80)
+WARMUP = _env_int("BENCH_WARMUP", 3)
+ITERS = _env_int("BENCH_ITERS", 10)
 
 MAX_ATTEMPTS = 6
 BACKOFF_S = (5, 10, 20, 40, 60)  # >= 5 attempts spread over >= 2 minutes
-ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900))
-DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", 2400))  # whole-run cap
+ATTEMPT_TIMEOUT_S = _env_int("BENCH_ATTEMPT_TIMEOUT_S", 900)
+DEADLINE_S = _env_int("BENCH_DEADLINE_S", 2400)  # whole-run cap
 _START = time.monotonic()
 
 # Each measurement attempt runs in a CHILD process: SIGALRM cannot interrupt a
@@ -79,6 +96,46 @@ def _peak_flops(device_kind: str) -> float:
     return 197e12  # default to v5e-class
 
 
+def flagship_config(fused: bool):
+    """The flagship recipe (ResNet-34, CUB-200 shapes, bf16 trunk) — the ONE
+    definition compiled by both this bench and scripts/perf_model.py, so the
+    analytic pre-registration in PERF.md can never drift from what is timed
+    on hardware."""
+    from mgproto_tpu.config import Config, ModelConfig
+
+    return Config(
+        model=ModelConfig(
+            arch="resnet34",
+            num_classes=200,
+            pretrained=False,
+            # bf16 trunk on the MXU; params/BN-stats/density/losses stay f32
+            compute_dtype="bfloat16",
+            fused_scoring=fused,
+        )
+    )
+
+
+def flops_from_cost_analysis(compiled, strict: bool = False):
+    """Flop count of a compiled module, tolerating the cost_analysis return
+    shapes seen across jax versions (dict, list-of-dict, None). strict=False
+    returns None when unavailable (bench treats MFU as a best-effort extra);
+    strict=True raises SystemExit (perf_model's flop count IS its output)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = ca.get("flops") if ca else None
+        if f and f > 0:
+            return float(f)
+    except Exception:
+        pass
+    if strict:
+        raise SystemExit(
+            "cost_analysis returned no usable flop count on this backend"
+        )
+    return None
+
+
 def run_config(fused: bool) -> dict:
     """Steady-state throughput for one scoring path. Returns
     {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}."""
@@ -90,19 +147,9 @@ def run_config(fused: bool) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from mgproto_tpu.config import Config, ModelConfig
     from mgproto_tpu.engine.train import Trainer
 
-    cfg = Config(
-        model=ModelConfig(
-            arch="resnet34",
-            num_classes=200,
-            pretrained=False,
-            # bf16 trunk on the MXU; params/BN-stats/density/losses stay f32
-            compute_dtype="bfloat16",
-            fused_scoring=fused,
-        )
-    )
+    cfg = flagship_config(fused)
     trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
@@ -138,17 +185,8 @@ def run_config(fused: bool) -> dict:
         state, images, labels, use_mine_arr, update_gmm_arr, warm=False
     ).compile()
 
-    flops = None
-    try:  # best-effort: some PJRT plugins return no cost model
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        if ca:
-            f = ca.get("flops")
-            if f and f > 0:
-                flops = float(f)
-    except Exception:
-        flops = None
+    flops = flops_from_cost_analysis(compiled)  # best-effort: some PJRT
+    # plugins return no cost model; MFU is then simply omitted
 
     def step(s):
         s, m = compiled(s, images, labels, use_mine_arr, update_gmm_arr)
@@ -231,18 +269,13 @@ def robust_measure(fused: bool) -> tuple:
 
 
 def main() -> None:
-    if BATCH <= 0 or ITERS <= 0:
+    if _ENV_ERRORS or BATCH <= 0 or ITERS <= 0:
         # deterministic misconfig: report immediately, don't retry 12 children
-        print(
-            json.dumps(
-                {
-                    "error": f"invalid BENCH_BATCH={BATCH} / BENCH_ITERS="
-                             f"{ITERS}: both must be > 0",
-                    "attempts": 0,
-                    "errors": {},
-                }
-            )
+        detail = "; ".join(_ENV_ERRORS) or (
+            f"invalid BENCH_BATCH={BATCH} / BENCH_ITERS={ITERS}: "
+            f"both must be > 0"
         )
+        print(json.dumps({"error": detail, "attempts": 0, "errors": {}}))
         raise SystemExit(1)
     results = {}
     errors = {}

@@ -12,7 +12,7 @@ while `bytes accessed` is NOT (CPU fusion differs from TPU), so bytes are
 reported as a caveated upper bound only. On-device MFU from real step time is
 bench.py's job; this script pre-registers what to expect.
 
-Usage: python scripts/perf_model.py [--batch 80] [--arch resnet34] [--smoke]
+Usage: python scripts/perf_model.py [--batch 80] [--smoke]
 Prints one JSON line; paste-ready for PERF.md.
 """
 
@@ -25,9 +25,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# single source for the comparison constants: the on-device bench harness
-# (its module level is import-safe — stdlib imports and constants only)
-from bench import NORTH_STAR_PER_CHIP, _PEAK_BF16  # noqa: E402
+# single source for the flagship recipe, flop extraction, and comparison
+# constants: the on-device bench harness (its module level is import-safe:
+# stdlib imports, constants, and env parsing that records errors instead of
+# raising)
+from bench import (  # noqa: E402
+    NORTH_STAR_PER_CHIP,
+    _PEAK_BF16,
+    flagship_config,
+    flops_from_cost_analysis,
+)
 
 V5E_PEAK_BF16 = _PEAK_BF16["v5e"]
 
@@ -35,11 +42,11 @@ V5E_PEAK_BF16 = _PEAK_BF16["v5e"]
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=80)
-    p.add_argument("--arch", default="resnet34")
-    p.add_argument("--classes", type=int, default=200)
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes: validates the harness in seconds")
     args = p.parse_args()
+    if args.batch <= 0:
+        p.error(f"--batch must be > 0, got {args.batch}")
 
     from mgproto_tpu.hermetic import pin_cpu_devices
 
@@ -48,22 +55,15 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from mgproto_tpu.config import Config, ModelConfig, tiny_test_config
+    from mgproto_tpu.config import tiny_test_config
     from mgproto_tpu.engine.train import Trainer
 
     if args.smoke:
         cfg = tiny_test_config()
         batch = 4
     else:
-        cfg = Config(
-            model=ModelConfig(
-                arch=args.arch,
-                num_classes=args.classes,
-                pretrained=False,
-                compute_dtype="bfloat16",
-                fused_scoring=False,
-            )
-        )
+        # THE flagship recipe bench.py times on hardware, by construction
+        cfg = flagship_config(fused=False)
         batch = args.batch
 
     trainer = Trainer(cfg, steps_per_epoch=100)
@@ -72,29 +72,21 @@ def main() -> None:
                      jnp.float32)
     lbls = jnp.zeros((batch,), jnp.int32)
 
-    def flops_of(compiled) -> float:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) if ca else 0.0
-        if flops <= 0.0:
-            # the flop count IS this script's output — fail fast, don't
-            # print a plausible-looking zero (bench.py degrades gracefully
-            # because for it MFU is a best-effort extra; here it's the point)
-            raise SystemExit(
-                "cost_analysis returned no usable flop count on this backend"
-            )
-        return flops
-
-    train_flops = flops_of(
+    # strict: the flop count IS this script's output — fail fast rather than
+    # print a plausible-looking zero (bench.py uses the same helper lenient,
+    # because for it MFU is a best-effort extra)
+    train_flops = flops_from_cost_analysis(
         trainer._train_step.lower(
             state, imgs, lbls, jnp.asarray(1.0, jnp.float32),
             jnp.asarray(True, bool), warm=False,
-        ).compile()
+        ).compile(),
+        strict=True,
     )
-    eval_flops = flops_of(trainer._eval_step.lower(state, imgs, lbls).compile())
+    eval_flops = flops_from_cost_analysis(
+        trainer._eval_step.lower(state, imgs, lbls).compile(), strict=True
+    )
 
-    per_img = train_flops / batch
+    per_img = train_flops / batch  # > 0: strict extraction above
     out = {
         "arch": cfg.model.arch,
         "batch": batch,
@@ -104,10 +96,11 @@ def main() -> None:
         "v5e_imgs_per_sec_chip_at_mfu": {
             f"{int(m * 100)}%": round(V5E_PEAK_BF16 * m / per_img, 1)
             for m in (0.2, 0.4, 0.6)
-        } if per_img else {},
-        f"mfu_needed_for_north_star_{NORTH_STAR_PER_CHIP}_imgs_s_chip": round(
+        },
+        "north_star_imgs_per_sec_chip": NORTH_STAR_PER_CHIP,
+        "mfu_needed_for_north_star": round(
             NORTH_STAR_PER_CHIP * per_img / V5E_PEAK_BF16, 4
-        ) if per_img else None,
+        ),
     }
     print(json.dumps(out))
 

@@ -119,3 +119,38 @@ def test_bench_rejects_misconfig_without_retrying():
     assert proc.returncode == 1
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert "invalid BENCH_BATCH" in out["error"] and out["attempts"] == 0
+
+
+def test_perf_model_smoke_contract():
+    """`scripts/perf_model.py --smoke` must print one JSON line with a
+    positive flop count and the derived roofline fields (PERF.md's numbers
+    are regenerated from this script; a broken harness would silently
+    strand the doc)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_model.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, env=_driver_env(),
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stderr or proc.stdout)[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["train_flops_per_step"] > 0
+    assert out["mfu_needed_for_north_star"] >= 0
+    assert out["north_star_imgs_per_sec_chip"] > 0
+    assert set(out["v5e_imgs_per_sec_chip_at_mfu"]) == {"20%", "40%", "60%"}
+
+
+def test_bench_rejects_non_numeric_env_with_json_diagnostic():
+    """A malformed BENCH_* var must produce the JSON diagnostic contract,
+    not an import-time int() traceback (which would also break
+    scripts/perf_model.py's constant import)."""
+    env = _driver_env()
+    env.update(BENCH_ITERS="abc")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "BENCH_ITERS" in out["error"] and "not an integer" in out["error"]
+    assert out["attempts"] == 0
