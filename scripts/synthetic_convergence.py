@@ -108,7 +108,7 @@ def compare_prune_styles(cfg) -> dict:
 
 
 def build_config(workdir: str, arch: str, classes: int, epochs: int,
-                 batch: int, ood_dirs=()):
+                 batch: int, ood_dirs=(), compute_dtype: str = "float32"):
     """The evidence Config shared by this script and synthetic_ood.py —
     the OoD evaluation must restore checkpoints under the EXACT training-time
     model config."""
@@ -131,6 +131,7 @@ def build_config(workdir: str, arch: str, classes: int, epochs: int,
             mine_T=4,
             mem_capacity=64,
             pretrained=False,
+            compute_dtype=compute_dtype,
         ),
         schedule=ScheduleConfig(
             num_train_epochs=epochs,
@@ -167,6 +168,9 @@ def main() -> None:
     p.add_argument("--per_class", type=int, default=40)
     p.add_argument("--arch", default="tiny")
     p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--compute_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="trunk compute dtype (the TPU recipe uses bfloat16)")
     args = p.parse_args()
 
     from mgproto_tpu.hermetic import pin_cpu_devices
@@ -181,7 +185,8 @@ def main() -> None:
     make_dataset(data_root, args.classes, args.per_class, test_per_class=16)
 
     cfg = build_config(
-        args.workdir, args.arch, args.classes, args.epochs, args.batch
+        args.workdir, args.arch, args.classes, args.epochs, args.batch,
+        compute_dtype=args.compute_dtype,
     )
 
     _, accuracy = run_training(cfg, render_push=False, target_accu=0.3)
@@ -207,6 +212,7 @@ def main() -> None:
         "driver": "mgproto_tpu.cli.train.run_training (warm/joint, mine, EM, "
                   "push, prune all exercised)",
         "arch": args.arch,
+        "compute_dtype": args.compute_dtype,
         "classes": args.classes,
         "epochs": args.epochs,
         "chance_accuracy": 1.0 / args.classes,

@@ -92,6 +92,7 @@ def main() -> None:
     from mgproto_tpu.engine.train import Trainer
     from mgproto_tpu.utils.checkpoint import (
         list_checkpoints,
+        load_metadata,
         restore_checkpoint,
     )
 
@@ -106,9 +107,13 @@ def main() -> None:
     path = ckpts[-1][-1]
 
     ood_dirs = make_ood_sets(os.path.join(args.workdir, "data"))
+    # adopt the training-time trunk dtype recorded in the checkpoint (what
+    # cli/evaluate.py does): p(x)/OoD numbers must reflect the numerics the
+    # model trained under, not a silent f32 default
+    ckpt_dtype = (load_metadata(path) or {}).get("compute_dtype", "float32")
     cfg = sc.build_config(
         args.workdir, args.arch, args.classes, args.epochs, args.batch,
-        ood_dirs=ood_dirs,
+        ood_dirs=ood_dirs, compute_dtype=ckpt_dtype,
     )
 
     _, _, test_loader, ood_loaders = build_pipelines(cfg)
@@ -125,6 +130,7 @@ def main() -> None:
                 "train_and_test.py:161-238 semantics: 5th-percentile ID "
                 "threshold, FPR = OoD fraction predicted in-distribution)",
         "arch": args.arch,
+        "compute_dtype": ckpt_dtype,
         "checkpoint": os.path.basename(path),
         "id_set": "synthetic 8-class test split",
         "ood_sets": {"ood1": "random checkerboards",
