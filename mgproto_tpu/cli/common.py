@@ -85,6 +85,12 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
         help="renormalize kept priors after pruning (beyond-parity; "
              "preserves per-class mixture mass, recompute OoD thresholds)",
     )
+    p.add_argument(
+        "--em_reference_stepping", action="store_true",
+        help="reference-exact EM: sequential per-class Adam steps incl. the "
+             "torch moment-decay drift (slower; default is the vmapped "
+             "all-class step — see core/em.py)",
+    )
     p.add_argument("--no_pretrained", action="store_true")
     # default matches ModelConfig so pre-existing f32 checkpoints evaluate
     # under the numerics they trained with; launch_tpu.sh opts into bf16
@@ -136,7 +142,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             fused_scoring=args.fused_scoring,
             remat=args.remat,
         ),
-        em=EMConfig(),
+        em=EMConfig(reference_stepping=args.em_reference_stepping),
         optim=OptimConfig(),
         schedule=ScheduleConfig(
             num_train_epochs=args.epochs,

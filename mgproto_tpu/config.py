@@ -56,6 +56,15 @@ class EMConfig:
     diversity_lambda: float = 1.0  # diversity cost weight (model.py:367)
     mean_lr: float = 3e-3  # Adam on means (settings.py:29 'prototype_vectors')
     update_interval: int = 1  # EM every N train iterations (model.py:171)
+    # False (default): TPU-native stepping — ONE Adam step per EM round over
+    # all classes at once, inactive classes pinned exactly (core/em.py
+    # docstring). True: reference-exact stepping — sequential per-class Adam
+    # steps on the shared means tensor, reproducing the reference's
+    # step-count/bias-correction bookkeeping AND its zero-grad moment-decay
+    # drift of other classes' means (model.py:281-298 under one torch Adam,
+    # main.py:223-227). Slower (C sequential steps per round); exists so the
+    # deviation is a switch, not a belief.
+    reference_stepping: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

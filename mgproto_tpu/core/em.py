@@ -16,7 +16,11 @@ are masked out of the loss, and ONE Adam step per EM round updates the whole
 [C, K, d] means tensor. Deliberate deviation from the reference: inactive
 classes' means are pinned exactly (the final jnp.where), whereas torch Adam
 lets zero-grad params drift under nonzero moment decay — the drift is an
-optimizer artifact, not a modeling choice, so we don't reproduce it.
+optimizer artifact, not a modeling choice, so we don't reproduce it by
+default. `EMConfig.reference_stepping=True` switches to a reference-exact
+sequential path (`_reference_em_update`) that reproduces the torch
+bookkeeping — per-(class, round) Adam steps, shared moments, drift included —
+measured against a torch oracle in tests/test_em_parity.py.
 """
 
 from __future__ import annotations
@@ -50,6 +54,28 @@ def make_mean_optimizer(cfg: EMConfig) -> optax.GradientTransformation:
     return optax.adam(cfg.mean_lr)
 
 
+def _class_objective(
+    mu: jax.Array,
+    x: jax.Array,
+    resp: jax.Array,
+    pi_old: jax.Array,
+    sigmas: jax.Array,
+    lam: float,
+    eps: float = 1e-10,
+) -> jax.Array:
+    """The reference's per-class gmm_loss (model.py:387-393): responsibility-
+    weighted NLL + diversity cost. Shapes: mu/sigmas [K,d], x [N,d],
+    resp [N,K], pi_old [K]. The ONE definition of the M-step objective —
+    vmapped by `_m_step_objective`, sliced by `_reference_em_update` — so the
+    two EM modes provably optimize the same loss."""
+    ll = diag_gaussian_log_prob(x, mu, sigmas) + jnp.log(pi_old + eps)
+    weighted_nll = -jnp.mean(jnp.sum(resp * ll, axis=-1))
+    pair = pairwise_sq_dists(mu, mu)
+    off = 1.0 - jnp.eye(mu.shape[0])
+    diversity = jnp.sum(jnp.exp(-pair) * off) / jnp.sum(off)
+    return weighted_nll + lam * diversity
+
+
 def _m_step_objective(
     means: jax.Array,
     x: jax.Array,
@@ -58,23 +84,101 @@ def _m_step_objective(
     sigmas: jax.Array,
     active: jax.Array,
     lam: float,
-    eps: float = 1e-10,
 ) -> jax.Array:
-    """Masked sum over classes of the reference's per-class gmm_loss
-    (model.py:387-393). Shapes: means/sigmas [C,K,d], x [C,N,d],
-    resp [C,N,K], pi_old [C,K], active [C]."""
-    ll = jax.vmap(diag_gaussian_log_prob)(x, means[:, None], sigmas[:, None])
-    # vmap gives [C, N, 1, K]; weighted NLL: sum over K, mean over N
-    ll = ll[:, :, 0, :] + jnp.log(pi_old + eps)[:, None, :]  # [C, N, K]
-    weighted_nll = -jnp.mean(jnp.sum(resp * ll, axis=-1), axis=-1)  # [C]
-
-    pair = jax.vmap(pairwise_sq_dists)(means, means)  # [C, K, K]
-    k = means.shape[1]
-    off = 1.0 - jnp.eye(k)
-    diversity = jnp.sum(jnp.exp(-pair) * off, axis=(1, 2)) / jnp.sum(off)  # [C]
-
-    per_class = weighted_nll + lam * diversity
+    """Masked sum over classes of `_class_objective`. Shapes: means/sigmas
+    [C,K,d], x [C,N,d], resp [C,N,K], pi_old [C,K], active [C]."""
+    per_class = jax.vmap(_class_objective, in_axes=(0, 0, 0, 0, 0, None))(
+        means, x, resp, pi_old, sigmas, lam
+    )
     return jnp.sum(per_class * active)
+
+
+def _reference_em_update(
+    gmm: GMMState,
+    memory: Memory,
+    opt_state: optax.OptState,
+    mean_tx: optax.GradientTransformation,
+    cfg: EMConfig,
+    eps: float = 1e-10,
+) -> Tuple[GMMState, Memory, optax.OptState, EMAux]:
+    """Reference-exact stepping (cfg.reference_stepping=True).
+
+    Reproduces the reference's control flow under jit: a sequential scan over
+    classes IN ORDER (model.py:281); per active class, `num_em_loop` rounds of
+    E-step → smoothed responsibilities → ONE Adam step whose gradient is
+    nonzero only in that class's slice but which updates the WHOLE [C,K,d]
+    tensor through the shared optimizer state (torch keeps one Adam over the
+    full parameter, main.py:223-227 — zero-grad slices still move under
+    moment decay, and the step count advances once per (class, round)) →
+    τ-momentum prior write-back for that class. Inactive classes take no
+    step of their own but DO drift during other classes' steps — the exact
+    torch artifact the default path deliberately removes."""
+    c_num, cap, _ = memory.feats.shape
+    active = memory.updated & (memory.length == cap)
+    x_all = memory.feats
+    lam = cfg.diversity_lambda
+
+    def class_step(carry, c):
+        means, priors, opt_state = carry
+        xc = x_all[c]  # [N, d]
+        sig_c = gmm.sigmas[c]  # [K, d]
+
+        def run(args):
+            means, priors, opt_state = args
+
+            def em_round(inner, _):
+                means, pi_old, opt_state = inner
+                ll_c, log_resp = e_step(xc, means[c], sig_c, pi_old)
+                resp = jnp.exp(log_resp)
+                resp = (resp + cfg.alpha) / jnp.sum(
+                    resp + cfg.alpha, axis=-1, keepdims=True
+                )
+                pi_unnorm = jnp.sum(resp, axis=0) + eps
+
+                def obj(m):
+                    # m[c]: only this class's slice carries gradient
+                    return _class_objective(
+                        m[c], xc, resp, pi_old, sig_c, lam, eps
+                    )
+
+                loss, grads = jax.value_and_grad(obj)(means)
+                updates, opt_state = mean_tx.update(grads, opt_state, means)
+                means = optax.apply_updates(means, updates)
+                pi_old = momentum_update(pi_old, pi_unnorm / cap, cfg.tau)
+                return (means, pi_old, opt_state), (loss, ll_c)
+
+            (means, pi_old, opt_state), (losses, lls) = jax.lax.scan(
+                em_round, (means, priors[c], opt_state), None,
+                length=cfg.num_em_loop,
+            )
+            priors = priors.at[c].set(pi_old)
+            return means, priors, opt_state, losses[-1], lls[-1]
+
+        def skip(args):
+            means, priors, opt_state = args
+            return means, priors, opt_state, jnp.zeros(()), jnp.zeros(())
+
+        means, priors, opt_state, loss, ll = jax.lax.cond(
+            active[c], run, skip, (means, priors, opt_state)
+        )
+        return (means, priors, opt_state), (loss, ll)
+
+    (means, priors, opt_state), (losses, lls) = jax.lax.scan(
+        class_step, (gmm.means, gmm.priors, opt_state), jnp.arange(c_num)
+    )
+    active_f = active.astype(jnp.float32)
+    n_active = jnp.maximum(jnp.sum(active_f), 1.0)
+    new_gmm = gmm._replace(means=means, priors=priors)
+    return (
+        new_gmm,
+        clear_updated(memory),
+        opt_state,
+        EMAux(
+            loss=jnp.sum(losses * active_f),
+            num_active=jnp.sum(active),
+            log_likelihood=jnp.sum(lls * active_f) / n_active,
+        ),
+    )
 
 
 def em_update(
@@ -86,7 +190,11 @@ def em_update(
     eps: float = 1e-10,
 ) -> Tuple[GMMState, Memory, optax.OptState, EMAux]:
     """One full EM call (reference `update_GMM`, model.py:277-301). Jittable;
-    call every `update_interval` training steps once the epoch gate is open."""
+    call every `update_interval` training steps once the epoch gate is open.
+    Dispatches on cfg.reference_stepping (a static config bool): the
+    TPU-native vmapped path below, or the reference-exact sequential path."""
+    if cfg.reference_stepping:
+        return _reference_em_update(gmm, memory, opt_state, mean_tx, cfg, eps)
     c, cap, _ = memory.feats.shape
     active = memory.updated & (memory.length == cap)  # model.py:283,289
     active_f = active.astype(jnp.float32)
