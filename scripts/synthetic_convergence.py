@@ -29,18 +29,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def make_dataset(root: str, num_classes: int, per_class: int, test_per_class: int,
-                 img: int = 64, seed: int = 0) -> None:
+                 img: int = 64, seed: int = 0, blob_only: bool = False):
     """Class-separable synthetic ImageFolder: each class is a distinct
-    oriented sinusoidal texture + tinted blob, plus per-image noise/jitter."""
+    oriented sinusoidal texture + tinted blob, plus per-image noise/jitter.
+
+    Returns {split: [(class, filename, blob_cx_px, blob_cy_px), ...]} — the
+    blob center doubles as a "part" annotation for interpretability evidence
+    (scripts/synthetic_interp.py).
+
+    blob_only=True makes the blob the ONLY class cue (shared neutral texture
+    for every class; class tint on the blob alone) — prototypes then MUST
+    localize the blob to classify, which is the regime where part-consistency
+    metrics are meaningful."""
     from PIL import Image
 
     rng = np.random.RandomState(seed)
+    records = {"train": [], "test": []}
     for split, n in (("train", per_class), ("test", test_per_class)):
         for c in range(num_classes):
             d = os.path.join(root, split, f"class_{c:03d}")
             os.makedirs(d, exist_ok=True)
-            angle = np.pi * c / num_classes
-            freq = 2.0 + 1.5 * (c % 4)
+            angle = 0.4 if blob_only else np.pi * c / num_classes
+            freq = 3.0 if blob_only else 2.0 + 1.5 * (c % 4)
             tint = np.array(
                 [
                     0.5 + 0.5 * np.cos(2 * np.pi * c / num_classes),
@@ -48,6 +58,10 @@ def make_dataset(root: str, num_classes: int, per_class: int, test_per_class: in
                     0.5 + 0.5 * np.cos(2 * np.pi * c / num_classes + 2.0),
                 ]
             )
+            # blob_only: neutral gray texture for EVERY class; class tint
+            # appears exclusively on the blob
+            wave_tint = np.full(3, 0.5) if blob_only else tint
+            blob_amp = 0.45 if blob_only else 0.3
             yy, xx = np.mgrid[0:img, 0:img] / img
             for i in range(n):
                 phase = rng.uniform(0, 2 * np.pi)
@@ -57,10 +71,15 @@ def make_dataset(root: str, num_classes: int, per_class: int, test_per_class: in
                 )
                 cx, cy = rng.uniform(0.3, 0.7, size=2)
                 blob = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 0.02))
-                base = 0.45 + 0.25 * wave[..., None] * tint + 0.3 * blob[..., None] * tint
+                base = (0.45 + 0.25 * wave[..., None] * wave_tint
+                        + blob_amp * blob[..., None] * tint)
                 noisy = base + rng.normal(0, 0.06, size=(img, img, 3))
                 arr = (np.clip(noisy, 0, 1) * 255).astype(np.uint8)
-                Image.fromarray(arr).save(os.path.join(d, f"{i:04d}.png"))
+                name = f"{i:04d}.png"
+                Image.fromarray(arr).save(os.path.join(d, name))
+                # (x, y) pixel coords, CUB part_locs convention (col, row)
+                records[split].append((c, name, cx * img, cy * img))
+    return records
 
 
 def compare_prune_styles(cfg) -> dict:
