@@ -22,7 +22,7 @@ from mgproto_tpu.cli.train import _test
 from mgproto_tpu.data import build_pipelines
 from mgproto_tpu.parallel import ShardedTrainer
 from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
-from mgproto_tpu.utils.checkpoint import load_metadata
+from mgproto_tpu.utils.checkpoint import adopt_checkpoint_dtype
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -47,18 +47,7 @@ def main(argv: Optional[list] = None) -> None:
     )
     if not path:
         raise FileNotFoundError(f"no checkpoint found in {cfg.model_dir}")
-    # adopt the training-time trunk dtype recorded in the checkpoint: eval
-    # under different numerics silently shifts p(x)/OoD metrics
-    meta = load_metadata(path) or {}
-    ckpt_dtype = meta.get("compute_dtype")
-    if ckpt_dtype and ckpt_dtype != cfg.model.compute_dtype:
-        print(
-            f"note: checkpoint was trained with compute_dtype={ckpt_dtype}; "
-            f"overriding --compute_dtype {cfg.model.compute_dtype}"
-        )
-        import dataclasses as _dc
-
-        cfg = cfg.replace(model=_dc.replace(cfg.model, compute_dtype=ckpt_dtype))
+    cfg = adopt_checkpoint_dtype(cfg, path, log=print)
 
     trainer = ShardedTrainer(cfg, steps_per_epoch=1)
     state = trainer.init_state(jax.random.PRNGKey(cfg.seed), for_restore=True)

@@ -121,3 +121,41 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     """Highest-epoch checkpoint path (the resume point the reference lacks)."""
     ckpts = list_checkpoints(ckpt_dir)
     return ckpts[-1][3] if ckpts else None
+
+
+def select_checkpoint(ckpt_dir: str, stage: str = "nopush",
+                      policy: str = "best"):
+    """(epoch, stage, acc, path) of the requested stage, or None.
+
+    policy='best' — highest test accuracy: how the reference chose its
+    released eval checkpoints (eval_purity.py:55 `104nopush0.8224`).
+    policy='latest' — highest epoch. One definition for every evidence/eval
+    consumer so checkpoint-selection can't silently diverge between them."""
+    if policy not in ("best", "latest"):
+        raise ValueError(f"unknown policy {policy!r}")
+    ckpts = [c for c in list_checkpoints(ckpt_dir) if c[1] == stage]
+    if not ckpts:
+        return None
+    return max(ckpts, key=lambda c: c[2]) if policy == "best" else ckpts[-1]
+
+
+def adopt_checkpoint_dtype(cfg, path: str, log=None):
+    """Return cfg with `model.compute_dtype` overridden by the checkpoint's
+    recorded training-time dtype: evaluating under different numerics
+    silently shifts the p(x) scale OoD thresholding rides on. The single
+    definition behind cli/evaluate, cli/interpret, and the evidence
+    scripts."""
+    import dataclasses
+
+    meta = load_metadata(path) or {}
+    ckpt_dtype = meta.get("compute_dtype")
+    if ckpt_dtype and ckpt_dtype != cfg.model.compute_dtype:
+        if log is not None:
+            log(
+                f"note: checkpoint was trained with compute_dtype="
+                f"{ckpt_dtype}; overriding {cfg.model.compute_dtype}"
+            )
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, compute_dtype=ckpt_dtype)
+        )
+    return cfg

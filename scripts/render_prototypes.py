@@ -1,0 +1,111 @@
+"""Render prototype visualizations from a trained synthetic run.
+
+Produces the reference's signature interpretability artifact (push.py:202-226:
+original image with prototype bbox, activation heatmap overlay, and the
+prototype patch crop — three files per pushed prototype) from a
+`scripts/synthetic_interp.py` / `synthetic_convergence.py` workdir, and
+copies a small per-class sample into --out for the evidence directory.
+
+On the blob_only interp run the rendered boxes should visibly sit on the
+class-tinted blob — the picture version of the consistency metric.
+
+Usage: python scripts/render_prototypes.py \
+           --workdir /tmp/mgproto_synth_interp --out evidence/interp/prototypes
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import synthetic_convergence as sc  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", default="/tmp/mgproto_synth_interp")
+    p.add_argument("--arch", default="tiny")
+    p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=25,
+                   help="training-time epochs (config must match restore)")
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--out", default="evidence/interp/prototypes")
+    p.add_argument("--sample_classes", type=int, default=2,
+                   help="copy renders for this many classes into --out")
+    args = p.parse_args()
+
+    from mgproto_tpu.hermetic import pin_cpu_devices
+
+    pin_cpu_devices(1)
+
+    import jax
+
+    from mgproto_tpu.data import build_pipelines
+    from mgproto_tpu.engine.push import push_prototypes
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.utils.checkpoint import (
+        adopt_checkpoint_dtype,
+        restore_checkpoint,
+        select_checkpoint,
+    )
+
+    cfg = sc.build_config(
+        args.workdir, args.arch, args.classes, args.epochs, args.batch
+    )
+    found = select_checkpoint(cfg.model_dir, stage="nopush", policy="best")
+    if found is None:
+        raise FileNotFoundError(
+            f"no nopush checkpoint in {cfg.model_dir} — run "
+            f"scripts/synthetic_interp.py (or synthetic_convergence.py) first"
+        )
+    _, _, ckpt_acc, path = found
+    cfg = adopt_checkpoint_dtype(cfg, path, log=print)
+
+    _, push_loader, _, _ = build_pipelines(cfg)
+    push_ds = push_loader.dataset
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0), for_restore=True)
+    state = restore_checkpoint(path, state)
+    print(f"loaded {path} (test acc {ckpt_acc})")
+
+    render_dir = os.path.join(args.workdir, "render")
+    shutil.rmtree(render_dir, ignore_errors=True)
+    _, result = push_prototypes(
+        trainer,
+        state,
+        iter(push_loader),
+        save_dir=render_dir,
+        load_image=lambda i: push_ds.load(i)[0],
+    )
+    n_pushed = int(result.pushed.sum())
+    files = sorted(os.listdir(render_dir))
+    assert files, "push rendered nothing"
+    print(f"rendered {len(files)} files for {n_pushed} pushed prototypes")
+
+    # filenames are "{j}prototype-*.jpg" with flat j = class*K + k
+    # (engine/push.py:_render, matching the reference's naming) — keep the
+    # renders of the first `sample_classes` classes
+    os.makedirs(args.out, exist_ok=True)
+    k_per_class = cfg.model.prototypes_per_class
+    cutoff = args.sample_classes * k_per_class
+    kept = 0
+    for f in files:
+        digits = ""
+        for ch in f:
+            if ch.isdigit():
+                digits += ch
+            else:
+                break
+        if digits and int(digits) < cutoff:
+            shutil.copy(os.path.join(render_dir, f), os.path.join(args.out, f))
+            kept += 1
+    assert kept > 0, f"no renders matched the naming scheme: {files[:5]}"
+    print(f"copied {kept} renders to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

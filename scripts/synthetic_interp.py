@@ -130,12 +130,12 @@ def main() -> None:
     # evals load nopush checkpoints (eval_purity.py:55 `104nopush0.8224`,
     # eval_consistency.py:50) — push/prune under-convergence artifacts are
     # analyzed separately in evidence/README.md
-    from mgproto_tpu.utils.checkpoint import list_checkpoints
+    from mgproto_tpu.utils.checkpoint import select_checkpoint
 
-    nopush = [c for c in list_checkpoints(cfg.model_dir) if c[1] == "nopush"]
-    if not nopush:
+    found = select_checkpoint(cfg.model_dir, stage="nopush", policy="best")
+    if found is None:
         raise RuntimeError(f"no nopush checkpoint in {cfg.model_dir}")
-    epoch_n, _, ckpt_acc, ckpt_path = max(nopush, key=lambda c: c[2])
+    epoch_n, _, ckpt_acc, ckpt_path = found
 
     # the production interpret CLI on the production checkpoint; flags must
     # restate build_config's tiny shapes (proto_dim 16, K=5, emb 8, T=4)

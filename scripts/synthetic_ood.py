@@ -91,30 +91,29 @@ def main() -> None:
     from mgproto_tpu.data import build_pipelines
     from mgproto_tpu.engine.train import Trainer
     from mgproto_tpu.utils.checkpoint import (
-        list_checkpoints,
-        load_metadata,
+        adopt_checkpoint_dtype,
         restore_checkpoint,
+        select_checkpoint,
     )
 
     run_dir = os.path.join(args.workdir, "run")
-    ckpts = [c for c in list_checkpoints(run_dir) if c[1] == args.stage]
-    if not ckpts:
+    found = select_checkpoint(run_dir, stage=args.stage, policy="latest")
+    if found is None:
         raise FileNotFoundError(
             f"no '{args.stage}' checkpoint in {run_dir} — run "
             f"scripts/synthetic_convergence.py --workdir {args.workdir} "
             f"--arch {args.arch} first"
         )
-    path = ckpts[-1][-1]
+    path = found[-1]
 
     ood_dirs = make_ood_sets(os.path.join(args.workdir, "data"))
-    # adopt the training-time trunk dtype recorded in the checkpoint (what
-    # cli/evaluate.py does): p(x)/OoD numbers must reflect the numerics the
-    # model trained under, not a silent f32 default
-    ckpt_dtype = (load_metadata(path) or {}).get("compute_dtype", "float32")
     cfg = sc.build_config(
         args.workdir, args.arch, args.classes, args.epochs, args.batch,
-        ood_dirs=ood_dirs, compute_dtype=ckpt_dtype,
+        ood_dirs=ood_dirs,
     )
+    # p(x)/OoD numbers must reflect the numerics the model trained under,
+    # not a silent f32 default
+    cfg = adopt_checkpoint_dtype(cfg, path, log=print)
 
     _, _, test_loader, ood_loaders = build_pipelines(cfg)
     trainer = Trainer(cfg, steps_per_epoch=1)
@@ -130,7 +129,7 @@ def main() -> None:
                 "train_and_test.py:161-238 semantics: 5th-percentile ID "
                 "threshold, FPR = OoD fraction predicted in-distribution)",
         "arch": args.arch,
-        "compute_dtype": ckpt_dtype,
+        "compute_dtype": cfg.model.compute_dtype,
         "checkpoint": os.path.basename(path),
         "id_set": "synthetic 8-class test split",
         "ood_sets": {"ood1": "random checkerboards",
