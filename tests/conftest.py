@@ -24,3 +24,23 @@ if os.environ.get("MGPROTO_TEST_TPU") != "1":
 # is either on the virtual CPU mesh or on the TPU, never both, so under the
 # flag run ONLY that file — the rest of the suite requires the 8-device pin:
 #   MGPROTO_TEST_TPU=1 python -m pytest tests/test_tpu_execution.py
+
+
+def prefill_full_memory(state, seed: int = 1):
+    """Fill every class queue with L2-normalized features and mark all
+    classes touched, so the next train step runs EM for ALL classes
+    (`updated & length==capacity`). Shared by the reference-stepping tests
+    in test_em_parity.py and test_parallel.py."""
+    import jax
+    import jax.numpy as jnp
+
+    mem = state.memory
+    feats = jax.random.uniform(jax.random.PRNGKey(seed), mem.feats.shape)
+    feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+    return state.replace(
+        memory=mem._replace(
+            feats=feats,
+            length=jnp.full_like(mem.length, mem.capacity),
+            updated=jnp.ones_like(mem.updated),
+        )
+    )

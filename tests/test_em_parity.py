@@ -318,16 +318,9 @@ def test_em_reference_stepping_inside_jitted_train_step():
     cfg = cfg.replace(em=dataclasses.replace(cfg.em, reference_stepping=True))
     tr = Trainer(cfg, steps_per_epoch=4)
     state = tr.init_state(jax.random.PRNGKey(0))
-    mem = state.memory
-    feats = jax.random.uniform(jax.random.PRNGKey(1), mem.feats.shape)
-    feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
-    state = state.replace(
-        memory=mem._replace(
-            feats=feats,
-            length=jnp.full_like(mem.length, mem.capacity),
-            updated=jnp.ones_like(mem.updated),
-        )
-    )
+    from conftest import prefill_full_memory
+
+    state = prefill_full_memory(state)
     rng = np.random.RandomState(0)
     imgs = jnp.asarray(
         rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3), jnp.float32

@@ -142,3 +142,42 @@ def test_imagenet_scale_class_sharding():
     assert st.gmm.means.sharding.spec == jax.sharding.PartitionSpec("model")
     out = tr.eval_step(st, imgs, lbls)
     assert out.logits.shape == (8, 1000)
+
+
+def test_sharded_reference_stepping_matches_single_device(cfg):
+    """The reference-exact EM path (sequential class scan + shared Adam on
+    the full means tensor) under a (data x model) mesh == single-device,
+    with the memory pre-filled so EM is fully active."""
+    import dataclasses
+
+    rcfg = cfg.replace(
+        em=dataclasses.replace(cfg.em, reference_stepping=True)
+    )
+    ref = Trainer(rcfg, steps_per_epoch=4)
+    sh = ShardedTrainer(rcfg, steps_per_epoch=4, mesh=make_mesh(model=2))
+
+    state0 = ref.init_state(jax.random.PRNGKey(0))
+    from conftest import prefill_full_memory
+
+    state0 = prefill_full_memory(state0)
+    state_sh = sh.prepare(state0)
+
+    images, labels = _batch()
+    s1, m1 = ref.train_step(
+        state0, jnp.asarray(images), jnp.asarray(labels),
+        use_mine=True, update_gmm=True,
+    )
+    s2, m2 = sh.train_step(
+        state_sh, images, labels, use_mine=True, update_gmm=True
+    )
+    assert int(jax.device_get(m1.em_active)) == rcfg.model.num_classes
+    assert int(jax.device_get(m2.em_active)) == rcfg.model.num_classes
+    np.testing.assert_allclose(m1.loss, jax.device_get(m2.loss), rtol=2e-5)
+    np.testing.assert_allclose(
+        jax.device_get(s1.gmm.means), jax.device_get(s2.gmm.means),
+        rtol=2e-5, atol=2e-6,
+    )
+    np.testing.assert_allclose(
+        jax.device_get(s1.gmm.priors), jax.device_get(s2.gmm.priors),
+        rtol=2e-5, atol=2e-6,
+    )
