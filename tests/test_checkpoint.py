@@ -133,3 +133,26 @@ def test_logger_and_metrics(tmp_path):
     # null-path variants are no-ops
     Logger(None).log("to stdout only")
     MetricsWriter(None).write(0, {"a": 1})
+
+
+def test_profiler_trace_writes_artifacts(tmp_path):
+    """`profiler_trace` (the --profile_dir path, utils/log.py) must emit a
+    real jax.profiler trace when given a dir, and be a no-op when not."""
+    import jax
+    import jax.numpy as jnp
+
+    from mgproto_tpu.utils.log import profiler_trace
+
+    with profiler_trace(None):  # falsy: must not touch the profiler
+        pass
+
+    logdir = str(tmp_path / "trace")
+    with profiler_trace(logdir):
+        jax.jit(lambda x: x * 2)(jnp.ones((8, 8))).block_until_ready()
+    files = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(logdir)
+        for f in fs
+    ]
+    assert files, "no trace artifacts written"
+    assert any(f.endswith((".pb", ".json.gz", ".xplane.pb")) for f in files), files
