@@ -9,11 +9,13 @@ script produces one end-to-end on the production eval code
 (`engine/evaluate.py:evaluate_with_ood`), using a model trained by
 `scripts/synthetic_convergence.py`.
 
-Two OoD sets mirror the reference's two (Cars/Pets for CUB, main.py:141-163),
-generated to be structurally disjoint from the ID generator's oriented
-sinusoid + tinted blob textures:
-  ood1: random checkerboards (hard edges, no orientation field)
-  ood2: dense uniform color noise (no spatial structure at all)
+Three OoD sets extend the reference's two (Cars/Pets for CUB,
+main.py:141-163):
+  ood1: random checkerboards (far-OoD: hard edges, no orientation field)
+  ood2: dense uniform color noise (far-OoD: no spatial structure)
+  ood3: held-out classes of the SAME generator family (near-OoD — novel
+        textures/tints with matching image statistics, the honest analogue
+        of the reference's natural-image OoD sets)
 
 Usage: first run synthetic_convergence.py (any arch), then
     python scripts/synthetic_ood.py --workdir /tmp/mgproto_synth_d121 \
@@ -25,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
 
 import numpy as np
@@ -35,9 +38,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import synthetic_convergence as sc  # noqa: E402  (same scripts/ directory)
 
 
-def make_ood_sets(root: str, n: int = 128, img: int = 64, seed: int = 7):
-    """Two single-folder ImageFolders of textures the ID generator never
-    produces. Returns their directories."""
+def make_ood_sets(root: str, n: int = 128, img: int = 64, seed: int = 7,
+                  id_classes: int = 8):
+    """Three single-folder ImageFolders of out-of-distribution inputs.
+    Returns their directories.
+
+    ood1/ood2 are FAR-OoD (structures the ID generator never produces);
+    ood3 is NEAR-OoD — the analogue of the reference's CUB-vs-Cars/Pets
+    setup (natural images from unseen categories, main.py:141-163): the SAME
+    generator family, but class indices the model never trained on (the ODD
+    upper-half indices of a doubled palette — see the aliasing note below),
+    so textures and tints are genuinely novel while the image statistics
+    match the ID set."""
     from PIL import Image
 
     rng = np.random.RandomState(seed)
@@ -62,6 +74,37 @@ def make_ood_sets(root: str, n: int = 128, img: int = 64, seed: int = 7):
                 os.path.join(d, f"{i:04d}.png")
             )
         dirs.append(os.path.dirname(d))
+
+    # ood3: held-out classes of a widened palette, via the ID generator.
+    # ONLY ODD upper-half indices: class params are deterministic in
+    # (c, num_classes) — angle pi*c/(2C) and tint phase 2pi*c/(2C) — so EVEN
+    # upper-half indices alias exactly onto trained classes (c=2k of 2C ==
+    # class k of C); odd indices can never coincide with a trained angle/tint.
+    held = os.path.join(root, "ood3_heldout")
+    if not os.path.isdir(held):
+        tmp = os.path.join(root, "_heldout_gen")
+        stage = held + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(stage, ignore_errors=True)
+        held_classes = [
+            c for c in range(id_classes, 2 * id_classes) if c % 2 == 1
+        ]
+        per = max(1, n // len(held_classes))
+        sc.make_dataset(tmp, 2 * id_classes, per_class=1, test_per_class=per,
+                        img=img, seed=seed + 1)
+        d = os.path.join(stage, "ood")
+        os.makedirs(d, exist_ok=True)
+        kept = 0
+        for c in held_classes:
+            src = os.path.join(tmp, "test", f"class_{c:03d}")
+            for f in sorted(os.listdir(src)):
+                shutil.copy(os.path.join(src, f),
+                            os.path.join(d, f"c{c:03d}_{f}"))
+                kept += 1
+        shutil.rmtree(tmp, ignore_errors=True)
+        assert kept > 0
+        os.rename(stage, held)  # atomic: a crash can't leave a partial cache
+    dirs.append(held)
     return dirs
 
 
@@ -106,7 +149,9 @@ def main() -> None:
         )
     path = found[-1]
 
-    ood_dirs = make_ood_sets(os.path.join(args.workdir, "data"))
+    ood_dirs = make_ood_sets(
+        os.path.join(args.workdir, "data"), id_classes=args.classes
+    )
     cfg = sc.build_config(
         args.workdir, args.arch, args.classes, args.epochs, args.batch,
         ood_dirs=ood_dirs,
@@ -132,8 +177,9 @@ def main() -> None:
         "compute_dtype": cfg.model.compute_dtype,
         "checkpoint": os.path.basename(path),
         "id_set": "synthetic 8-class test split",
-        "ood_sets": {"ood1": "random checkerboards",
-                     "ood2": "uniform color noise"},
+        "ood_sets": {"ood1": "random checkerboards (far-OoD)",
+                     "ood2": "uniform color noise (far-OoD)",
+                     "ood3": "held-out generator classes (near-OoD)"},
         **{k: (round(v, 6) if isinstance(v, float) else v)
            for k, v in results.items()},
     }
