@@ -22,7 +22,7 @@ from mgproto_tpu.cli.train import _test
 from mgproto_tpu.data import build_pipelines
 from mgproto_tpu.parallel import ShardedTrainer
 from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
-from mgproto_tpu.utils.checkpoint import adopt_checkpoint_dtype
+from mgproto_tpu.utils.checkpoint import adopt_checkpoint_train_config
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -47,7 +47,7 @@ def main(argv: Optional[list] = None) -> None:
     )
     if not path:
         raise FileNotFoundError(f"no checkpoint found in {cfg.model_dir}")
-    cfg = adopt_checkpoint_dtype(cfg, path, log=print)
+    cfg = adopt_checkpoint_train_config(cfg, path, log=print)
 
     trainer = ShardedTrainer(cfg, steps_per_epoch=1)
     state = trainer.init_state(jax.random.PRNGKey(cfg.seed), for_restore=True)

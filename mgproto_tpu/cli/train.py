@@ -110,6 +110,10 @@ def run_training(
     run_meta = {
         "compute_dtype": cfg.model.compute_dtype,
         "arch": cfg.model.arch,
+        # non-proxy aux losses have no params['proxies'] leaf: a restore
+        # target must be built with the SAME aux_loss or the pytree
+        # structures mismatch (core/state.py; adopt_checkpoint_train_config)
+        "aux_loss": cfg.loss.aux_loss,
     }
     push_ds = push_loader.dataset
     accu = 0.0

@@ -139,12 +139,19 @@ def select_checkpoint(ckpt_dir: str, stage: str = "nopush",
     return max(ckpts, key=lambda c: c[2]) if policy == "best" else ckpts[-1]
 
 
-def adopt_checkpoint_dtype(cfg, path: str, log=None):
-    """Return cfg with `model.compute_dtype` overridden by the checkpoint's
-    recorded training-time dtype: evaluating under different numerics
-    silently shifts the p(x) scale OoD thresholding rides on. The single
-    definition behind cli/evaluate, cli/interpret, and the evidence
-    scripts."""
+def adopt_checkpoint_train_config(cfg, path: str, log=None):
+    """Return cfg with training-time settings recorded in the checkpoint's
+    metadata adopted for restore/eval. The single definition behind
+    cli/evaluate, cli/interpret, and the evidence scripts. Adopts:
+
+    - `model.compute_dtype`: evaluating under different numerics silently
+      shifts the p(x) scale OoD thresholding rides on;
+    - `loss.aux_loss`: proxy-based losses carry a params['proxies'] leaf
+      (plus optimizer-state leaves), so a restore target built with the
+      wrong aux_loss has a mismatching pytree STRUCTURE and orbax restore
+      fails outright.
+
+    Checkpoints predating a metadata key keep cfg's value for it."""
     import dataclasses
 
     meta = load_metadata(path) or {}
@@ -157,5 +164,15 @@ def adopt_checkpoint_dtype(cfg, path: str, log=None):
             )
         cfg = cfg.replace(
             model=dataclasses.replace(cfg.model, compute_dtype=ckpt_dtype)
+        )
+    ckpt_aux = meta.get("aux_loss")
+    if ckpt_aux and ckpt_aux != cfg.loss.aux_loss:
+        if log is not None:
+            log(
+                f"note: checkpoint was trained with aux_loss={ckpt_aux}; "
+                f"overriding {cfg.loss.aux_loss}"
+            )
+        cfg = cfg.replace(
+            loss=dataclasses.replace(cfg.loss, aux_loss=ckpt_aux)
         )
     return cfg

@@ -48,7 +48,7 @@ def main() -> None:
     from mgproto_tpu.engine.push import push_prototypes
     from mgproto_tpu.engine.train import Trainer
     from mgproto_tpu.utils.checkpoint import (
-        adopt_checkpoint_dtype,
+        adopt_checkpoint_train_config,
         restore_checkpoint,
         select_checkpoint,
     )
@@ -63,7 +63,7 @@ def main() -> None:
             f"scripts/synthetic_interp.py (or synthetic_convergence.py) first"
         )
     _, _, ckpt_acc, path = found
-    cfg = adopt_checkpoint_dtype(cfg, path, log=print)
+    cfg = adopt_checkpoint_train_config(cfg, path, log=print)
 
     _, push_loader, _, _ = build_pipelines(cfg)
     push_ds = push_loader.dataset

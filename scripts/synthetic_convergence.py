@@ -127,13 +127,15 @@ def compare_prune_styles(cfg) -> dict:
 
 
 def build_config(workdir: str, arch: str, classes: int, epochs: int,
-                 batch: int, ood_dirs=(), compute_dtype: str = "float32"):
+                 batch: int, ood_dirs=(), compute_dtype: str = "float32",
+                 aux_loss: str = "proxy_anchor"):
     """The evidence Config shared by this script and synthetic_ood.py —
     the OoD evaluation must restore checkpoints under the EXACT training-time
     model config."""
     from mgproto_tpu.config import (
         Config,
         DataConfig,
+        LossConfig,
         ModelConfig,
         ScheduleConfig,
     )
@@ -163,6 +165,7 @@ def build_config(workdir: str, arch: str, classes: int, epochs: int,
             push_every=5,
             prune_top_m=4,
         ),
+        loss=LossConfig(aux_loss=aux_loss),
         data=DataConfig(
             dataset="synthetic",
             train_dir=os.path.join(data_root, "train"),
@@ -190,6 +193,12 @@ def main() -> None:
     p.add_argument("--compute_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="trunk compute dtype (the TPU recipe uses bfloat16)")
+    p.add_argument("--aux_loss", default="proxy_anchor",
+                   choices=["proxy_anchor", "proxy_nca", "ms", "contrastive",
+                            "triplet", "npair"],
+                   help="auxiliary DML loss — ALL six are trainable here "
+                        "(the reference CLI crashes on everything but "
+                        "proxy_anchor, reference main.py:189-198)")
     args = p.parse_args()
 
     from mgproto_tpu.hermetic import pin_cpu_devices
@@ -205,7 +214,7 @@ def main() -> None:
 
     cfg = build_config(
         args.workdir, args.arch, args.classes, args.epochs, args.batch,
-        compute_dtype=args.compute_dtype,
+        compute_dtype=args.compute_dtype, aux_loss=args.aux_loss,
     )
 
     _, accuracy = run_training(cfg, render_push=False, target_accu=0.3)
@@ -232,6 +241,7 @@ def main() -> None:
                   "push, prune all exercised)",
         "arch": args.arch,
         "compute_dtype": args.compute_dtype,
+        "aux_loss": args.aux_loss,
         "classes": args.classes,
         "epochs": args.epochs,
         "chance_accuracy": 1.0 / args.classes,

@@ -134,7 +134,7 @@ def main() -> None:
     from mgproto_tpu.data import build_pipelines
     from mgproto_tpu.engine.train import Trainer
     from mgproto_tpu.utils.checkpoint import (
-        adopt_checkpoint_dtype,
+        adopt_checkpoint_train_config,
         restore_checkpoint,
         select_checkpoint,
     )
@@ -158,7 +158,7 @@ def main() -> None:
     )
     # p(x)/OoD numbers must reflect the numerics the model trained under,
     # not a silent f32 default
-    cfg = adopt_checkpoint_dtype(cfg, path, log=print)
+    cfg = adopt_checkpoint_train_config(cfg, path, log=print)
 
     _, _, test_loader, ood_loaders = build_pipelines(cfg)
     trainer = Trainer(cfg, steps_per_epoch=1)

@@ -124,3 +124,53 @@ def test_cli_interpret_main_end_to_end(tmp_path, capsys):
         assert 0.0 <= out[key] <= 100.0, (key, out)
     assert out["csv"] == csv_path and out["csv_rows"] > 0
     assert os.path.getsize(csv_path) > 0
+
+
+@pytest.mark.slow
+def test_cli_evaluate_adopts_aux_loss_from_checkpoint(tmp_path, capsys):
+    """A checkpoint trained with a NON-proxy aux loss has no params['proxies']
+    leaf; the eval CLIs rebuild their config from flags (default
+    proxy_anchor), so without metadata adoption the orbax restore target has
+    a mismatching pytree STRUCTURE and restore fails outright. Train with
+    'ms', evaluate with default flags: adoption must bridge the gap."""
+    import dataclasses
+
+    from mgproto_tpu.cli.evaluate import main as evaluate_main
+    from mgproto_tpu.cli.train import run_training
+
+    data_root = str(tmp_path / "data")
+    _make_folder(os.path.join(data_root, "train"))
+    _make_folder(os.path.join(data_root, "test"), per_class=3, seed=1)
+
+    cfg = tiny_test_config()
+    cfg = cfg.replace(
+        loss=dataclasses.replace(cfg.loss, aux_loss="ms"),
+        data=DataConfig(
+            train_dir=os.path.join(data_root, "train"),
+            test_dir=os.path.join(data_root, "test"),
+            train_push_dir=os.path.join(data_root, "train"),
+            ood_dirs=(),
+            train_batch_size=8,
+            test_batch_size=8,
+            train_push_batch_size=8,
+            num_workers=2,
+        ),
+        model_dir=str(tmp_path / "run"),
+    )
+    run_training(cfg, render_push=False)
+    capsys.readouterr()
+
+    evaluate_main(
+        TINY_FLAGS  # note: NO aux_loss flag -> proxy_anchor default
+        + [
+            "--img_size", "32",
+            "--train_dir", os.path.join(data_root, "train"),
+            "--test_dir", os.path.join(data_root, "test"),
+            "--push_dir", os.path.join(data_root, "train"),
+            "--model_dir", str(tmp_path / "run"),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "aux_loss=ms" in out  # the adoption note fired
+    parsed = _last_json_line(out)
+    assert 0.0 <= parsed["accuracy"] <= 1.0
