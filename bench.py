@@ -11,9 +11,27 @@ Pallas density kernel) and reported separately; the headline value is the
 winner. An MFU estimate comes from the compiled step's XLA cost analysis
 divided by the chip's peak bf16 FLOPs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-On unrecoverable failure it prints a JSON diagnostic line
-{"error", "attempts", "errors"} instead of a bare traceback.
+Output contract (BENCH_r01-r03 hardening, VERDICT r3 item 2): EVERY stdout
+line is one complete, flushed JSON object, so the last line always parses —
+even if an outer driver timeout SIGKILLs this process mid-attempt (the r03
+failure: rc=124 after one 900s attempt left zero parseable output). Lines:
+
+  * a start line (reads as a diagnostic if the run dies immediately),
+  * one line per relay probe and per failed measurement attempt,
+  * a PARTIAL result line the moment the first scoring path succeeds
+    ({"metric", "value", ..., "partial": true} — a kill during the second
+    path still leaves a real number as the last line),
+  * the final line: the full result, or {"error", "attempts", "errors"}.
+
+Cheap-probe gate: rounds 1-3 lost their whole bench window to relay hangs
+discovered only after burning a 900s flagship attempt. Now a ~75s child
+probe (mgproto_tpu/probe.py) runs first; if the backend cannot even run a
+tiny matmul, bench reports that diagnostic within ~3 minutes instead.
+
+Ladder sizing: per-attempt cap 420s, whole-run cap 900s (both env-tunable).
+The pre-attempt deadline check hands a child at most the remaining budget,
+so total runtime is bounded by DEADLINE_S + one child kill — sized to fit
+inside the driver's observed outer window (>900s in r03).
 
 Fault tolerance: the TPU relay this environment tunnels through refuses or
 drops connections transiently (observed: `remote_compile: Connection refused`
@@ -67,8 +85,10 @@ ITERS = _env_int("BENCH_ITERS", 10)
 
 MAX_ATTEMPTS = 6
 BACKOFF_S = (5, 10, 20, 40, 60)  # >= 5 attempts spread over >= 2 minutes
-ATTEMPT_TIMEOUT_S = _env_int("BENCH_ATTEMPT_TIMEOUT_S", 900)
-DEADLINE_S = _env_int("BENCH_DEADLINE_S", 2400)  # whole-run cap
+ATTEMPT_TIMEOUT_S = _env_int("BENCH_ATTEMPT_TIMEOUT_S", 420)
+DEADLINE_S = _env_int("BENCH_DEADLINE_S", 900)  # whole-run cap
+PROBE_TIMEOUT_S = _env_int("BENCH_PROBE_TIMEOUT_S", 75)
+PROBE_ATTEMPTS = _env_int("BENCH_PROBE_ATTEMPTS", 2)
 _START = time.monotonic()
 
 # Each measurement attempt runs in a CHILD process: SIGALRM cannot interrupt a
@@ -77,8 +97,9 @@ _START = time.monotonic()
 # in-process attempt. A subprocess gives a hard kill on hang and a fresh
 # backend per retry.
 
-# peak dense bf16 FLOP/s by TPU generation (public spec sheets)
-_PEAK_BF16 = {
+# peak dense bf16 FLOP/s by TPU generation (public spec sheets). Public name
+# (ADVICE r3): scripts/perf_model.py derives its roofline from this table.
+PEAK_BF16 = {
     "v4": 275e12,
     "v5 lite": 197e12,
     "v5e": 197e12,
@@ -88,12 +109,19 @@ _PEAK_BF16 = {
 }
 
 
-def _peak_flops(device_kind: str) -> float:
+def peak_flops(device_kind: str) -> float:
+    """Peak dense bf16 FLOP/s for a jax device_kind string (public helper)."""
     kind = device_kind.lower()
-    for key, peak in _PEAK_BF16.items():
+    for key, peak in PEAK_BF16.items():
         if key in kind:
             return peak
     return 197e12  # default to v5e-class
+
+
+def _emit(obj: dict) -> None:
+    """One complete JSON object per stdout line, flushed immediately — the
+    whole kill-safety contract hangs on this flush."""
+    print(json.dumps(obj), flush=True)
 
 
 def flagship_config(fused: bool):
@@ -120,6 +148,7 @@ def flops_from_cost_analysis(compiled, strict: bool = False):
     shapes seen across jax versions (dict, list-of-dict, None). strict=False
     returns None when unavailable (bench treats MFU as a best-effort extra);
     strict=True raises SystemExit (perf_model's flop count IS its output)."""
+    err = None
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -127,11 +156,12 @@ def flops_from_cost_analysis(compiled, strict: bool = False):
         f = ca.get("flops") if ca else None
         if f and f > 0:
             return float(f)
-    except Exception:
-        pass
+    except Exception as e:
+        err = e
     if strict:
         raise SystemExit(
             "cost_analysis returned no usable flop count on this backend"
+            + (f" (underlying error: {err!r})" if err is not None else "")
         )
     return None
 
@@ -143,6 +173,11 @@ def run_config(fused: bool) -> dict:
         # deterministic, instant child failure for the contract tests: fires
         # before any jax/model work so the retry ladder is cheap to exercise
         raise RuntimeError("BENCH_FAIL_INJECT: simulated child failure")
+    if os.environ.get("BENCH_HANG_INJECT"):
+        # deterministic child hang for the kill-mid-attempt contract test;
+        # bounded sleep so an orphaned child cannot linger past the test
+        time.sleep(_env_int("BENCH_HANG_INJECT_S", 120))
+        raise RuntimeError("BENCH_HANG_INJECT: child should have been killed")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -225,10 +260,12 @@ def robust_measure(fused: bool) -> tuple:
     (backend-init refusal, mid-run `remote_compile: Connection refused`
     surfacing as JaxRuntimeError) are not reliably distinguishable from the
     error type alone, and a false-positive retry only costs time. Each attempt
-    is a fresh child process (see the note by ATTEMPT_TIMEOUT_S)."""
+    is a fresh child process (see the note by ATTEMPT_TIMEOUT_S), and each
+    failed attempt flushes a JSON diagnostic line so an outer kill at any
+    moment leaves a parseable last line."""
+    name = "fused" if fused else "unfused"
     last_err = None
-    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--measure",
-           "fused" if fused else "unfused"]
+    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--measure", name]
     for attempt in range(1, MAX_ATTEMPTS + 1):
         # enforce the whole-run cap BEFORE spending, and never hand a child
         # more than the remaining budget — otherwise a wedged relay overruns
@@ -237,6 +274,16 @@ def robust_measure(fused: bool) -> tuple:
         if remaining <= 0:
             last_err = (last_err or "") + " [deadline exceeded, not attempted]"
             return None, last_err.strip(), attempt - 1
+        _emit({
+            # emitted BEFORE the child starts so a kill mid-attempt leaves a
+            # last line that says exactly where the run died
+            "error": f"in progress; killed during {name} attempt {attempt}",
+            "event": "attempt_start",
+            "path": name,
+            "attempt": attempt,
+            "budget_s": round(min(ATTEMPT_TIMEOUT_S, remaining), 1),
+            "elapsed_s": round(time.monotonic() - _START, 1),
+        })
         try:
             proc = subprocess.run(
                 cmd, capture_output=True, text=True,
@@ -260,6 +307,14 @@ def robust_measure(fused: bool) -> tuple:
         except Exception as e:
             last_err = f"{type(e).__name__}: {e}"
         print(f"[bench] attempt {attempt} failed: {last_err}", file=sys.stderr)
+        _emit({
+            "error": f"in progress; {name} attempt {attempt} failed",
+            "event": "attempt_failed",
+            "path": name,
+            "attempt": attempt,
+            "detail": last_err,
+            "elapsed_s": round(time.monotonic() - _START, 1),
+        })
         if time.monotonic() - _START > DEADLINE_S:
             last_err += " [deadline exceeded, no more retries]"
             return None, last_err, attempt
@@ -268,43 +323,15 @@ def robust_measure(fused: bool) -> tuple:
     return None, last_err, MAX_ATTEMPTS
 
 
-def main() -> None:
-    if _ENV_ERRORS or BATCH <= 0 or ITERS <= 0:
-        # deterministic misconfig: report immediately, don't retry 12 children
-        detail = "; ".join(_ENV_ERRORS) or (
-            f"invalid BENCH_BATCH={BATCH} / BENCH_ITERS={ITERS}: "
-            f"both must be > 0"
-        )
-        print(json.dumps({"error": detail, "attempts": 0, "errors": {}}))
-        raise SystemExit(1)
-    results = {}
-    errors = {}
-    attempts_total = 0
-    for name, fused in (("unfused", False), ("fused", True)):
-        result, err, attempts = robust_measure(fused)
-        attempts_total += attempts
-        if result is not None:
-            results[name] = result
-        else:
-            errors[name] = err
-
-    if not results:
-        print(
-            json.dumps(
-                {
-                    "error": "all scoring paths failed after retries",
-                    "attempts": attempts_total,
-                    "errors": errors,
-                }
-            )
-        )
-        raise SystemExit(1)
-
+def _summary(results: dict, errors: dict, attempts_total: int,
+             partial: bool) -> dict:
+    """The driver-contract result line, shared by the partial emission (first
+    path done) and the final one so the two can never drift in shape."""
     winner = max(results, key=lambda k: results[k]["imgs_per_sec"])
     best = results[winner]
     value = best["imgs_per_sec"]
     flops = best["flops_per_step"]
-    peak = _peak_flops(best["device_kind"])
+    peak = peak_flops(best["device_kind"])
     mfu = (flops / best["step_time_s"] / peak) if flops else None
 
     out = {
@@ -325,9 +352,103 @@ def main() -> None:
         "north_star_frac_per_chip": round(value / NORTH_STAR_PER_CHIP, 3),
         "attempts": attempts_total,
     }
+    if partial:
+        out["partial"] = True
     if errors:
         out["errors"] = errors
-    print(json.dumps(out))
+    return out
+
+
+def _probe_gate() -> bool:
+    """Cheap backend-health gate before any flagship attempt. Emits one JSON
+    line per probe; returns True when the backend answered. Probes whatever
+    platform this process would get (TPU in production, CPU in CI)."""
+    if os.environ.get("BENCH_SKIP_PROBE"):
+        _emit({
+            # every in-progress line carries "error": if a kill makes it the
+            # LAST line, it must read as a self-describing diagnostic
+            "error": "in progress; killed after probe skip, before attempts",
+            "event": "probe_skipped",
+            "reason": "BENCH_SKIP_PROBE set",
+        })
+        return True
+    from mgproto_tpu.probe import probe_once
+
+    for i in range(1, max(PROBE_ATTEMPTS, 1) + 1):
+        record = probe_once(PROBE_TIMEOUT_S)
+        line = {
+            "error": (
+                "in progress; killed after successful probe, before attempts"
+                if record["ok"] else "backend probe failed"
+            ),
+            "event": "probe",
+            "probe_attempt": i,
+            **record,
+        }
+        _emit(line)
+        if record["ok"]:
+            return True
+        if i <= PROBE_ATTEMPTS - 1:
+            time.sleep(10)
+    return False
+
+
+def main() -> None:
+    _emit({
+        "error": "bench started but was killed before any attempt completed",
+        "event": "start",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "batch": BATCH,
+        "iters": ITERS,
+        "attempt_timeout_s": ATTEMPT_TIMEOUT_S,
+        "deadline_s": DEADLINE_S,
+    })
+    if _ENV_ERRORS or BATCH <= 0 or ITERS <= 0:
+        # deterministic misconfig: report immediately, don't retry 12 children
+        detail = "; ".join(_ENV_ERRORS) or (
+            f"invalid BENCH_BATCH={BATCH} / BENCH_ITERS={ITERS}: "
+            f"both must be > 0"
+        )
+        _emit({"error": detail, "attempts": 0, "errors": {}})
+        raise SystemExit(1)
+
+    if not _probe_gate():
+        _emit({
+            "error": (
+                "backend unreachable: a tiny-jit child probe failed "
+                f"{PROBE_ATTEMPTS}x within {PROBE_TIMEOUT_S}s each — relay "
+                "down; flagship attempts not started (they would only burn "
+                "the window rediscovering the hang)"
+            ),
+            "attempts": 0,
+            "errors": {"probe": "see probe event lines above"},
+        })
+        raise SystemExit(1)
+
+    results = {}
+    errors = {}
+    attempts_total = 0
+    for name, fused in (("unfused", False), ("fused", True)):
+        result, err, attempts = robust_measure(fused)
+        attempts_total += attempts
+        if result is not None:
+            results[name] = result
+        else:
+            errors[name] = err
+        if results:
+            # flush the best-known RESULT now: a kill during the next path
+            # still leaves a real number as the last parseable line
+            is_final = name == "fused"
+            _emit(_summary(results, errors, attempts_total,
+                           partial=not is_final))
+
+    if not results:
+        _emit({
+            "error": "all scoring paths failed after retries",
+            "attempts": attempts_total,
+            "errors": errors,
+        })
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,89 @@
+"""Relay/backend health probe (VERDICT r3 items 1-2).
+
+`probe_once` answers one question cheaply: *can a fresh python process bring
+up the default jax backend and run a tiny jitted matmul right now?* It exists
+because this environment's TPU is reached through a relay that, when wedged,
+HANGS backend init inside native PJRT code (rounds 1-3: every bench attempt
+died this way after burning its full timeout). A 60-90s child probe is ~10x
+cheaper than discovering the same hang with a 420-900s flagship bench attempt.
+
+The probe runs in a CHILD process on purpose: SIGALRM cannot interrupt a
+native call blocked on a wedged relay (python signal handlers only fire at
+bytecode boundaries), and a half-initialized backend poisons every later
+in-process jax use. A subprocess gives a hard kill and leaks nothing into the
+caller.
+
+This module is import-light (stdlib only, no jax) so `bench.py` and
+`scripts/tpu_probe.py` can load it without touching any backend.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+# Child: backend init + one 256x256 bf16 matmul under jit + a host readback.
+# First TPU compile is slow (~20-40s observed), so timeouts must comfortably
+# exceed that; a relay HANG blows far past it, which is what the kill detects.
+_CHILD_SRC = r"""
+import json, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+t_import = time.time() - t0
+x = jnp.ones((256, 256), jnp.bfloat16)
+# f32 cast before the reduction: a bf16-accumulated sum of 2^16 terms rounds,
+# which would flag a healthy backend as broken
+v = float(jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x))
+expected = 256.0 ** 3  # ones @ ones: every entry 256, summed over 256*256
+d = jax.devices()[0]
+print(json.dumps({
+    "device_kind": d.device_kind,
+    "platform": d.platform,
+    "n_devices": len(jax.devices()),
+    "import_s": round(t_import, 2),
+    "value_ok": abs(v - expected) / expected < 1e-2,
+}))
+"""
+
+
+def probe_once(timeout_s: float = 75.0) -> dict:
+    """Run one child probe; never raises.
+
+    Returns a record with at least {ts, ok, elapsed_s}; on success also
+    {device_kind, platform, n_devices, import_s}; on failure {error}.
+    The child inherits this process's environment, so whatever platform the
+    caller would get (axon TPU in production, pinned CPU under the test
+    suite) is exactly what is probed.
+    """
+    t0 = time.monotonic()
+    record: dict = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "ok": False,
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", _CHILD_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        record["elapsed_s"] = round(time.monotonic() - t0, 2)
+        if proc.returncode == 0 and proc.stdout.strip():
+            child = json.loads(proc.stdout.strip().splitlines()[-1])
+            record.update(child)
+            record["ok"] = bool(child.get("value_ok"))
+        else:
+            tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+            record["error"] = f"child rc={proc.returncode}: {tail}"
+    except subprocess.TimeoutExpired:
+        record["elapsed_s"] = round(time.monotonic() - t0, 2)
+        record["error"] = (
+            f"timeout: backend init + tiny jit did not finish in "
+            f"{timeout_s:.0f}s (relay hang)"
+        )
+    except Exception as e:  # defensive: the record must always come back
+        record["elapsed_s"] = round(time.monotonic() - t0, 2)
+        record["error"] = f"{type(e).__name__}: {e}"
+    return record

@@ -31,12 +31,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # raising)
 from bench import (  # noqa: E402
     NORTH_STAR_PER_CHIP,
-    _PEAK_BF16,
+    PEAK_BF16,
     flagship_config,
     flops_from_cost_analysis,
 )
 
-V5E_PEAK_BF16 = _PEAK_BF16["v5e"]
+V5E_PEAK_BF16 = PEAK_BF16["v5e"]
 
 
 def main() -> None:

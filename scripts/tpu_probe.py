@@ -1,0 +1,61 @@
+"""CLI for the TPU relay-health probe (VERDICT r3, next-round item 1).
+
+Thin wrapper over `mgproto_tpu.probe.probe_once` that appends each probe
+record as ONE timestamped JSON line to TPU_PROBE.jsonl at the repo root, so a
+round of probes (driven by scripts/tpu_watch.sh) is a machine-readable record
+of when — if ever — the relay was reachable:
+
+    {"ts": "...", "ok": true,  "elapsed_s": 31.2, "device_kind": "...", ...}
+    {"ts": "...", "ok": false, "elapsed_s": 75.0, "error": "timeout ..."}
+
+Exit code: 0 iff the probe succeeded, so shell loops can gate expensive bench
+attempts on it.
+
+This script deliberately does NOT clear PALLAS_AXON_POOL_IPS / JAX_PLATFORMS:
+unlike the test suite (tests/conftest.py pins CPU), reaching the real relay
+is the entire point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from mgproto_tpu.probe import probe_once  # noqa: E402
+
+LOG_PATH = os.path.join(REPO_ROOT, "TPU_PROBE.jsonl")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--timeout", type=float, default=75.0,
+        help="seconds before the child probe is killed (default 75)",
+    )
+    parser.add_argument(
+        "--log", default=LOG_PATH,
+        help="JSONL file to append the probe record to",
+    )
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="suppress the record on stdout (still appended to --log)",
+    )
+    args = parser.parse_args()
+
+    record = probe_once(args.timeout)
+    with open(args.log, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    if not args.quiet:
+        print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -96,6 +96,7 @@ def test_bench_failure_emits_diagnostic_json():
     env.update(
         BENCH_FAIL_INJECT="1", BENCH_BATCH="4", BENCH_WARMUP="0",
         BENCH_ITERS="1", BENCH_ATTEMPT_TIMEOUT_S="60", BENCH_DEADLINE_S="5",
+        BENCH_SKIP_PROBE="1",  # target the retry ladder, not the probe gate
     )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],
@@ -121,6 +122,62 @@ def test_bench_rejects_misconfig_without_retrying():
     assert "invalid BENCH_BATCH" in out["error"] and out["attempts"] == 0
 
 
+def test_bench_killed_mid_attempt_leaves_parseable_last_line():
+    """BENCH_r03's failure mode: the driver's outer timeout SIGKILLed bench
+    mid-attempt and `parsed` came back null. Now every stdout line is a
+    complete flushed JSON object, so a hard kill at ANY moment leaves the
+    last line parseable as a diagnostic."""
+    import signal
+    import time as _time
+
+    env = _driver_env()
+    env.update(
+        BENCH_SKIP_PROBE="1", BENCH_HANG_INJECT="1", BENCH_HANG_INJECT_S="60",
+        BENCH_ATTEMPT_TIMEOUT_S="300", BENCH_DEADLINE_S="600",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=REPO, start_new_session=True,
+    )
+    try:
+        first = proc.stdout.readline()  # the start line, flushed immediately
+        _time.sleep(2)  # let it get INTO the (hung) measurement attempt
+    finally:
+        # kill the whole group: bench AND its hung measurement child
+        os.killpg(proc.pid, signal.SIGKILL)
+    rest = proc.stdout.read()
+    proc.wait(timeout=30)
+    lines = [ln for ln in (first + rest).splitlines() if ln.strip()]
+    assert lines, "bench printed nothing before the kill"
+    for ln in lines:  # EVERY line is a complete JSON object
+        json.loads(ln)
+    last = json.loads(lines[-1])
+    assert "error" in last, last  # a kill-time last line reads as diagnostic
+
+
+def test_bench_probe_gate_fails_fast_when_backend_unreachable():
+    """With an unusable backend the probe gate must produce the diagnostic
+    JSON contract quickly — WITHOUT burning flagship-attempt timeouts
+    (rounds 1-3 lost their whole window rediscovering the hang)."""
+    env = _driver_env()
+    env.update(
+        JAX_PLATFORMS="nonexistent_backend",  # every child probe fails fast
+        BENCH_PROBE_TIMEOUT_S="60", BENCH_PROBE_ATTEMPTS="2",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, (proc.stderr or proc.stdout)[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    for ln in lines:
+        json.loads(ln)
+    last = json.loads(lines[-1])
+    assert "backend unreachable" in last["error"]
+    assert last["attempts"] == 0  # no flagship attempt was started
+
+
 def test_perf_model_smoke_contract():
     """`scripts/perf_model.py --smoke` must print one JSON line with a
     positive flop count and the derived roofline fields (PERF.md's numbers
@@ -138,6 +195,19 @@ def test_perf_model_smoke_contract():
     assert out["mfu_needed_for_north_star"] >= 0
     assert out["north_star_imgs_per_sec_chip"] > 0
     assert set(out["v5e_imgs_per_sec_chip_at_mfu"]) == {"20%", "40%", "60%"}
+
+
+def test_probe_timeout_returns_failure_record_not_exception():
+    """probe_once must NEVER raise — a sub-second timeout (guaranteed to
+    fire: child python cannot even start that fast) must come back as an
+    ok=False record with a timeout error and the timestamp fields intact."""
+    from mgproto_tpu.probe import probe_once
+
+    record = probe_once(timeout_s=0.5)
+    assert record["ok"] is False
+    assert "timeout" in record["error"]
+    assert record["elapsed_s"] >= 0.5
+    assert "ts" in record
 
 
 def test_bench_rejects_non_numeric_env_with_json_diagnostic():
