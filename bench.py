@@ -253,7 +253,7 @@ def run_config(fused: bool) -> dict:
     }
 
 
-def robust_measure(fused: bool) -> tuple:
+def robust_measure(fused: bool, reemit=None) -> tuple:
     """(result dict or None, last error string or None, attempts used).
 
     Retries with exponential backoff on ANY failure — the observed transients
@@ -262,7 +262,10 @@ def robust_measure(fused: bool) -> tuple:
     error type alone, and a false-positive retry only costs time. Each attempt
     is a fresh child process (see the note by ATTEMPT_TIMEOUT_S), and each
     failed attempt flushes a JSON diagnostic line so an outer kill at any
-    moment leaves a parseable last line."""
+    moment leaves a parseable last line. `reemit` (when set) re-flushes the
+    caller's best-known partial RESULT line right after every in-progress
+    emission, so once one scoring path has produced a number, the last line
+    stays a number through the other path's attempts."""
     name = "fused" if fused else "unfused"
     last_err = None
     cmd = [sys.executable, "-u", os.path.abspath(__file__), "--measure", name]
@@ -284,6 +287,8 @@ def robust_measure(fused: bool) -> tuple:
             "budget_s": round(min(ATTEMPT_TIMEOUT_S, remaining), 1),
             "elapsed_s": round(time.monotonic() - _START, 1),
         })
+        if reemit:
+            reemit()
         try:
             proc = subprocess.run(
                 cmd, capture_output=True, text=True,
@@ -315,6 +320,8 @@ def robust_measure(fused: bool) -> tuple:
             "detail": last_err,
             "elapsed_s": round(time.monotonic() - _START, 1),
         })
+        if reemit:
+            reemit()
         if time.monotonic() - _START > DEADLINE_S:
             last_err += " [deadline exceeded, no more retries]"
             return None, last_err, attempt
@@ -428,8 +435,14 @@ def main() -> None:
     results = {}
     errors = {}
     attempts_total = 0
+    partial_line = None
     for name, fused in (("unfused", False), ("fused", True)):
-        result, err, attempts = robust_measure(fused)
+        result, err, attempts = robust_measure(
+            fused,
+            # once a partial result exists, re-flush it after every
+            # in-progress line so the last line stays a real number
+            reemit=(lambda: _emit(partial_line)) if partial_line else None,
+        )
         attempts_total += attempts
         if result is not None:
             results[name] = result
@@ -439,8 +452,9 @@ def main() -> None:
             # flush the best-known RESULT now: a kill during the next path
             # still leaves a real number as the last parseable line
             is_final = name == "fused"
-            _emit(_summary(results, errors, attempts_total,
-                           partial=not is_final))
+            partial_line = _summary(results, errors, attempts_total,
+                                    partial=not is_final)
+            _emit(partial_line)
 
     if not results:
         _emit({

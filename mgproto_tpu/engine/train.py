@@ -246,9 +246,16 @@ class Trainer:
         }
 
     def train_epoch(self, state, batches, epoch: int):
-        """Drive one epoch over an iterable of (images, labels) host batches."""
+        """Drive one epoch over an iterable of (images, labels) host batches.
+
+        The returned metrics are the LAST step's, except `em_active` and
+        `full_mem_ratio`, which are epoch maxima: EM width varies per step
+        with batch label composition (the step where queues first fill can
+        touch every class at once), so a last-step sample would understate
+        it. The max runs on-device (no per-step host sync)."""
         flags = self.epoch_flags(state, epoch)
         last = None
+        em_max = fm_max = None
         for images, labels in batches:
             # raw host arrays: train_step converts (and, in the sharded
             # subclass, device_puts with the batch sharding)
@@ -260,4 +267,14 @@ class Trainer:
                 update_gmm=flags["update_gmm"],
                 warm=flags["warm"],
             )
+            em_max = (
+                last.em_active if em_max is None
+                else jnp.maximum(em_max, last.em_active)
+            )
+            fm_max = (
+                last.full_mem_ratio if fm_max is None
+                else jnp.maximum(fm_max, last.full_mem_ratio)
+            )
+        if last is not None:
+            last = last._replace(em_active=em_max, full_mem_ratio=fm_max)
         return state, last

@@ -108,9 +108,17 @@ def main() -> None:
 
     data_root = os.path.join(args.workdir, "data")
     cub_root = os.path.join(args.workdir, "cub")
-    cfg = sc.build_config(
-        args.workdir, "tiny", args.classes, args.epochs, args.batch
-    )
+    # --reuse restores an existing run: rebuild its EXACT training-time
+    # config from the persisted build args when available (ADVICE r3) rather
+    # than trusting the flags to be restated correctly
+    saved = sc.load_build_args(args.workdir) if args.reuse else None
+    if saved is not None:
+        print(f"using persisted build args: {saved}")
+        cfg = sc.build_config(args.workdir, **saved)
+    else:
+        cfg = sc.build_config(
+            args.workdir, "tiny", args.classes, args.epochs, args.batch
+        )
     if args.reuse and os.path.isdir(cfg.model_dir):
         accuracy = None  # re-evaluating an existing run; see checkpoint acc
     else:
@@ -124,6 +132,12 @@ def main() -> None:
             img=IMG, blob_only=not args.texture_cue,
         )
         write_cub_view(data_root, cub_root, records, IMG)
+        # persist the build args so render_prototypes.py can rebuild this
+        # exact config without flag re-statement (ADVICE r3)
+        sc.save_build_args(
+            args.workdir, arch="tiny", classes=args.classes,
+            epochs=args.epochs, batch=args.batch,
+        )
         _, accuracy = run_training(cfg, render_push=False, target_accu=0.3)
 
     # evaluate the BEST pre-push checkpoint: the reference's own interp

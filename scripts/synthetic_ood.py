@@ -152,10 +152,17 @@ def main() -> None:
     ood_dirs = make_ood_sets(
         os.path.join(args.workdir, "data"), id_classes=args.classes
     )
-    cfg = sc.build_config(
-        args.workdir, args.arch, args.classes, args.epochs, args.batch,
-        ood_dirs=ood_dirs,
-    )
+    # prefer the persisted training-time build args (ADVICE r3) so the
+    # restore config can never drift from the run being evaluated
+    saved = sc.load_build_args(args.workdir)
+    if saved is not None:
+        print(f"using persisted build args: {saved}")
+        cfg = sc.build_config(args.workdir, **saved, ood_dirs=ood_dirs)
+    else:
+        cfg = sc.build_config(
+            args.workdir, args.arch, args.classes, args.epochs, args.batch,
+            ood_dirs=ood_dirs,
+        )
     # p(x)/OoD numbers must reflect the numerics the model trained under,
     # not a silent f32 default
     cfg = adopt_checkpoint_train_config(cfg, path, log=print)
