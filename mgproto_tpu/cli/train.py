@@ -40,7 +40,10 @@ from mgproto_tpu.utils import (
     save_state_w_condition,
     timed_span,
 )
-from mgproto_tpu.utils.checkpoint import load_metadata
+from mgproto_tpu.utils.checkpoint import (
+    adopt_checkpoint_train_config,
+    load_metadata,
+)
 from mgproto_tpu.utils.log import profiler_trace
 
 
@@ -76,9 +79,21 @@ def run_training(
         resume_path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
         if resume != "auto" and not os.path.exists(resume_path):
             raise FileNotFoundError(resume_path)
+    adoption_notes: list = []
+    if resume_path:
+        # resume under the checkpoint's own training-time settings: without
+        # this, resuming e.g. a reference-stepping EM run without re-passing
+        # the flag would silently switch EM math mid-training (ADVICE r3)
+        cfg = adopt_checkpoint_train_config(
+            cfg, resume_path, log=adoption_notes.append
+        )
 
     os.makedirs(cfg.model_dir, exist_ok=True)
     log = Logger(os.path.join(cfg.model_dir, "train.log"))
+    for note in adoption_notes:
+        # adoption ran before the Logger existed; the overrides it made are
+        # exactly the decisions a run's own log must record
+        log(note)
     metrics = MetricsWriter(os.path.join(cfg.model_dir, "metrics.jsonl"))
 
     log(describe(cfg))
@@ -114,6 +129,9 @@ def run_training(
         # target must be built with the SAME aux_loss or the pytree
         # structures mismatch (core/state.py; adopt_checkpoint_train_config)
         "aux_loss": cfg.loss.aux_loss,
+        # resuming a reference-stepping run without this flag would silently
+        # switch EM math mid-training (trajectory change, no error)
+        "em_reference_stepping": cfg.em.reference_stepping,
     }
     push_ds = push_loader.dataset
     accu = 0.0

@@ -149,7 +149,10 @@ def adopt_checkpoint_train_config(cfg, path: str, log=None):
     - `loss.aux_loss`: proxy-based losses carry a params['proxies'] leaf
       (plus optimizer-state leaves), so a restore target built with the
       wrong aux_loss has a mismatching pytree STRUCTURE and orbax restore
-      fails outright.
+      fails outright;
+    - `em.reference_stepping`: resuming a reference-stepping run without
+      re-passing the flag would silently switch EM math mid-training (the
+      two paths share a pytree structure, so nothing else would catch it).
 
     Checkpoints predating a metadata key keep cfg's value for it."""
     import dataclasses
@@ -174,5 +177,15 @@ def adopt_checkpoint_train_config(cfg, path: str, log=None):
             )
         cfg = cfg.replace(
             loss=dataclasses.replace(cfg.loss, aux_loss=ckpt_aux)
+        )
+    ckpt_ref_em = meta.get("em_reference_stepping")
+    if ckpt_ref_em is not None and ckpt_ref_em != cfg.em.reference_stepping:
+        if log is not None:
+            log(
+                f"note: checkpoint was trained with em.reference_stepping="
+                f"{ckpt_ref_em}; overriding {cfg.em.reference_stepping}"
+            )
+        cfg = cfg.replace(
+            em=dataclasses.replace(cfg.em, reference_stepping=ckpt_ref_em)
         )
     return cfg

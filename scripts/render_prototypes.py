@@ -53,17 +53,13 @@ def main() -> None:
         select_checkpoint,
     )
 
-    # prefer the persisted training-time build args (ADVICE r3: restating
+    # persisted training-time build args when present (ADVICE r3: restating
     # --epochs/--arch/--classes wrong could silently restore under the wrong
     # schedule); flags remain the fallback for pre-persistence workdirs
-    saved = sc.load_build_args(args.workdir)
-    if saved is not None:
-        print(f"using persisted build args: {saved}")
-        cfg = sc.build_config(args.workdir, **saved)
-    else:
-        cfg = sc.build_config(
-            args.workdir, args.arch, args.classes, args.epochs, args.batch
-        )
+    cfg, _ = sc.resolve_build_config(
+        args.workdir, arch=args.arch, classes=args.classes,
+        epochs=args.epochs, batch=args.batch,
+    )
     found = select_checkpoint(cfg.model_dir, stage="nopush", policy="best")
     if found is None:
         raise FileNotFoundError(

@@ -231,6 +231,26 @@ def load_build_args(workdir: str):
         return json.load(f)
 
 
+def effective_build_args(workdir: str, log=print, **fallback) -> dict:
+    """Persisted build args when present, else the supplied flag fallbacks.
+    The ONE place restore-time consumers get their training-time settings
+    from, so no caller can consume the saved args incompletely (e.g. a cfg
+    from saved classes but an OoD set from a stale --classes flag)."""
+    saved = load_build_args(workdir)
+    if saved is not None:
+        if log:
+            log(f"using persisted build args: {saved}")
+        return dict(saved)
+    return dict(fallback)
+
+
+def resolve_build_config(workdir: str, ood_dirs=(), log=print, **fallback):
+    """(cfg, effective_args) for a restore-time consumer — persisted build
+    args when present, flag fallbacks otherwise."""
+    eff = effective_build_args(workdir, log=log, **fallback)
+    return build_config(workdir, ood_dirs=ood_dirs, **eff), eff
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="evidence/synthetic")

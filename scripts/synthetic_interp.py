@@ -111,10 +111,11 @@ def main() -> None:
     # --reuse restores an existing run: rebuild its EXACT training-time
     # config from the persisted build args when available (ADVICE r3) rather
     # than trusting the flags to be restated correctly
-    saved = sc.load_build_args(args.workdir) if args.reuse else None
-    if saved is not None:
-        print(f"using persisted build args: {saved}")
-        cfg = sc.build_config(args.workdir, **saved)
+    if args.reuse:
+        cfg, _ = sc.resolve_build_config(
+            args.workdir, arch="tiny", classes=args.classes,
+            epochs=args.epochs, batch=args.batch,
+        )
     else:
         cfg = sc.build_config(
             args.workdir, "tiny", args.classes, args.epochs, args.batch

@@ -149,20 +149,18 @@ def main() -> None:
         )
     path = found[-1]
 
-    ood_dirs = make_ood_sets(
-        os.path.join(args.workdir, "data"), id_classes=args.classes
+    # the persisted training-time build args (ADVICE r3) drive EVERYTHING
+    # downstream — config, the near-OoD generator's id_classes (a stale
+    # --classes flag would generate "held-out" textures aliasing onto
+    # trained classes), and the summary's arch field
+    eff = sc.effective_build_args(
+        args.workdir, arch=args.arch, classes=args.classes,
+        epochs=args.epochs, batch=args.batch,
     )
-    # prefer the persisted training-time build args (ADVICE r3) so the
-    # restore config can never drift from the run being evaluated
-    saved = sc.load_build_args(args.workdir)
-    if saved is not None:
-        print(f"using persisted build args: {saved}")
-        cfg = sc.build_config(args.workdir, **saved, ood_dirs=ood_dirs)
-    else:
-        cfg = sc.build_config(
-            args.workdir, args.arch, args.classes, args.epochs, args.batch,
-            ood_dirs=ood_dirs,
-        )
+    ood_dirs = make_ood_sets(
+        os.path.join(args.workdir, "data"), id_classes=eff["classes"]
+    )
+    cfg = sc.build_config(args.workdir, ood_dirs=ood_dirs, **eff)
     # p(x)/OoD numbers must reflect the numerics the model trained under,
     # not a silent f32 default
     cfg = adopt_checkpoint_train_config(cfg, path, log=print)
@@ -180,7 +178,7 @@ def main() -> None:
                 "(engine/evaluate.py:evaluate_with_ood; reference "
                 "train_and_test.py:161-238 semantics: 5th-percentile ID "
                 "threshold, FPR = OoD fraction predicted in-distribution)",
-        "arch": args.arch,
+        "arch": eff["arch"],
         "compute_dtype": cfg.model.compute_dtype,
         "checkpoint": os.path.basename(path),
         "id_set": "synthetic 8-class test split",

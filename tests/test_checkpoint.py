@@ -82,6 +82,37 @@ def test_save_restore_resume_bitexact(tmp_path):
     assert load_metadata(path) == {"epoch": 1}
 
 
+def test_adopt_em_reference_stepping_from_metadata(tmp_path):
+    """Resuming a reference-stepping EM run must adopt the flag from the
+    checkpoint metadata — the two EM paths share a pytree structure, so
+    nothing else would catch the silent mid-training math switch (ADVICE
+    r3; cli/train.py records em_reference_stepping in run_meta)."""
+    from mgproto_tpu.utils.checkpoint import adopt_checkpoint_train_config
+
+    cfg, trainer, state = _tiny_trainer()
+    assert cfg.em.reference_stepping is False
+    path = save_checkpoint(
+        str(tmp_path), state, "ck", metadata={"em_reference_stepping": True}
+    )
+    notes = []
+    adopted = adopt_checkpoint_train_config(cfg, path, log=notes.append)
+    assert adopted.em.reference_stepping is True
+    assert any("em.reference_stepping" in n for n in notes)
+    # a checkpoint that matches cfg adopts nothing and logs nothing
+    path2 = save_checkpoint(
+        str(tmp_path), state, "ck2", metadata={"em_reference_stepping": False}
+    )
+    notes2 = []
+    same = adopt_checkpoint_train_config(cfg, path2, log=notes2.append)
+    assert same.em.reference_stepping is False and notes2 == []
+    # metadata predating the key keeps cfg's value
+    path3 = save_checkpoint(str(tmp_path), state, "ck3", metadata={"epoch": 1})
+    assert (
+        adopt_checkpoint_train_config(cfg, path3).em.reference_stepping
+        is False
+    )
+
+
 def test_conditional_save_and_latest(tmp_path):
     cfg, trainer, state = _tiny_trainer()
     # below threshold: no save (reference utils/save.py:11 condition)
