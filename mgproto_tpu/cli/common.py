@@ -102,6 +102,10 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--remat", action="store_true",
                    help="checkpoint backbone blocks (HBM for FLOPs)")
     p.add_argument("--num_workers", type=int, default=8)
+    p.add_argument("--worker_backend", default="thread",
+                   choices=["thread", "process"],
+                   help="loader workers: 'process' (fork pool) scales the "
+                        "augmentation math past the GIL on many-core hosts")
     p.add_argument("--seed", type=int, default=0)
     # runtime
     p.add_argument("--distributed", action="store_true",
@@ -165,6 +169,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             test_batch_size=args.batch_size,
             train_push_batch_size=args.batch_size,
             num_workers=args.num_workers,
+            worker_backend=args.worker_backend,
         ),
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
         seed=args.seed,

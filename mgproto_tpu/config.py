@@ -131,6 +131,10 @@ class DataConfig:
     test_batch_size: int = 80
     train_push_batch_size: int = 80
     num_workers: int = 8
+    # "thread" overlaps PIL decode with device compute; "process" (fork
+    # pool) additionally scales the numpy augmentation math past the GIL —
+    # required to reach pod-scale input rates (VERDICT r3 item 5)
+    worker_backend: str = "thread"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -48,6 +48,7 @@ def build_pipelines(cfg):
         shuffle=True,
         drop_last=True,
         num_workers=d.num_workers,
+        worker_backend=d.worker_backend,
         seed=cfg.seed,
         **shard,
     )
@@ -55,12 +56,14 @@ def build_pipelines(cfg):
         ImageFolder(d.train_push_dir, push_transform(img)),
         d.train_push_batch_size,
         num_workers=d.num_workers,
+        worker_backend=d.worker_backend,
         **shard,
     )
     test = DataLoader(
         ImageFolder(d.test_dir, test_transform(img)),
         d.test_batch_size,
         num_workers=d.num_workers,
+        worker_backend=d.worker_backend,
         **shard,
     )
     oods = [
@@ -68,6 +71,7 @@ def build_pipelines(cfg):
             ImageFolder(o, ood_transform(img)),
             d.test_batch_size,
             num_workers=d.num_workers,
+            worker_backend=d.worker_backend,
             **shard,
         )
         for o in d.ood_dirs

@@ -1,24 +1,73 @@
-"""Threaded prefetching batch loader.
+"""Prefetching batch loader with thread- and process-worker backends.
 
 The reference trains with `num_workers=0` — every JPEG decoded serially on
 the main thread between optimizer steps (reference main.py:94; SURVEY.md
 §7.3.6 calls this the bottleneck-by-neglect). Here decode/augment runs on a
-thread pool (PIL decode releases the GIL) overlapped with device compute, and
-batches are pre-assembled into pinned numpy arrays ready for device_put.
+worker pool overlapped with device compute, and batches are pre-assembled
+into numpy arrays ready for device_put.
+
+Two backends (`worker_backend`):
+  * "thread" (default): a ThreadPoolExecutor. PIL decode releases the GIL,
+    but the numpy-heavy augmentation math (color jitter, affine) does not —
+    on a many-core host the pipeline serializes on the GIL well below the
+    ~2,100 img/s the v5e-8 north star needs (VERDICT r3 item 5).
+  * "process": a SPAWN-context multiprocessing.Pool, created lazily on
+    first use and reused for the loader's lifetime. Spawn, not fork: the
+    loader's first iteration typically happens after the JAX/PJRT runtime
+    is live, and forking a parent with XLA/grpc threads can deadlock the
+    children (jax explicitly does not support it); spawn children import a
+    fresh interpreter and never touch jax. The dataset is pickled ONCE into
+    each worker (initializer), not per task; only finished (img, label, id)
+    tuples cross IPC afterwards. Worker death surfaces as a RuntimeError
+    after a generous per-sample timeout instead of a silent hang.
 
 Determinism: sample i of epoch e is transformed with a generator seeded by
-(seed, epoch, sample index) — reproducible regardless of worker scheduling,
-unlike torch's global-RNG loaders.
+(seed, epoch, sample index) — reproducible regardless of worker scheduling
+OR backend (both call the same `_load_sample`), unlike torch's global-RNG
+loaders. `tests/test_data.py` asserts thread==process batch equality.
 """
 
 from __future__ import annotations
 
+import multiprocessing
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
+
+
+def _load_sample(dataset, seed: int, index: int, epoch: int):
+    """The ONE sample-load path both backends share: deterministic per
+    (seed, epoch, index), so backends are interchangeable mid-experiment."""
+    if index < 0:  # sentinel pad row (multi-host tail alignment)
+        return None
+    rng = np.random.default_rng([seed, epoch, int(index)])
+    img, label, sid = dataset.load(int(index), rng)
+    return np.asarray(img, np.float32), label, sid
+
+
+# per-worker state for process workers: the initializer receives the
+# (pickled-once) dataset when the spawn child starts — never per task
+_WORKER_STATE: dict = {}
+
+# ceiling on one sample load (decode + augment is ms-scale; minutes means a
+# dead/stuck worker) — Pool replaces a killed worker but never completes the
+# lost task's AsyncResult, so an un-timed get() would hang training silently
+_RESULT_TIMEOUT_S = 120.0
+
+
+def _proc_worker_init(dataset, seed: int) -> None:
+    _WORKER_STATE["dataset"] = dataset
+    _WORKER_STATE["seed"] = seed
+
+
+def _proc_load_one(args: Tuple[int, int]):
+    index, epoch = args
+    return _load_sample(
+        _WORKER_STATE["dataset"], _WORKER_STATE["seed"], index, epoch
+    )
 
 
 class DataLoader:
@@ -32,7 +81,9 @@ class DataLoader:
       drop_last: drop the trailing partial GLOBAL batch (train: True so
         jitted shapes stay static; eval: False, the tail is padded with
         sentinel rows — zero image, label -1, id -1).
-      num_workers: decode threads (0 = synchronous).
+      num_workers: decode workers (0 = synchronous, backend ignored).
+      worker_backend: "thread" (GIL-sharing pool; PIL decode overlaps) or
+        "process" (fork pool; augmentation math scales past the GIL).
       seed: base seed for shuffle + augmentation streams.
       shard_index/shard_count: multi-host data sharding. Every process
         computes the SAME global order (seeded identically), walks it in
@@ -50,6 +101,7 @@ class DataLoader:
         shuffle: bool = False,
         drop_last: bool = False,
         num_workers: int = 8,
+        worker_backend: str = "thread",
         seed: int = 0,
         prefetch_batches: int = 2,
         shard_index: int = 0,
@@ -57,17 +109,45 @@ class DataLoader:
     ):
         if not 0 <= shard_index < shard_count:
             raise ValueError(f"shard_index {shard_index} not in [0, {shard_count})")
+        if worker_backend not in ("thread", "process"):
+            raise ValueError(
+                f"worker_backend must be 'thread' or 'process', "
+                f"got {worker_backend!r}"
+            )
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.num_workers = num_workers
+        self.worker_backend = worker_backend
         self.seed = seed
         self.prefetch_batches = prefetch_batches
         self.shard_index = shard_index
         self.shard_count = shard_count
         self.epoch = 0
         self._template = None  # (shape,) of a sample image, for sentinel rows
+        self._pool = None  # lazy persistent process pool (backend="process")
+
+    def _ensure_pool(self):
+        """The process pool, created on first use and reused across epochs
+        (spawn startup pickles the dataset into each worker — pay it once,
+        not per epoch). Pool workers are daemonic: they die with the parent,
+        so an unclosed loader cannot outlive the process."""
+        if self._pool is None:
+            self._pool = multiprocessing.get_context("spawn").Pool(
+                self.num_workers,
+                initializer=_proc_worker_init,
+                initargs=(self.dataset, self.seed),
+            )
+        return self._pool
+
+    def close(self) -> None:
+        """Tear down the process pool (no-op for the thread backend — its
+        pool is per-iteration). Idempotent."""
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -85,14 +165,7 @@ class DataLoader:
         return np.arange(n)
 
     def _load_one(self, index: int, epoch: int):
-        if index < 0:  # sentinel pad row (multi-host tail alignment)
-            return None
-        rng = np.random.default_rng([self.seed, epoch, int(index)])
-        img, label, sid = self.dataset.load(int(index), rng)
-        img = np.asarray(img, np.float32)
-        if self._template is None:
-            self._template = img.shape
-        return img, label, sid
+        return _load_sample(self.dataset, self.seed, index, epoch)
 
     def _sentinel_row(self):
         if self._template is None:
@@ -121,6 +194,11 @@ class DataLoader:
         self.epoch += 1
 
         def assemble(results):
+            if self._template is None:
+                for r in results:  # learn the sentinel shape from any real
+                    if r is not None:  # row (process workers can't set it —
+                        self._template = r[0].shape  # separate memory)
+                        break
             results = [r if r is not None else self._sentinel_row() for r in results]
             imgs = np.stack([r[0] for r in results])
             labels = np.asarray([r[1] for r in results], np.int32)
@@ -149,7 +227,25 @@ class DataLoader:
         sentinel = object()
         stop = threading.Event()
 
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+        if self.worker_backend == "process":
+            pool = self._ensure_pool()  # persistent across epochs
+            submit = lambda i: pool.apply_async(_proc_load_one, ((i, epoch),))
+
+            def result_of(f):
+                try:
+                    return f.get(timeout=_RESULT_TIMEOUT_S)
+                except multiprocessing.TimeoutError:
+                    raise RuntimeError(
+                        f"loader process-worker did not return a sample "
+                        f"within {_RESULT_TIMEOUT_S:.0f}s — a worker likely "
+                        "died (OOM/segfault); Pool cannot complete its task"
+                    ) from None
+        else:
+            pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            submit = lambda i: pool.submit(self._load_one, i, epoch)
+            result_of = lambda f: f.result()
+
+        try:
             def put_or_stop(item) -> bool:
                 while not stop.is_set():
                     try:
@@ -162,13 +258,8 @@ class DataLoader:
             def feeder():
                 try:
                     for idx_batch in self._batches_of_indices(order):
-                        futures = [
-                            pool.submit(self._load_one, i, epoch)
-                            for i in idx_batch
-                        ]
+                        futures = [submit(i) for i in idx_batch]
                         if not put_or_stop(futures):
-                            for f in futures:
-                                f.cancel()
                             return
                 finally:
                     put_or_stop(sentinel)
@@ -180,7 +271,7 @@ class DataLoader:
                     item = batch_q.get()
                     if item is sentinel:
                         break
-                    yield assemble([f.result() for f in item])
+                    yield assemble([result_of(f) for f in item])
             finally:
                 stop.set()
                 try:  # drain so the feeder's pending put unblocks
@@ -189,3 +280,8 @@ class DataLoader:
                 except queue.Empty:
                     pass
                 t.join(timeout=10)
+        finally:
+            if self.worker_backend != "process":
+                pool.shutdown(wait=True, cancel_futures=True)
+            # the process pool persists across epochs (close() tears it
+            # down); abandoned in-flight tasks just finish in the workers

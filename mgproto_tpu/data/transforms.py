@@ -277,44 +277,71 @@ def random_resized_crop(
 # ---------------------------------------------------------------- pipelines
 Transform = Callable[[Image.Image, Optional[np.random.Generator]], np.ndarray]
 
+# The pipelines are CLASSES, not closures: datasets holding a transform must
+# be picklable so the loader's spawn-based process workers can receive them
+# (mgproto_tpu/data/loader.py; closures can't cross a spawn boundary). The
+# factory functions below keep the call-site API unchanged.
 
-def train_transform(img_size: int) -> Transform:
+
+class TrainTransform:
     """The reference's training augmentation stack (main.py:98-106)."""
 
-    def apply(img: Image.Image, rng: np.random.Generator) -> np.ndarray:
+    def __init__(self, img_size: int):
+        self.img_size = img_size
+
+    def __call__(self, img: Image.Image, rng: np.random.Generator) -> np.ndarray:
         img = img.convert("RGB")
         img = random_perspective(img, rng)
         img = color_jitter(img, rng)
         img = random_horizontal_flip(img, rng)
         img = random_affine(img, rng)
-        img = random_resized_crop(img, rng, img_size)
+        img = random_resized_crop(img, rng, self.img_size)
         return _to_norm_f32(img)
 
-    return apply
+
+class PushTransform:
+    """Resize-only, UNNORMALIZED (main.py:111-116)."""
+
+    def __init__(self, img_size: int):
+        self.img_size = img_size
+
+    def __call__(self, img: Image.Image, rng=None) -> np.ndarray:
+        return _to_f32(resize(img, (self.img_size, self.img_size)))
+
+
+class TestTransform:
+    """Resize(shorter=img+32) + CenterCrop (main.py:128-135)."""
+
+    def __init__(self, img_size: int):
+        self.img_size = img_size
+
+    def __call__(self, img: Image.Image, rng=None) -> np.ndarray:
+        return _to_norm_f32(
+            center_crop(resize(img, self.img_size + 32), self.img_size)
+        )
+
+
+class OodTransform:
+    """Exact-resize + normalize (main.py:141-163)."""
+
+    def __init__(self, img_size: int):
+        self.img_size = img_size
+
+    def __call__(self, img: Image.Image, rng=None) -> np.ndarray:
+        return _to_norm_f32(resize(img, (self.img_size, self.img_size)))
+
+
+def train_transform(img_size: int) -> Transform:
+    return TrainTransform(img_size)
 
 
 def push_transform(img_size: int) -> Transform:
-    """Resize-only, UNNORMALIZED (main.py:111-116)."""
-
-    def apply(img: Image.Image, rng=None) -> np.ndarray:
-        return _to_f32(resize(img, (img_size, img_size)))
-
-    return apply
+    return PushTransform(img_size)
 
 
 def test_transform(img_size: int) -> Transform:
-    """Resize(shorter=img+32) + CenterCrop (main.py:128-135)."""
-
-    def apply(img: Image.Image, rng=None) -> np.ndarray:
-        return _to_norm_f32(center_crop(resize(img, img_size + 32), img_size))
-
-    return apply
+    return TestTransform(img_size)
 
 
 def ood_transform(img_size: int) -> Transform:
-    """Exact-resize + normalize (main.py:141-163)."""
-
-    def apply(img: Image.Image, rng=None) -> np.ndarray:
-        return _to_norm_f32(resize(img, (img_size, img_size)))
-
-    return apply
+    return OodTransform(img_size)

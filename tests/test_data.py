@@ -154,6 +154,75 @@ def test_loader_pads_last_batch(image_tree):
     assert (ids == -1).sum() == 4
 
 
+def test_process_backend_matches_thread_backend(image_tree):
+    """The fork-pool backend must produce bit-identical batches to the
+    thread backend (both route through `_load_sample`, seeded by
+    (seed, epoch, index)) — backends are interchangeable mid-experiment."""
+    ds = ImageFolder(image_tree, push_transform(16))
+    thread = DataLoader(
+        ds, 8, shuffle=True, drop_last=True, num_workers=2, seed=7
+    )
+    proc = DataLoader(
+        ds, 8, shuffle=True, drop_last=True, num_workers=2, seed=7,
+        worker_backend="process",
+    )
+    try:
+        for (ia, la, da), (ib, lb, db) in zip(list(thread), list(proc)):
+            np.testing.assert_array_equal(da, db)
+            np.testing.assert_array_equal(la, lb)
+            np.testing.assert_array_equal(ia, ib)  # bit-identical, not approx
+        # the pool persists across epochs: a second epoch must work too
+        assert len(list(proc)) == 2
+    finally:
+        proc.close()
+
+
+def test_process_backend_pads_and_sentinels(image_tree):
+    """Tail padding + sentinel rows work when the template shape can only be
+    learned from worker results (process workers can't set parent state)."""
+    ds = ImageFolder(image_tree, push_transform(16))
+    dl = DataLoader(
+        ds, 8, drop_last=False, num_workers=2, worker_backend="process"
+    )
+    try:
+        batches = list(dl)
+        assert len(batches) == 3
+        imgs, labels, ids = batches[-1]
+        assert imgs.shape[0] == 8 and (labels == -1).sum() == 4
+    finally:
+        dl.close()
+
+
+def test_process_backend_close_terminates_pool(image_tree):
+    """The persistent pool survives early consumer breaks (next epoch
+    reuses it) and close() tears it down; close is idempotent."""
+    import multiprocessing
+
+    ds = ImageFolder(image_tree, push_transform(16))
+    dl = DataLoader(
+        ds, 4, num_workers=2, prefetch_batches=1, worker_backend="process"
+    )
+    for _ in range(2):
+        for batch in dl:
+            break  # early break must not wedge the persistent pool
+    assert len(list(dl)) == 5  # full epoch still works after breaks
+    dl.close()
+    dl.close()  # idempotent
+    # only this loader's workers are asserted on: filter by our pool being
+    # gone — after close there must be no live children from this loader
+    assert dl._pool is None
+    assert all(
+        not p.name.startswith("SpawnPoolWorker")
+        for p in multiprocessing.active_children()
+    )
+
+
+def test_invalid_worker_backend_rejected(image_tree):
+    ds = ImageFolder(image_tree, push_transform(16))
+    with pytest.raises(ValueError):
+        DataLoader(ds, 4, worker_backend="greenlet")
+
+
 def test_loader_early_break_no_thread_leak(image_tree):
     import threading
 
