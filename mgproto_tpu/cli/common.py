@@ -104,8 +104,9 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num_workers", type=int, default=8)
     p.add_argument("--worker_backend", default="thread",
                    choices=["thread", "process"],
-                   help="loader workers: 'process' (fork pool) scales the "
-                        "augmentation math past the GIL on many-core hosts")
+                   help="train-loader workers: 'process' (spawn pool) scales "
+                        "the augmentation math past the GIL on many-core "
+                        "hosts")
     p.add_argument("--seed", type=int, default=0)
     # runtime
     p.add_argument("--distributed", action="store_true",

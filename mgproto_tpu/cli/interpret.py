@@ -72,7 +72,7 @@ def main(argv: Optional[list] = None) -> None:
         dataset,
         cfg.data.test_batch_size,
         num_workers=cfg.data.num_workers,
-        worker_backend=cfg.data.worker_backend,
+        # resize-only pipeline: not GIL-bound, thread workers suffice
         # per-process shard: collect_gt_activations allgathers rows globally
         shard_index=jax.process_index(),
         shard_count=jax.process_count(),

@@ -131,9 +131,11 @@ class DataConfig:
     test_batch_size: int = 80
     train_push_batch_size: int = 80
     num_workers: int = 8
-    # "thread" overlaps PIL decode with device compute; "process" (fork
-    # pool) additionally scales the numpy augmentation math past the GIL —
-    # required to reach pod-scale input rates (VERDICT r3 item 5)
+    # "thread" overlaps PIL decode with device compute; "process" (spawn
+    # pool, dataset pickled once per worker) additionally scales the numpy
+    # augmentation math past the GIL — required to reach pod-scale input
+    # rates (VERDICT r3 item 5). Applied to the TRAIN loader only: push/
+    # test/ood pipelines are resize-only and not GIL-bound.
     worker_backend: str = "thread"
 
 

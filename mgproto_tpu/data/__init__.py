@@ -42,6 +42,9 @@ def build_pipelines(cfg):
         shard_index=jax.process_index(), shard_count=jax.process_count()
     )
     d, img = cfg.data, cfg.model.img_size
+    # worker_backend applies to the TRAIN loader only: the augmentation
+    # stack is the GIL-bound stage; push/test/ood are resize-only, and a
+    # per-loader persistent spawn pool would sit idle on each of them
     train = DataLoader(
         ImageFolder(d.train_dir, train_transform(img)),
         d.train_batch_size,
@@ -56,14 +59,12 @@ def build_pipelines(cfg):
         ImageFolder(d.train_push_dir, push_transform(img)),
         d.train_push_batch_size,
         num_workers=d.num_workers,
-        worker_backend=d.worker_backend,
         **shard,
     )
     test = DataLoader(
         ImageFolder(d.test_dir, test_transform(img)),
         d.test_batch_size,
         num_workers=d.num_workers,
-        worker_backend=d.worker_backend,
         **shard,
     )
     oods = [
@@ -71,7 +72,6 @@ def build_pipelines(cfg):
             ImageFolder(o, ood_transform(img)),
             d.test_batch_size,
             num_workers=d.num_workers,
-            worker_backend=d.worker_backend,
             **shard,
         )
         for o in d.ood_dirs

@@ -83,7 +83,8 @@ class DataLoader:
         sentinel rows — zero image, label -1, id -1).
       num_workers: decode workers (0 = synchronous, backend ignored).
       worker_backend: "thread" (GIL-sharing pool; PIL decode overlaps) or
-        "process" (fork pool; augmentation math scales past the GIL).
+        "process" (spawn pool, dataset pickled once per worker;
+        augmentation math scales past the GIL).
       seed: base seed for shuffle + augmentation streams.
       shard_index/shard_count: multi-host data sharding. Every process
         computes the SAME global order (seeded identically), walks it in

@@ -192,3 +192,118 @@ def test_trunk_shape_mismatch_fails_loudly(tmp_path, monkeypatch):
         merge_pretrained_trunk(
             dict(state.params["net"]), dict(state.batch_stats), trunk
         )
+
+
+# ------------------------------------------------------------- auto-fetch
+def _fake_pth(tmp_path, content=b"fake-torch-bytes"):
+    """A file named with torchvision's hash-in-filename convention whose
+    8-hex suffix genuinely matches its content's sha256."""
+    import hashlib
+
+    digest = hashlib.sha256(content).hexdigest()[:8]
+    src_dir = tmp_path / "srv"
+    src_dir.mkdir(exist_ok=True)
+    path = src_dir / f"resnet18-{digest}.pth"
+    path.write_bytes(content)
+    return path, digest
+
+
+def test_fetch_checkpoint_file_url_verifies_and_lands_in_search_path(
+    tmp_path, monkeypatch
+):
+    from mgproto_tpu.models.pretrained import (
+        fetch_checkpoint,
+        find_torch_checkpoint,
+    )
+
+    path, _ = _fake_pth(tmp_path)
+    monkeypatch.setenv(
+        "MGPROTO_PRETRAINED_URL_RESNET18", path.as_uri()  # file://
+    )
+    dest_dir = tmp_path / "cache"
+    got = fetch_checkpoint("resnet18", dest_dir=str(dest_dir))
+    assert os.path.exists(got) and open(got, "rb").read() == b"fake-torch-bytes"
+    # the fetched file satisfies the normal search (arch-*.pth pattern)
+    monkeypatch.setenv("MGPROTO_PRETRAINED_DIR", str(dest_dir))
+    assert find_torch_checkpoint("resnet18") == got
+
+
+def test_fetch_checkpoint_rejects_checksum_mismatch(tmp_path, monkeypatch):
+    from mgproto_tpu.models.pretrained import fetch_checkpoint
+
+    path, _ = _fake_pth(tmp_path)
+    path.write_bytes(b"tampered-content")  # name hash no longer matches
+    monkeypatch.setenv("MGPROTO_PRETRAINED_URL_RESNET18", path.as_uri())
+    dest_dir = tmp_path / "cache"
+    with pytest.raises(ValueError, match="sha256 mismatch"):
+        fetch_checkpoint("resnet18", dest_dir=str(dest_dir))
+    # nothing half-written entered the search path
+    assert not os.path.exists(dest_dir) or os.listdir(str(dest_dir)) == []
+
+
+def test_fetch_refuses_url_without_checksum(tmp_path, monkeypatch):
+    from mgproto_tpu.models.pretrained import fetch_checkpoint
+
+    path = tmp_path / "weights.pth"  # no hash in the name
+    path.write_bytes(b"x")
+    monkeypatch.setenv("MGPROTO_PRETRAINED_URL_RESNET18", path.as_uri())
+    with pytest.raises(ValueError, match="no sha256 available"):
+        fetch_checkpoint("resnet18", dest_dir=str(tmp_path / "cache"))
+    # ...unless the digest is supplied explicitly
+    import hashlib
+
+    monkeypatch.setenv(
+        "MGPROTO_PRETRAINED_SHA256_RESNET18",
+        hashlib.sha256(b"x").hexdigest(),
+    )
+    got = fetch_checkpoint("resnet18", dest_dir=str(tmp_path / "cache"))
+    assert os.path.exists(got)
+
+
+def test_auto_fetch_disabled_by_default(tmp_path, monkeypatch):
+    """Zero-egress default: even with a resolvable URL, a missing checkpoint
+    raises (mentioning the opt-in) rather than touching the network."""
+    from mgproto_tpu.models.pretrained import load_pretrained_trunk
+
+    _env(monkeypatch, tmp_path)
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    monkeypatch.setenv("HOME", str(tmp_path / "home"))  # hermetic search path
+    monkeypatch.delenv("MGPROTO_AUTO_FETCH", raising=False)
+    path, _ = _fake_pth(tmp_path)
+    monkeypatch.setenv("MGPROTO_PRETRAINED_URL_RESNET18", path.as_uri())
+    with pytest.raises(FileNotFoundError, match="MGPROTO_AUTO_FETCH"):
+        load_pretrained_trunk("resnet18")
+
+
+@pytest.mark.skipif(not HAS_REFERENCE, reason="reference repo not mounted")
+def test_auto_fetch_end_to_end_converts_fetched_trunk(tmp_path, monkeypatch):
+    """MGPROTO_AUTO_FETCH=1 + a file:// URL of a REAL torchvision-format
+    .pth: load_pretrained_trunk downloads, verifies, converts — the fresh
+    TPU VM story with no manual torch step (VERDICT r3 item 6)."""
+    import hashlib
+
+    from mgproto_tpu.models.pretrained import load_pretrained_trunk
+
+    _env(monkeypatch, tmp_path)
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path / "torch_home"))
+    # the fetch dest is the LAST search dir (~/.cache/mgproto_tpu/pretrained)
+    # — redirect HOME so the test cannot pollute the real user cache
+    monkeypatch.setenv("HOME", str(tmp_path / "home"))
+    # a real reference-format trunk, renamed to carry its genuine hash
+    (tmp_path / "remote").mkdir()
+    pth, _ = _reference_trunk_state(tmp_path / "remote")
+    digest = hashlib.sha256(open(pth, "rb").read()).hexdigest()[:8]
+    import pathlib
+    served = pathlib.Path(pth).with_name(f"resnet18-{digest}.pth")
+    os.rename(pth, served)
+    monkeypatch.setenv("MGPROTO_PRETRAINED_URL_RESNET18", served.as_uri())
+    monkeypatch.setenv("MGPROTO_AUTO_FETCH", "1")
+    # the pretrained dir is EMPTY: only the fetch can satisfy this
+    trunk = load_pretrained_trunk("resnet18")
+    assert "params" in trunk and "batch_stats" in trunk
+    # the downloaded file landed in the search path for future runs
+    fetched = os.path.join(
+        str(tmp_path / "home"), ".cache", "mgproto_tpu", "pretrained",
+        served.name,
+    )
+    assert os.path.exists(fetched)
