@@ -32,9 +32,13 @@ def prototype_pair_distance(gmm: GMMState) -> float:
     return float(np.maximum(d2, 0.0).mean())
 
 
-def _run_eval(trainer, state, batches) -> Tuple[np.ndarray, np.ndarray, float, int]:
+def _run_eval(
+    trainer, state, batches
+) -> Tuple[np.ndarray, np.ndarray, float, int, np.ndarray]:
     """Shared loop: returns (per-sample log p(x), per-sample correct flags,
-    summed CE over batches, batch count) over the GLOBAL dataset.
+    summed CE over batches, batch count, per-sample class log-likelihood
+    matrix [N, C]) over the GLOBAL dataset — everything downstream scoring
+    needs from ONE forward pass per batch.
 
     Batches may be bare image arrays (unlabeled OoD), (images, labels), or
     (images, labels, ids) — the loader's padded sentinel rows carry label -1
@@ -45,7 +49,7 @@ def _run_eval(trainer, state, batches) -> Tuple[np.ndarray, np.ndarray, float, i
     process saw everything, train_and_test.py:100-242)."""
     from mgproto_tpu.parallel.multihost import allgather_rows, host_local_rows
 
-    log_pxs, corrects, valids = [], [], []
+    log_pxs, corrects, valids, logit_rows = [], [], [], []
     ce_total, n_batches = 0.0, 0
     for batch in batches:
         if isinstance(batch, tuple):
@@ -56,13 +60,12 @@ def _run_eval(trainer, state, batches) -> Tuple[np.ndarray, np.ndarray, float, i
         out = trainer.eval_step(state, jnp.asarray(images), labels_dev)
         batch_log_px = host_local_rows(out.log_px)
         batch_correct = host_local_rows(out.correct)
+        logits = host_local_rows(out.logits).astype(np.float64)
         if labels is None:
             valid = np.ones(batch_log_px.shape[0], bool)
         else:
             valid = np.asarray(labels) >= 0
-            logits = host_local_rows(out.logits).astype(np.float64)
-            lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1))
-            lse += logits.max(-1)
+            lse = _logsumexp(logits)
             lbl = np.where(valid, np.asarray(labels), 0)
             if valid.any():
                 ce_total += float(
@@ -72,10 +75,12 @@ def _run_eval(trainer, state, batches) -> Tuple[np.ndarray, np.ndarray, float, i
         log_pxs.append(batch_log_px)
         corrects.append(batch_correct)
         valids.append(valid)
+        logit_rows.append(logits)
     # raw per-shard concatenations have EQUAL shapes on every process (the
     # loaders pad all shards to the same batch count, data/loader.py), so the
     # cross-process gather is a plain row concat; the validity mask travels
     # with the data and sentinel rows are dropped globally afterwards.
+    n_c = int(state.gmm.num_classes)
     log_px = allgather_rows(np.concatenate(log_pxs) if log_pxs else np.zeros((0,)))
     correct = allgather_rows(
         np.concatenate(corrects) if corrects else np.zeros((0,), bool)
@@ -83,12 +88,21 @@ def _run_eval(trainer, state, batches) -> Tuple[np.ndarray, np.ndarray, float, i
     valid = allgather_rows(
         np.concatenate(valids) if valids else np.zeros((0,), bool)
     ).astype(bool)
+    logits_all = allgather_rows(
+        np.concatenate(logit_rows) if logit_rows else np.zeros((0, n_c))
+    )
     if jax.process_count() > 1:
         from mgproto_tpu.parallel.multihost import allgather_sum
 
         ce_total = allgather_sum(ce_total)
         n_batches = int(allgather_sum(float(n_batches)))
-    return log_px[valid], correct[valid].astype(bool), ce_total, n_batches
+    return (
+        log_px[valid],
+        correct[valid].astype(bool),
+        ce_total,
+        n_batches,
+        logits_all[valid],
+    )
 
 
 def evaluate(trainer, state, batches, log=print) -> Tuple[float, Dict]:
@@ -96,7 +110,7 @@ def evaluate(trainer, state, batches, log=print) -> Tuple[float, Dict]:
 
     `batches` yields (images, labels) host arrays. Returns
     (accuracy, {'acc', 'cross_entropy', 'p_avg_pair_dist'})."""
-    _, correct, ce_total, n_batches = _run_eval(trainer, state, batches)
+    _, correct, ce_total, n_batches, _ = _run_eval(trainer, state, batches)
     acc = float(correct.mean()) if correct.size else 0.0
     pdist = prototype_pair_distance(state.gmm)
     log(f"\ttest acc: \t\t{acc * 100}%")
@@ -128,9 +142,11 @@ def evaluate_with_ood(
     Beyond the reference: `AUROC_i` per OoD set — the threshold-free metric
     the paper's OoD tables report. Computed on the log p(x) scores (rank
     statistics are monotone-invariant, so log vs exp and the C-fold
-    asymmetry don't matter here).
+    asymmetry don't matter here). Also `score_variants_i`: AUROC under
+    alternative scoring rules (max-over-classes, temperature-scaled p(x) —
+    `ood_score_variants`), from the SAME forward pass.
     """
-    id_log_px, correct, _, _ = _run_eval(trainer, state, id_batches)
+    id_log_px, correct, _, _, id_logits = _run_eval(trainer, state, id_batches)
     acc = float(correct.mean()) if correct.size else 0.0
     log(f"\tTest Acc: \t{acc * 100}")
 
@@ -140,7 +156,7 @@ def evaluate_with_ood(
 
     results: Dict[str, float] = {"acc": acc, "ood_thresh": ood_thresh}
     for i, ood_batches in enumerate(ood_batch_iters, start=1):
-        ood_log_px, _, _, _ = _run_eval(trainer, state, ood_batches)
+        ood_log_px, _, _, _, ood_logits = _run_eval(trainer, state, ood_batches)
         mean_px = np.exp(ood_log_px.astype(np.float64)) / num_classes
         fpr = float((mean_px > ood_thresh).mean()) if mean_px.size else 0.0
         results[f"FPR95_{i}"] = fpr
@@ -149,7 +165,50 @@ def evaluate_with_ood(
             auroc = binary_auroc(id_log_px, ood_log_px)
             results[f"AUROC_{i}"] = auroc
             log(f"\tAUROC_{i}: \t{auroc}")
+            results[f"score_variants_{i}"] = {
+                k: round(v, 6)
+                for k, v in ood_score_variants(id_logits, ood_logits).items()
+            }
+            log(f"\tscore_variants_{i}: \t{results[f'score_variants_{i}']}")
     return acc, results
+
+
+def _logsumexp(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    m = x.max(axis=axis, keepdims=True)
+    return (m + np.log(np.exp(x - m).sum(axis=axis, keepdims=True))).squeeze(
+        axis
+    )
+
+
+def ood_score_variants(
+    id_logits: np.ndarray,
+    ood_logits: np.ndarray,
+    temperatures: Sequence[float] = (0.5, 2.0, 5.0),
+) -> Dict[str, float]:
+    """AUROC of OoD scoring rules over class log-likelihood matrices [N, C].
+
+    Beyond-parity experiment (VERDICT r3): the reference scores OoD by
+    sum_c p(x|c) only (train_and_test.py:184-229). Near-OoD inputs can
+    excite a BROAD low response across many classes that sums to an
+    ID-looking total; alternatives measured head-to-head:
+
+      sum      — log sum_c p(x|c) (the inherited rule, = log p(x) under
+                 uniform class priors)
+      max      — max_c log p(x|c): is the input strongly explained by ANY
+                 single class?
+      temp_T   — T * log sum_c exp(log p(x|c) / T): temperature-scaled
+                 p(x); T<1 sharpens toward max, T>1 flattens toward mean
+    """
+    out: Dict[str, float] = {}
+
+    def auroc_of(fn) -> float:
+        return binary_auroc(fn(id_logits), fn(ood_logits))
+
+    out["sum"] = auroc_of(lambda L: _logsumexp(L))
+    out["max"] = auroc_of(lambda L: L.max(-1))
+    for t in temperatures:
+        out[f"temp_{t:g}"] = auroc_of(lambda L: t * _logsumexp(L / t))
+    return out
 
 
 def binary_auroc(pos_scores: np.ndarray, neg_scores: np.ndarray) -> float:

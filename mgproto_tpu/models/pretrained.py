@@ -174,10 +174,12 @@ def fetch_checkpoint(arch: str, url: Optional[str] = None,
     fd, tmp = tempfile.mkstemp(dir=dest_dir, suffix=".fetch.tmp")
     digest = hashlib.sha256()
     try:
-        # socket timeout covers connect AND read stalls: a blackholed route
-        # must fail startup loudly, not hang a multi-host job at init
-        with urllib.request.urlopen(url, timeout=60) as r, \
-                os.fdopen(fd, "wb") as f:
+        # fdopen FIRST: if urlopen raises (DNS/404/timeout), f's exit still
+        # closes the mkstemp descriptor — a mirror-retry loop must not leak
+        # fds. Socket timeout covers connect AND read stalls: a blackholed
+        # route must fail startup loudly, not hang a multi-host job at init.
+        with os.fdopen(fd, "wb") as f, \
+                urllib.request.urlopen(url, timeout=60) as r:
             while True:
                 chunk = r.read(1 << 20)
                 if not chunk:

@@ -173,6 +173,16 @@ def main() -> None:
 
     _, results = _test(trainer, state, test_loader, ood_loaders, print)
 
+    # beyond-parity scoring comparison (VERDICT r3 item 7): evaluate_with_ood
+    # now reports AUROC under alternative rules (max-over-classes,
+    # temperature-scaled p(x)) from the SAME forward pass — regroup them for
+    # the evidence table
+    score_variants = {
+        f"ood{i}": results.pop(f"score_variants_{i}")
+        for i in range(1, len(ood_loaders) + 1)
+        if f"score_variants_{i}" in results
+    }
+
     summary = {
         "what": "p(x) OoD detection on the production eval path "
                 "(engine/evaluate.py:evaluate_with_ood; reference "
@@ -187,6 +197,13 @@ def main() -> None:
                      "ood3": "held-out generator classes (near-OoD)"},
         **{k: (round(v, 6) if isinstance(v, float) else v)
            for k, v in results.items()},
+        "score_variants_auroc": {
+            "note": "AUROC per scoring rule (sum = the reference's inherited "
+                    "rule; max = max-over-classes log p(x|c); temp_T = "
+                    "temperature-scaled p(x)) — engine/evaluate.py:"
+                    "ood_score_variants",
+            **score_variants,
+        },
     }
     os.makedirs(args.out, exist_ok=True)
     with open(os.path.join(args.out, "summary.json"), "w") as f:

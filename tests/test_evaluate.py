@@ -67,8 +67,14 @@ def test_evaluate_with_ood(setup):
         trainer, state, id_b, [ood1, ood2], log=lambda *_: None
     )
     assert set(res) == {
-        "acc", "ood_thresh", "FPR95_1", "FPR95_2", "AUROC_1", "AUROC_2"
+        "acc", "ood_thresh", "FPR95_1", "FPR95_2", "AUROC_1", "AUROC_2",
+        "score_variants_1", "score_variants_2",
     }
+    # the beyond-parity rules ride the same forward pass (round 4)
+    assert set(res["score_variants_1"]) == {
+        "sum", "max", "temp_0.5", "temp_2", "temp_5"
+    }
+    assert all(0.0 <= v <= 1.0 for v in res["score_variants_1"].values())
     assert res["ood_thresh"] > 0
     assert 0.0 <= res["FPR95_1"] <= 1.0 and 0.0 <= res["FPR95_2"] <= 1.0
     assert 0.0 <= res["AUROC_1"] <= 1.0 and 0.0 <= res["AUROC_2"] <= 1.0
@@ -116,3 +122,41 @@ def test_ood_auroc_identical_distributions_is_half(setup):
         trainer, state, b, [[x[0] for x in b]], log=lambda *_: None
     )
     assert res["AUROC_1"] == pytest.approx(0.5)  # same data as ID and OoD
+
+
+def test_ood_score_variants_broad_response_case():
+    """The canonical failure of the inherited sum rule: a near-OoD input
+    exciting a BROAD low response across all classes can out-sum an ID
+    input that is strongly explained by ONE class — max-over-classes (and
+    low-temperature p(x)) stay discriminative (VERDICT r3 item 7)."""
+    import numpy as np
+
+    from mgproto_tpu.engine.evaluate import ood_score_variants
+
+    c = 8
+    # ID: one confident class, the rest negligible
+    id_logits = np.full((64, c), -50.0)
+    id_logits[np.arange(64), np.arange(64) % c] = 0.0
+    # OoD: everything weakly plausible; sums to MORE than the ID total
+    ood_logits = np.full((64, c), -0.5)
+
+    v = ood_score_variants(id_logits, ood_logits)
+    assert v["max"] == 1.0                     # 0.0 vs -0.5 separates fully
+    assert v["sum"] < 0.5                      # inherited rule INVERTS here
+    assert v["temp_0.5"] >= v["sum"]           # sharpening helps
+    # T->0 approaches max; T->inf approaches mean (= sum shifted)
+    assert v["temp_0.5"] >= v["temp_5"]
+
+
+def test_ood_score_variants_monotone_invariance():
+    """When every rule ranks identically (ID uniformly above OoD), all
+    variants agree at AUROC 1.0."""
+    import numpy as np
+
+    from mgproto_tpu.engine.evaluate import ood_score_variants
+
+    rng = np.random.default_rng(0)
+    id_logits = rng.normal(0.0, 0.1, (32, 4))
+    ood_logits = rng.normal(-10.0, 0.1, (32, 4))
+    v = ood_score_variants(id_logits, ood_logits)
+    assert all(val == 1.0 for val in v.values()), v
