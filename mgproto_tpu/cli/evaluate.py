@@ -35,6 +35,16 @@ def main(argv: Optional[list] = None) -> None:
         default="auto",
         help="checkpoint path ('auto' = latest in --model_dir)",
     )
+    p.add_argument(
+        "--ood_score",
+        default="sum",
+        choices=["sum", "max"],
+        help="OoD operating-point rule: 'sum' = the reference's inherited "
+             "sum_c p(x|c) threshold (with its C-fold asymmetry, kept for "
+             "parity); 'max' = max_c p(x|c), which rescues broad-response "
+             "near-OoD (evidence/README.md). AUROC for every rule is "
+             "reported either way.",
+    )
     args = p.parse_args(argv)
     maybe_init_distributed(args)
     cfg = config_from_args(args)
@@ -54,7 +64,10 @@ def main(argv: Optional[list] = None) -> None:
     state = trainer.prepare(restore_checkpoint(path, state))
     print(f"loaded {path}")
 
-    accu, results = _test(trainer, state, test_loader, ood_loaders, print)
+    accu, results = _test(
+        trainer, state, test_loader, ood_loaders, print,
+        score_rule=args.ood_score,
+    )
     print(json.dumps({"checkpoint": path, "accuracy": accu, **results}))
 
 

@@ -52,13 +52,14 @@ def _labeled(loader):
         yield images, labels
 
 
-def _test(trainer, state, test_loader, ood_loaders, log):
+def _test(trainer, state, test_loader, ood_loaders, log, score_rule="sum"):
     if ood_loaders:
         return evaluate_with_ood(
             trainer,
             state,
             _labeled(test_loader),
             [_labeled(o) for o in ood_loaders],
+            score_rule=score_rule,
             log=log,
         )
     return evaluate(trainer, state, _labeled(test_loader), log=log)

@@ -128,6 +128,7 @@ def evaluate_with_ood(
     id_batches,
     ood_batch_iters: Sequence[Iterable],
     percentile: float = 5.0,
+    score_rule: str = "sum",
     log=print,
 ) -> Tuple[float, Dict]:
     """OoD pass (reference `_testing_with_OoD`, train_and_test.py:161-238).
@@ -145,20 +146,45 @@ def evaluate_with_ood(
     asymmetry don't matter here). Also `score_variants_i`: AUROC under
     alternative scoring rules (max-over-classes, temperature-scaled p(x) —
     `ood_score_variants`), from the SAME forward pass.
+
+    `score_rule` selects the OPERATING-POINT rule (threshold + FPR):
+    "sum" is the inherited reference behavior above (exp space, for
+    parity); "max" thresholds max_c log p(x|c) symmetrically (no C-fold
+    asymmetry) in LOG space (monotone-equivalent, immune to exp
+    underflow) — the rule the scoring study showed rescues broad-response
+    near-OoD (evidence/README.md "ood/"). `ood_thresh` is therefore an
+    exp-space density for "sum" and a log-density for "max".
     """
+    if score_rule not in ("sum", "max"):
+        raise ValueError(f"score_rule must be 'sum' or 'max', got {score_rule!r}")
     id_log_px, correct, _, _, id_logits = _run_eval(trainer, state, id_batches)
     acc = float(correct.mean()) if correct.size else 0.0
     log(f"\tTest Acc: \t{acc * 100}")
 
     num_classes = state.gmm.num_classes
-    # sum_c p(x|c) = exp(log_px); kept in float64 on host for a stable percentile
-    ood_thresh = float(np.percentile(np.exp(id_log_px.astype(np.float64)), percentile))
+    # scores kept in float64 on host for a stable percentile. The sum rule
+    # works in exp space for reference parity; the max rule has no parity
+    # constraint and stays in LOG space — exp would underflow to 0.0 below
+    # log-likelihood ~-745 (easy for high-dim GMMs), collapsing the
+    # threshold to 0.0 and faking a perfect FPR
+    if score_rule == "sum":
+        id_score = np.exp(id_log_px.astype(np.float64))
+    else:
+        id_score = id_logits.max(-1)
+    ood_thresh = float(np.percentile(id_score, percentile))
 
-    results: Dict[str, float] = {"acc": acc, "ood_thresh": ood_thresh}
+    results: Dict[str, float] = {
+        "acc": acc, "ood_thresh": ood_thresh, "score_rule": score_rule
+    }
     for i, ood_batches in enumerate(ood_batch_iters, start=1):
         ood_log_px, _, _, _, ood_logits = _run_eval(trainer, state, ood_batches)
-        mean_px = np.exp(ood_log_px.astype(np.float64)) / num_classes
-        fpr = float((mean_px > ood_thresh).mean()) if mean_px.size else 0.0
+        if score_rule == "sum":
+            # inherited asymmetry: threshold from SUM, OoD tested on MEAN
+            # (reference train_and_test.py:196-213) — kept for parity
+            ood_score = np.exp(ood_log_px.astype(np.float64)) / num_classes
+        else:
+            ood_score = ood_logits.max(-1)  # log space, like the threshold
+        fpr = float((ood_score > ood_thresh).mean()) if ood_score.size else 0.0
         results[f"FPR95_{i}"] = fpr
         log(f"\tFPR95_{i}: \t{fpr}")
         if ood_log_px.size:

@@ -67,9 +67,10 @@ def test_evaluate_with_ood(setup):
         trainer, state, id_b, [ood1, ood2], log=lambda *_: None
     )
     assert set(res) == {
-        "acc", "ood_thresh", "FPR95_1", "FPR95_2", "AUROC_1", "AUROC_2",
-        "score_variants_1", "score_variants_2",
+        "acc", "ood_thresh", "score_rule", "FPR95_1", "FPR95_2",
+        "AUROC_1", "AUROC_2", "score_variants_1", "score_variants_2",
     }
+    assert res["score_rule"] == "sum"  # the inherited default
     # the beyond-parity rules ride the same forward pass (round 4)
     assert set(res["score_variants_1"]) == {
         "sum", "max", "temp_0.5", "temp_2", "temp_5"
@@ -91,6 +92,25 @@ def test_ood_threshold_separates(setup):
         trainer, state, b, [[x[0] for x in b]], log=lambda *_: None
     )
     assert res["FPR95_1"] == pytest.approx(0.0)
+
+
+def test_ood_max_score_rule_operating_point(setup):
+    """score_rule='max' thresholds max_c p(x|c) SYMMETRICALLY (no C-fold
+    asymmetry): identical ID/OoD data at the 5th-percentile threshold flags
+    ~95% of OoD as in-distribution — unlike the sum rule, whose asymmetry
+    drives the same setup to FPR ~0 (test_ood_threshold_separates)."""
+    cfg, trainer, state = setup
+    b = _batches(cfg, n_batches=3, seed=3)
+    _, res = evaluate_with_ood(
+        trainer, state, b, [[x[0] for x in b]], score_rule="max",
+        log=lambda *_: None,
+    )
+    assert res["score_rule"] == "max"
+    assert res["FPR95_1"] == pytest.approx(0.95, abs=0.1)
+    with pytest.raises(ValueError, match="score_rule"):
+        evaluate_with_ood(
+            trainer, state, b, [], score_rule="median", log=lambda *_: None
+        )
 
 
 def test_binary_auroc_exact():
