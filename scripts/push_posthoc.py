@@ -1,0 +1,96 @@
+"""Post-hoc push + prune evaluation on a trained synthetic workdir.
+
+Restores the best NOPUSH checkpoint, measures test accuracy, runs the real
+push projection (`engine/push.py`), re-measures, then prunes at one or more
+top-M widths and measures each — the nopush → push → prune trajectory as one
+JSON artifact. Exists because the reference's push schedule fires on
+MULTIPLES of push_every at/after push_start (reference settings.py:52), so a
+short evidence run whose window contains no such multiple trains fine but
+never pushes in-schedule; projection capability is exercised here instead,
+on exactly the state such a run produced.
+
+Usage:
+    python scripts/push_posthoc.py --workdir /tmp/mg_200cls \
+        --out evidence/synthetic_200cls/push_prune_posthoc.json \
+        --prune_m 8 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "scripts")
+)
+
+import synthetic_convergence as sc  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--prune_m", type=int, nargs="+", default=[8, 4],
+                   help="top-M prune widths to evaluate after push "
+                        "(reference main.py:285 keeps 8 of 10)")
+    args = p.parse_args()
+
+    from mgproto_tpu.hermetic import pin_cpu_devices
+
+    pin_cpu_devices(1)
+
+    from mgproto_tpu.cli.train import _labeled
+    from mgproto_tpu.core.mgproto import prune_top_m
+    from mgproto_tpu.data import build_pipelines
+    from mgproto_tpu.engine import evaluate
+    from mgproto_tpu.engine.push import push_prototypes
+    from mgproto_tpu.utils.checkpoint import select_checkpoint
+
+    cfg, eff = sc.resolve_build_config(args.workdir)
+    found = select_checkpoint(
+        os.path.join(args.workdir, "run"), stage="nopush", policy="best"
+    )
+    if found is None:
+        raise FileNotFoundError(f"no nopush checkpoint in {args.workdir}/run")
+    epoch_n, _, ckpt_acc, path = found
+
+    _, push_loader, test_loader, _ = build_pipelines(cfg)
+    cfg, trainer, state = sc.restore_for_eval(cfg, path)
+    print(f"loaded {path} (checkpoint acc {ckpt_acc})")
+
+    def acc_of(s):
+        a, _ = evaluate(trainer, s, _labeled(test_loader), log=lambda *_: None)
+        return round(a, 4)
+
+    result = {
+        "what": "post-hoc push + prune trajectory on the best nopush "
+                "checkpoint (engine/push.py projection; reference "
+                "push.py:160-228 / main.py:285 semantics)",
+        "checkpoint": os.path.basename(path),
+        "classes": eff.get("classes"),
+        "protos_per_class": eff.get("protos"),
+        "nopush_acc": acc_of(state),
+    }
+    state, push_res = push_prototypes(trainer, state, iter(push_loader))
+    result["pushed_prototypes"] = int(push_res.pushed.sum())
+    result["push_acc"] = acc_of(state)
+    # dedupe after clamping: widths that collapse to the same effective M
+    # would silently overwrite each other and re-run a full eval
+    for m_eff in dict.fromkeys(
+        min(m, cfg.model.prototypes_per_class) for m in args.prune_m
+    ):
+        pruned = state.replace(gmm=prune_top_m(state.gmm, m_eff))
+        result[f"push_prune_top{m_eff}_acc"] = acc_of(pruned)
+    print(json.dumps(result))
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,16 +42,9 @@ def main() -> None:
 
     pin_cpu_devices(1)
 
-    import jax
-
     from mgproto_tpu.data import build_pipelines
     from mgproto_tpu.engine.push import push_prototypes
-    from mgproto_tpu.engine.train import Trainer
-    from mgproto_tpu.utils.checkpoint import (
-        adopt_checkpoint_train_config,
-        restore_checkpoint,
-        select_checkpoint,
-    )
+    from mgproto_tpu.utils.checkpoint import select_checkpoint
 
     # persisted training-time build args when present (ADVICE r3: restating
     # --epochs/--arch/--classes wrong could silently restore under the wrong
@@ -67,13 +60,10 @@ def main() -> None:
             f"scripts/synthetic_interp.py (or synthetic_convergence.py) first"
         )
     _, _, ckpt_acc, path = found
-    cfg = adopt_checkpoint_train_config(cfg, path, log=print)
+    cfg, trainer, state = sc.restore_for_eval(cfg, path)
 
     _, push_loader, _, _ = build_pipelines(cfg)
     push_ds = push_loader.dataset
-    trainer = Trainer(cfg, steps_per_epoch=1)
-    state = trainer.init_state(jax.random.PRNGKey(0), for_restore=True)
-    state = restore_checkpoint(path, state)
     print(f"loaded {path} (test acc {ckpt_acc})")
 
     render_dir = os.path.join(args.workdir, "render")

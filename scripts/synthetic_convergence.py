@@ -86,17 +86,11 @@ def compare_prune_styles(cfg) -> dict:
     """Restore the last pre-prune checkpoint and measure test accuracy
     unpruned vs reference-prune vs renormalized-prune (the measurement behind
     core/mgproto.py:prune_top_m's renormalize option)."""
-    import jax
-
     from mgproto_tpu.cli.train import _labeled
     from mgproto_tpu.core.mgproto import prune_top_m
     from mgproto_tpu.data import build_pipelines
     from mgproto_tpu.engine import evaluate
-    from mgproto_tpu.engine.train import Trainer
-    from mgproto_tpu.utils.checkpoint import (
-        list_checkpoints,
-        restore_checkpoint,
-    )
+    from mgproto_tpu.utils.checkpoint import list_checkpoints
 
     # (epoch, stage, acc, path) tuples, already sorted by epoch
     nopush = [c for c in list_checkpoints(cfg.model_dir) if c[1] == "nopush"]
@@ -104,9 +98,7 @@ def compare_prune_styles(cfg) -> dict:
         return {}
     path = nopush[-1][-1]
     _, _, test_loader, _ = build_pipelines(cfg)
-    trainer = Trainer(cfg, steps_per_epoch=1)
-    state = trainer.init_state(jax.random.PRNGKey(0), for_restore=True)
-    state = restore_checkpoint(path, state)
+    cfg, trainer, state = restore_for_eval(cfg, path, log=lambda *_: None)
 
     def acc_of(s):
         a, _ = evaluate(trainer, s, _labeled(test_loader), log=lambda *_: None)
@@ -185,8 +177,14 @@ def build_config(workdir: str, arch: str, classes: int, epochs: int,
             mine_start=2,
             update_gmm_start=2,
             # proportional to the reference's 100/120-epoch push schedule and
-            # its 8-of-10 prune (settings.py:51-52, main.py:285)
-            push_start=max(int(epochs * 0.8), 1),
+            # its 8-of-10 prune (settings.py:51-52, main.py:285). Push fires
+            # on MULTIPLES of push_every at/after push_start (reference
+            # settings.py:52 semantics), so anchor push_start on the largest
+            # multiple of push_every <= 0.8*epochs — a fractional start like
+            # 11-of-14 would otherwise contain no push epoch at all. Runs
+            # shorter than push_every+1 epochs still cannot push (no nonzero
+            # multiple is in range); main() warns when the window is empty.
+            push_start=max((int(epochs * 0.8) // 5) * 5, 1),
             push_every=5,
             prune_top_m=4,
         ),
@@ -248,7 +246,31 @@ def resolve_build_config(workdir: str, ood_dirs=(), log=print, **fallback):
     """(cfg, effective_args) for a restore-time consumer — persisted build
     args when present, flag fallbacks otherwise."""
     eff = effective_build_args(workdir, log=log, **fallback)
+    if not eff:
+        raise FileNotFoundError(
+            f"{workdir} has no persisted {_BUILD_ARGS_NAME} and the caller "
+            "supplied no flag fallbacks — for pre-persistence workdirs pass "
+            "arch/classes/epochs/batch explicitly"
+        )
     return build_config(workdir, ood_dirs=ood_dirs, **eff), eff
+
+
+def restore_for_eval(cfg, path: str, log=print):
+    """(trainer, state) restored from `path` under cfg — the ONE
+    restore-and-measure sequence shared by every evidence script (a future
+    restore-contract change must not have to be applied in four places)."""
+    import jax
+
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.utils.checkpoint import (
+        adopt_checkpoint_train_config,
+        restore_checkpoint,
+    )
+
+    cfg = adopt_checkpoint_train_config(cfg, path, log=log)
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0), for_restore=True)
+    return cfg, trainer, restore_checkpoint(path, state)
 
 
 def main() -> None:
@@ -266,6 +288,12 @@ def main() -> None:
     p.add_argument("--mem_capacity", type=int, default=64,
                    help="memory-bank capacity per class (reference: 800)")
     p.add_argument("--proto_dim", type=int, default=16)
+    p.add_argument("--target_accu", type=float, default=0.3,
+                   help="checkpoint save threshold (reference utils/save.py "
+                        "semantics: save only above it). Lower it for runs "
+                        "whose plateau sits under 0.3 — e.g. 200-class "
+                        "over-chance evidence — or the run leaves NO "
+                        "restorable checkpoint for push/prune analysis.")
     p.add_argument("--compute_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="trunk compute dtype (the TPU recipe uses bfloat16)")
@@ -297,8 +325,17 @@ def main() -> None:
     )
     save_build_args(args.workdir, **build_kwargs)
     cfg = build_config(args.workdir, **build_kwargs)
+    if not cfg.schedule.push_epochs():
+        print(
+            f"WARNING: no push epoch in this {args.epochs}-epoch schedule "
+            f"(push fires on multiples of {cfg.schedule.push_every} >= "
+            f"{cfg.schedule.push_start}); use scripts/push_posthoc.py on the "
+            "best nopush checkpoint for push/prune evidence"
+        )
 
-    _, accuracy = run_training(cfg, render_push=False, target_accu=0.3)
+    _, accuracy = run_training(
+        cfg, render_push=False, target_accu=args.target_accu
+    )
 
     os.makedirs(args.out, exist_ok=True)
     shutil.copy(

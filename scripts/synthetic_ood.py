@@ -128,16 +128,9 @@ def main() -> None:
 
     pin_cpu_devices(1)
 
-    import jax
-
     from mgproto_tpu.cli.train import _test
     from mgproto_tpu.data import build_pipelines
-    from mgproto_tpu.engine.train import Trainer
-    from mgproto_tpu.utils.checkpoint import (
-        adopt_checkpoint_train_config,
-        restore_checkpoint,
-        select_checkpoint,
-    )
+    from mgproto_tpu.utils.checkpoint import select_checkpoint
 
     run_dir = os.path.join(args.workdir, "run")
     found = select_checkpoint(run_dir, stage=args.stage, policy="latest")
@@ -161,14 +154,10 @@ def main() -> None:
         os.path.join(args.workdir, "data"), id_classes=eff["classes"]
     )
     cfg = sc.build_config(args.workdir, ood_dirs=ood_dirs, **eff)
-    # p(x)/OoD numbers must reflect the numerics the model trained under,
-    # not a silent f32 default
-    cfg = adopt_checkpoint_train_config(cfg, path, log=print)
-
+    # restore_for_eval adopts the checkpoint's training-time numerics —
+    # p(x)/OoD numbers must not reflect a silent f32 default
+    cfg, trainer, state = sc.restore_for_eval(cfg, path)
     _, _, test_loader, ood_loaders = build_pipelines(cfg)
-    trainer = Trainer(cfg, steps_per_epoch=1)
-    state = trainer.init_state(jax.random.PRNGKey(0), for_restore=True)
-    state = restore_checkpoint(path, state)
     print(f"loaded {path}")
 
     _, results = _test(trainer, state, test_loader, ood_loaders, print)
