@@ -82,6 +82,11 @@ def _env_int(name: str, default: int) -> int:
 BATCH = _env_int("BENCH_BATCH", 80)
 WARMUP = _env_int("BENCH_WARMUP", 3)
 ITERS = _env_int("BENCH_ITERS", 10)
+# The 2026-07-31 on-device sweep (PERF.md) found the fused path peaks well
+# above the reference's batch 80: 1016 img/s @80 -> 1169 @128 -> 1330 @256.
+# A third measurement at this batch captures the throughput-optimal config;
+# 0 disables it (CI smoke runs only the two reference-batch paths).
+BEST_BATCH = _env_int("BENCH_BEST_BATCH", 256)
 
 MAX_ATTEMPTS = 6
 BACKOFF_S = (5, 10, 20, 40, 60)  # >= 5 attempts spread over >= 2 minutes
@@ -250,10 +255,11 @@ def run_config(fused: bool) -> dict:
         "step_time_s": dt / ITERS,
         "flops_per_step": flops,
         "device_kind": jax.devices()[0].device_kind,
+        "batch": BATCH,
     }
 
 
-def robust_measure(fused: bool, reemit=None) -> tuple:
+def robust_measure(name: str, fused: bool, batch: int, reemit=None) -> tuple:
     """(result dict or None, last error string or None, attempts used).
 
     Retries with exponential backoff on ANY failure — the observed transients
@@ -266,10 +272,17 @@ def robust_measure(fused: bool, reemit=None) -> tuple:
     caller's best-known partial RESULT line right after every in-progress
     emission, so once one scoring path has produced a number, the last line
     stays a number through the other path's attempts."""
-    name = "fused" if fused else "unfused"
     last_err = None
-    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--measure", name]
-    for attempt in range(1, MAX_ATTEMPTS + 1):
+    cmd = [
+        sys.executable, "-u", os.path.abspath(__file__),
+        "--measure", "fused" if fused else "unfused", str(batch),
+    ]
+    # the optional best-batch entry is a bonus measurement: give a likely-
+    # deterministic failure (e.g. HBM OOM at the bigger batch on a smaller
+    # device) at most 2 attempts instead of burning the rare relay window
+    # the reference-batch paths already used productively
+    max_attempts = MAX_ATTEMPTS if name in ("unfused", "fused") else 2
+    for attempt in range(1, max_attempts + 1):
         # enforce the whole-run cap BEFORE spending, and never hand a child
         # more than the remaining budget — otherwise a wedged relay overruns
         # DEADLINE_S by up to ATTEMPT_TIMEOUT_S per scoring path
@@ -325,16 +338,24 @@ def robust_measure(fused: bool, reemit=None) -> tuple:
         if time.monotonic() - _START > DEADLINE_S:
             last_err += " [deadline exceeded, no more retries]"
             return None, last_err, attempt
-        if attempt < MAX_ATTEMPTS:
+        if attempt < max_attempts:
             time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
-    return None, last_err, MAX_ATTEMPTS
+    return None, last_err, max_attempts
 
 
 def _summary(results: dict, errors: dict, attempts_total: int,
              partial: bool) -> dict:
     """The driver-contract result line, shared by the partial emission (first
-    path done) and the final one so the two can never drift in shape."""
-    winner = max(results, key=lambda k: results[k]["imgs_per_sec"])
+    path done) and the final one so the two can never drift in shape.
+
+    The headline value/vs_baseline/mfu stay pinned to the REFERENCE-batch
+    head-to-head (unfused/fused at batch 80) so rounds remain comparable and
+    vs_baseline stays apples-to-apples with the batch-80 A100 estimate; the
+    throughput-optimal batch entry is reported via its own keys only
+    (fused_b<N>_imgs_per_sec, best_batch*)."""
+    reference = {k: v for k, v in results.items()
+                 if k in ("unfused", "fused")} or results
+    winner = max(reference, key=lambda k: reference[k]["imgs_per_sec"])
     best = results[winner]
     value = best["imgs_per_sec"]
     flops = best["flops_per_step"]
@@ -347,6 +368,7 @@ def _summary(results: dict, errors: dict, attempts_total: int,
         "unit": "images/sec/chip",
         "vs_baseline": round(value / A100_EST_IMAGES_PER_SEC, 3),
         "winner": winner,
+        "winner_batch": best.get("batch"),
         "unfused_imgs_per_sec": round(
             results.get("unfused", {}).get("imgs_per_sec", 0.0), 2
         ),
@@ -359,6 +381,17 @@ def _summary(results: dict, errors: dict, attempts_total: int,
         "north_star_frac_per_chip": round(value / NORTH_STAR_PER_CHIP, 3),
         "attempts": attempts_total,
     }
+    for name, r in results.items():
+        if name not in ("unfused", "fused"):
+            out[f"{name}_imgs_per_sec"] = round(r["imgs_per_sec"], 2)
+            if r["imgs_per_sec"] > best["imgs_per_sec"]:
+                out["best_batch"] = r.get("batch")
+                out["best_batch_imgs_per_sec"] = round(r["imgs_per_sec"], 2)
+                peak_b = peak_flops(r["device_kind"])
+                out["best_batch_mfu"] = (
+                    round(r["flops_per_step"] / r["step_time_s"] / peak_b, 4)
+                    if r["flops_per_step"] else None
+                )
     if partial:
         out["partial"] = True
     if errors:
@@ -432,13 +465,19 @@ def main() -> None:
         })
         raise SystemExit(1)
 
+    plan = [("unfused", False, BATCH), ("fused", True, BATCH)]
+    if BEST_BATCH > 0 and BEST_BATCH != BATCH:
+        # throughput-optimal batch from the on-device sweep (PERF.md); the
+        # two reference-batch paths come FIRST so a deadline-truncated run
+        # still records the head-to-head at the reference's batch 80
+        plan.append((f"fused_b{BEST_BATCH}", True, BEST_BATCH))
     results = {}
     errors = {}
     attempts_total = 0
     partial_line = None
-    for name, fused in (("unfused", False), ("fused", True)):
+    for name, fused, batch in plan:
         result, err, attempts = robust_measure(
-            fused,
+            name, fused, batch,
             # once a partial result exists, re-flush it after every
             # in-progress line so the last line stays a real number
             reemit=(lambda: _emit(partial_line)) if partial_line else None,
@@ -451,7 +490,7 @@ def main() -> None:
         if results:
             # flush the best-known RESULT now: a kill during the next path
             # still leaves a real number as the last parseable line
-            is_final = name == "fused"
+            is_final = name == plan[-1][0]
             partial_line = _summary(results, errors, attempts_total,
                                     partial=not is_final)
             _emit(partial_line)
@@ -466,8 +505,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--measure":
-        # child mode: one measurement, result JSON on the last stdout line
-        print(json.dumps(run_config(fused=(sys.argv[2] == "fused"))))
+    if len(sys.argv) in (3, 4) and sys.argv[1] == "--measure":
+        # child mode: one measurement, result JSON on the last stdout line.
+        # Optional 3rd operand overrides the batch (the best-batch plan
+        # entry); BENCH_BATCH env still works for plain 2-operand calls.
+        if len(sys.argv) == 4:
+            BATCH = int(sys.argv[3])
+        print(json.dumps(run_config(fused=sys.argv[2].startswith("fused"))))
     else:
         main()

@@ -97,8 +97,13 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--compute_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="trunk compute dtype (params/density stay f32)")
-    p.add_argument("--fused_scoring", action="store_true",
-                   help="Pallas fused density+top-T kernel (TPU)")
+    p.add_argument("--fused_scoring", action="store_true", default=None,
+                   help="force the Pallas fused density+top-T kernel on "
+                        "(default: auto — on for TPU with an unsharded "
+                        "class axis, off elsewhere)")
+    p.add_argument("--no_fused_scoring", dest="fused_scoring",
+                   action="store_false",
+                   help="force the XLA scoring path")
     p.add_argument("--remat", action="store_true",
                    help="checkpoint backbone blocks (HBM for FLOPs)")
     p.add_argument("--num_workers", type=int, default=8)

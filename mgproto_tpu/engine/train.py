@@ -64,6 +64,13 @@ class Trainer:
         self.steps_per_epoch = steps_per_epoch
         self.donate = donate
         self.model = MGProtoFeatures(cfg=cfg.model)
+        # fused_scoring=None resolves per backend: the Pallas kernel measured
+        # 1.9x faster than the XLA path on real TPU (BENCH_PROBE_RUN.json)
+        # so TPU defaults to it; CPU/GPU fall back to the XLA path (the
+        # interpret-mode kernel is correct but slow). ShardedTrainer further
+        # constrains auto-resolution (a pallas_call cannot be partitioned
+        # over a sharded class axis). Explicit True/False is always honored.
+        self._fused = self._resolve_fused(cfg.model.fused_scoring)
         self.joint_tx = make_joint_optimizer(cfg, steps_per_epoch)
         self.warm_tx = make_warm_optimizer(cfg)
         self.proto_tx = make_mean_optimizer(cfg.em)
@@ -78,6 +85,11 @@ class Trainer:
             donate_argnums=(0,) if donate else (),
         )
         self._eval_step = jax.jit(self._eval)
+
+    def _resolve_fused(self, fused: Optional[bool]) -> bool:
+        if fused is not None:
+            return fused
+        return jax.default_backend() == "tpu"
 
     def init_state(self, rng: jax.Array, for_restore: bool = False) -> TrainState:
         """`for_restore=True` builds a restore TARGET: skips the pretrained
@@ -116,7 +128,7 @@ class Trainer:
         )
         logits, pooled, enq = head_forward(
             proto_map, state.gmm, labels, self.cfg.model.mine_T,
-            fused=self.cfg.model.fused_scoring,
+            fused=self._fused,
         )
         ce = L.cross_entropy(logits[..., 0], labels)
         mine = L.mine_loss(logits, labels) * use_mine
@@ -217,7 +229,7 @@ class Trainer:
         )
         logits, _, _ = head_forward(
             proto_map, state.gmm, None, self.cfg.model.mine_T,
-            fused=self.cfg.model.fused_scoring,
+            fused=self._fused,
         )
         lvl0 = logits[..., 0]
         correct = (

@@ -84,6 +84,14 @@ class ShardedTrainer(Trainer):
         self._repl = replicated(self.mesh)
         self._batch_sh = batch_sharding(self.mesh)
         self._state_sh = None  # built lazily from the first state seen
+        # Re-resolve fused_scoring=None now that the mesh is known (the base
+        # __init__ ran before it existed): SPMD cannot partition a
+        # pallas_call over a sharded class axis, so auto stays on the XLA
+        # path whenever model>1. Safe to rebind here — the jitted steps trace
+        # (and read _fused) on first call, not at jit-wrap time. An explicit
+        # fused_scoring=True is honored unchanged (single-axis TPU meshes).
+        if cfg.model.fused_scoring is None and self.mesh.shape["model"] > 1:
+            self._fused = False
 
     # -------------------------------------------------------------- plumbing
     def _build_jits(self, state_sh: Any) -> None:

@@ -142,17 +142,21 @@ def compare_prune_styles(cfg) -> dict:
 def build_config(workdir: str, arch: str, classes: int, epochs: int,
                  batch: int, ood_dirs=(), compute_dtype: str = "float32",
                  aux_loss: str = "proxy_anchor", protos: int = 5,
-                 mem_capacity: int = 64, proto_dim: int = 16):
+                 mem_capacity: int = 64, proto_dim: int = 16,
+                 mesh_data: int = -1, mesh_model: int = 1):
     """The evidence Config shared by this script and synthetic_ood.py —
     the OoD evaluation must restore checkpoints under the EXACT training-time
     model config. protos/mem_capacity/proto_dim default to the tiny evidence
     shapes; the flagship-width evidence run (VERDICT r3 item 3) passes the
     reference's real K=10 / capacity-800 (reference settings.py:4,
-    main.py:25)."""
+    main.py:25). mesh_data/mesh_model shard the run over a device mesh —
+    the ImageNet-1000 stretch evidence class-shards GMM/memory/EM over
+    'model' on a virtual CPU mesh (SURVEY.md §2.3, §5.7)."""
     from mgproto_tpu.config import (
         Config,
         DataConfig,
         LossConfig,
+        MeshConfig,
         ModelConfig,
         ScheduleConfig,
     )
@@ -200,6 +204,7 @@ def build_config(workdir: str, arch: str, classes: int, epochs: int,
             train_push_batch_size=32,
             num_workers=2,
         ),
+        mesh=MeshConfig(data=mesh_data, model=mesh_model),
         model_dir=os.path.join(workdir, "run"),
     )
 
@@ -303,11 +308,23 @@ def main() -> None:
                    help="auxiliary DML loss — ALL six are trainable here "
                         "(the reference CLI crashes on everything but "
                         "proxy_anchor, reference main.py:189-198)")
+    p.add_argument("--cpu_devices", type=int, default=1,
+                   help="virtual CPU device count (8 for the class-sharded "
+                        "stretch evidence; SURVEY.md §4's fake-mesh story). "
+                        "0 = do NOT pin: use the default backend — the "
+                        "real-TPU end-to-end evidence run")
+    p.add_argument("--mesh_data", type=int, default=-1,
+                   help="mesh data-axis size (-1: all remaining devices)")
+    p.add_argument("--mesh_model", type=int, default=1,
+                   help="mesh model-axis size — class-shards GMM/memory/EM "
+                        "(must divide both --cpu_devices and --classes)")
     args = p.parse_args()
 
-    from mgproto_tpu.hermetic import pin_cpu_devices
+    if args.cpu_devices > 0:
+        from mgproto_tpu.hermetic import pin_cpu_devices
 
-    pin_cpu_devices(1)  # evidence runs hermetically; TPU relay not required
+        # evidence runs hermetically; TPU relay not required
+        pin_cpu_devices(args.cpu_devices)
 
     from mgproto_tpu.cli.train import run_training
 
@@ -322,6 +339,7 @@ def main() -> None:
         batch=args.batch, compute_dtype=args.compute_dtype,
         aux_loss=args.aux_loss, protos=args.protos,
         mem_capacity=args.mem_capacity, proto_dim=args.proto_dim,
+        mesh_data=args.mesh_data, mesh_model=args.mesh_model,
     )
     save_build_args(args.workdir, **build_kwargs)
     cfg = build_config(args.workdir, **build_kwargs)

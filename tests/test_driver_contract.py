@@ -73,6 +73,7 @@ def test_bench_emits_contract_json_at_toy_size():
     env.update(
         BENCH_BATCH="4", BENCH_WARMUP="0", BENCH_ITERS="1",
         BENCH_ATTEMPT_TIMEOUT_S="300", BENCH_DEADLINE_S="600",
+        BENCH_BEST_BATCH="0",  # no best-batch attempt at CPU toy sizes
     )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],
@@ -115,6 +116,7 @@ def test_bench_failure_emits_diagnostic_json():
         BENCH_FAIL_INJECT="1", BENCH_BATCH="4", BENCH_WARMUP="0",
         BENCH_ITERS="1", BENCH_ATTEMPT_TIMEOUT_S="60", BENCH_DEADLINE_S="5",
         BENCH_SKIP_PROBE="1",  # target the retry ladder, not the probe gate
+        BENCH_BEST_BATCH="0",
     )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],

@@ -129,3 +129,36 @@ def test_train_step_fused_matches_unfused():
         jax.tree_util.tree_leaves(s0.params), jax.tree_util.tree_leaves(s1.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_fused_scoring_auto_resolution():
+    """fused_scoring=None resolves per backend/mesh: off on CPU (this test's
+    backend), on only for TPU with an unsharded class axis; explicit
+    True/False is always honored (config.py:ModelConfig.fused_scoring)."""
+    import dataclasses
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.parallel import ShardedTrainer, make_mesh
+
+    def with_fused(value):
+        cfg = tiny_test_config()
+        return cfg.replace(
+            model=dataclasses.replace(cfg.model, fused_scoring=value)
+        )
+
+    assert jax.default_backend() == "cpu"  # conftest pins the CPU backend
+    assert Trainer(with_fused(None), steps_per_epoch=1)._fused is False
+    assert Trainer(with_fused(True), steps_per_epoch=1)._fused is True
+    assert Trainer(with_fused(False), steps_per_epoch=1)._fused is False
+
+    # class-sharded mesh: auto must stay on the XLA path (SPMD cannot
+    # partition a pallas_call over the class axis); explicit True wins
+    devices = jax.devices()[:4]
+    mesh = make_mesh(data=2, model=2, devices=devices)
+    assert ShardedTrainer(
+        with_fused(None), steps_per_epoch=1, mesh=mesh
+    )._fused is False
+    assert ShardedTrainer(
+        with_fused(True), steps_per_epoch=1, mesh=mesh
+    )._fused is True
