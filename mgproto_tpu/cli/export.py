@@ -1,0 +1,85 @@
+"""Export driver: checkpoint -> self-contained StableHLO inference artifact.
+
+Beyond the reference's deployment story (torch state_dicts that need the full
+Python model code to reload, eval_purity.py:55): `mgproto-export` produces a
+one-file program — weights baked in, symbolic batch — that any XLA backend
+runs via `jax.export.deserialize` alone. See engine/export.py.
+
+    mgproto-export --arch resnet34 --num_classes 200 \
+        --model_dir saved_models --out mgproto_r34_cub.mgproto
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+import jax
+
+from mgproto_tpu.cli.common import add_train_args, config_from_args
+from mgproto_tpu.engine.export import (
+    artifact_meta,
+    export_eval,
+    save_artifact,
+)
+from mgproto_tpu.engine.train import Trainer
+from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
+from mgproto_tpu.utils.checkpoint import adopt_checkpoint_train_config
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="Export an MGProto-TPU checkpoint as a StableHLO artifact"
+    )
+    add_train_args(p)
+    p.add_argument("--checkpoint", default="auto",
+                   help="checkpoint path ('auto' = latest in --model_dir)")
+    p.add_argument("--out", required=True,
+                   help="artifact path to write (convention: *.mgproto)")
+    p.add_argument("--static_batch", type=int, default=0,
+                   help="pin the batch dimension to this size instead of "
+                        "exporting a symbolic batch (some non-XLA StableHLO "
+                        "consumers need static shapes); 0 = symbolic")
+    args = p.parse_args(argv)
+    cfg = config_from_args(args)
+
+    path = (
+        latest_checkpoint(cfg.model_dir)
+        if args.checkpoint == "auto"
+        else args.checkpoint
+    )
+    if not path:
+        raise FileNotFoundError(f"no checkpoint found in {cfg.model_dir}")
+    cfg = adopt_checkpoint_train_config(cfg, path, log=print)
+    # the exported program always uses the portable XLA scoring path
+    # (engine/export.py); forcing it here avoids constructing a fused-path
+    # Trainer on TPU hosts only for export_eval to rebuild a portable one
+    import dataclasses
+
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, fused_scoring=False)
+    )
+
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(cfg.seed), for_restore=True)
+    state = restore_checkpoint(path, state)
+
+    dynamic = args.static_batch <= 0
+    exported = export_eval(
+        trainer, state, dynamic_batch=dynamic,
+        static_batch=max(args.static_batch, 1),
+    )
+    meta = artifact_meta(cfg, path, dynamic)
+    save_artifact(args.out, exported, meta)
+    print(json.dumps({
+        "artifact": args.out,
+        "bytes": os.path.getsize(args.out),
+        **{k: meta[k] for k in ("arch", "num_classes", "img_size",
+                                "dynamic_batch", "checkpoint")},
+    }))
+
+
+if __name__ == "__main__":
+    main()

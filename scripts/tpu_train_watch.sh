@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Waits for a TPU relay window, then runs the production driver END TO END on
+# the real chip: scripts/synthetic_convergence.py --cpu_devices 0 (no CPU
+# pinning), bf16 trunk, fused Pallas scoring via the auto default. This is
+# the "training on hardware" complement to bench.py's step-level numbers —
+# warm/joint phases, mine, EM, push, prune, checkpoints, all on the TPU.
+#
+# Usage: tpu_train_watch.sh [duration_s] [period_s]
+set -u
+cd "$(dirname "$0")/.."
+DURATION="${1:-36000}"
+PERIOD="${2:-600}"
+END=$(( $(date +%s) + DURATION ))
+OUT=evidence/tpu_e2e
+echo "[tpu_train_watch] start $(date -Is) duration=${DURATION}s period=${PERIOD}s"
+while [ "$(date +%s)" -lt "$END" ]; do
+    if python scripts/tpu_probe.py --timeout 75 --quiet; then
+        echo "[tpu_train_watch] $(date -Is) probe OK — starting TPU training run"
+        if timeout 3000 python scripts/synthetic_convergence.py \
+            --out "$OUT" --workdir /tmp/mgproto_tpu_e2e \
+            --classes 50 --per_class 20 --test_per_class 6 --epochs 12 \
+            --batch 32 --protos 10 --proto_dim 64 --mem_capacity 100 \
+            --arch resnet18 --compute_dtype bfloat16 --cpu_devices 0 \
+            --target_accu 0.05 \
+            && [ -f "$OUT/summary.json" ]; then
+            echo "[tpu_train_watch] TPU training run DONE -> $OUT"
+            exit 0
+        fi
+        echo "[tpu_train_watch] run failed/timed out; will retry next window"
+    else
+        echo "[tpu_train_watch] $(date -Is) probe failed (relay down)"
+    fi
+    sleep "$PERIOD"
+done
+echo "[tpu_train_watch] end $(date -Is) without a completed TPU run"

@@ -1,0 +1,178 @@
+"""StableHLO export artifacts (engine/export.py, cli/export.py).
+
+The exported program must (1) reproduce the live eval step's numbers, (2)
+serve multiple batch sizes from one symbolic-batch artifact, (3) round-trip
+through the one-file zip format with its metadata, and (4) be reachable from
+the CLI against a real checkpoint — the deployment surface a reference user
+gets INSTEAD of `load_state_dict` + the Python model tree."""
+
+import json
+import os
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.engine.export import (
+    artifact_meta,
+    export_eval,
+    load_artifact,
+    save_artifact,
+)
+from mgproto_tpu.engine.train import Trainer
+
+
+def _trainer_state():
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    return cfg, trainer, state
+
+
+def test_export_matches_live_eval_and_serves_multiple_batches(tmp_path):
+    cfg, trainer, state = _trainer_state()
+    exported = export_eval(trainer, state)
+    path = str(tmp_path / "tiny.mgproto")
+    save_artifact(path, exported, artifact_meta(cfg, None, True))
+    infer, meta = load_artifact(path)
+
+    for batch in (2, 5):  # one symbolic-batch artifact, several batch sizes
+        imgs = jnp.asarray(
+            np.random.RandomState(batch).rand(
+                batch, cfg.model.img_size, cfg.model.img_size, 3
+            ),
+            jnp.float32,
+        )
+        got = infer(imgs)
+        want = trainer.eval_step(state, imgs)
+        np.testing.assert_allclose(
+            np.asarray(got["logits"]), np.asarray(want.logits),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got["log_px"]), np.asarray(want.log_px),
+            rtol=1e-5, atol=1e-5,
+        )
+    assert meta["num_classes"] == cfg.model.num_classes
+    assert meta["compute_dtype"] == cfg.model.compute_dtype
+    # multi-platform lowering: a TPU-side export must stay servable on CPU
+    assert {"cpu", "tpu"} <= set(exported.platforms)
+
+
+def test_export_forces_portable_scoring_path(tmp_path):
+    """A fused-scoring trainer must still export the XLA path (a serialized
+    pallas_call would pin the artifact to TPU+Mosaic) and agree with it."""
+    import dataclasses
+
+    cfg = tiny_test_config()
+    fused_cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, fused_scoring=True)
+    )
+    trainer = Trainer(fused_cfg, steps_per_epoch=1)
+    assert trainer._fused
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    exported = export_eval(trainer, state)
+    path = str(tmp_path / "fused.mgproto")
+    save_artifact(path, exported, artifact_meta(fused_cfg, None, True))
+    infer, _ = load_artifact(path)
+
+    imgs = jnp.asarray(
+        np.random.RandomState(0).rand(
+            3, cfg.model.img_size, cfg.model.img_size, 3
+        ),
+        jnp.float32,
+    )
+    unfused = Trainer(cfg, steps_per_epoch=1)
+    want = unfused.eval_step(state, imgs)
+    np.testing.assert_allclose(
+        np.asarray(infer(imgs)["logits"]), np.asarray(want.logits),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_static_batch_export_rejects_other_batch_sizes(tmp_path):
+    cfg, trainer, state = _trainer_state()
+    exported = export_eval(trainer, state, dynamic_batch=False, static_batch=4)
+    path = str(tmp_path / "static.mgproto")
+    save_artifact(path, exported, artifact_meta(cfg, None, False))
+    infer, meta = load_artifact(path)
+    assert meta["dynamic_batch"] is False
+
+    ok = jnp.zeros((4, cfg.model.img_size, cfg.model.img_size, 3), jnp.float32)
+    assert np.asarray(infer(ok)["logits"]).shape == (4, cfg.model.num_classes)
+    bad = jnp.zeros((2, cfg.model.img_size, cfg.model.img_size, 3), jnp.float32)
+    with pytest.raises(Exception):
+        infer(bad)
+
+
+def test_artifact_is_a_plain_zip_with_meta(tmp_path):
+    cfg, trainer, state = _trainer_state()
+    path = str(tmp_path / "zip.mgproto")
+    save_artifact(path, export_eval(trainer, state),
+                  artifact_meta(cfg, "ckpt/path", True))
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        assert names == {"model.stablehlo", "meta.json"}
+        meta = json.loads(z.read("meta.json"))
+    assert meta["format"] == "mgproto-stablehlo-v1"
+    assert meta["checkpoint"] == "ckpt/path"
+
+
+@pytest.mark.slow
+def test_cli_export_end_to_end(tmp_path, capsys):
+    """Train tiny -> mgproto-export -> load WITHOUT mgproto_tpu imports ->
+    classify: the full deployment path a migrating user follows."""
+    from test_cli import _make_folder
+
+    from mgproto_tpu.cli.export import main as export_main
+    from mgproto_tpu.cli.train import run_training
+    from mgproto_tpu.config import DataConfig
+
+    data_root = str(tmp_path / "data")
+    _make_folder(os.path.join(data_root, "train"))
+    cfg = tiny_test_config().replace(
+        data=DataConfig(
+            train_dir=os.path.join(data_root, "train"),
+            test_dir=os.path.join(data_root, "train"),
+            train_push_dir=os.path.join(data_root, "train"),
+            ood_dirs=(),
+            train_batch_size=8,
+            test_batch_size=8,
+            train_push_batch_size=8,
+            num_workers=2,
+        ),
+        model_dir=str(tmp_path / "run"),
+    )
+    run_training(cfg, render_push=False)
+    capsys.readouterr()
+
+    out_path = str(tmp_path / "model.mgproto")
+    export_main([
+        "--dataset", "CUB", "--arch", "tiny", "--num_classes", "4",
+        "--protos_per_class", "3", "--proto_dim", "8", "--aux_emb_sz", "8",
+        "--mine_level", "4", "--mem_sz", "16", "--no_pretrained",
+        "--img_size", "32",
+        "--train_dir", os.path.join(data_root, "train"),
+        "--test_dir", os.path.join(data_root, "train"),
+        "--push_dir", os.path.join(data_root, "train"),
+        "--model_dir", str(tmp_path / "run"),
+        "--out", out_path,
+    ])
+    printed = json.loads(
+        [l for l in capsys.readouterr().out.splitlines()
+         if l.startswith("{")][-1]
+    )
+    assert printed["artifact"] == out_path and printed["bytes"] > 0
+
+    # serving side: jax.export only — no framework imports
+    from jax import export as jax_export
+
+    with zipfile.ZipFile(out_path) as z:
+        program = jax_export.deserialize(z.read("model.stablehlo"))
+    imgs = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    out = program.call(imgs)
+    assert np.asarray(out["logits"]).shape == (2, 4)
+    assert np.all(np.isfinite(np.asarray(out["log_px"])))
