@@ -318,6 +318,9 @@ def main() -> None:
     p.add_argument("--mesh_model", type=int, default=1,
                    help="mesh model-axis size — class-shards GMM/memory/EM "
                         "(must divide both --cpu_devices and --classes)")
+    p.add_argument("--profile_dir", default="",
+                   help="write a jax.profiler trace of the first epoch here "
+                        "(cli/common.py --profile_dir pass-through)")
     args = p.parse_args()
 
     if args.cpu_devices > 0:
@@ -352,7 +355,8 @@ def main() -> None:
         )
 
     _, accuracy = run_training(
-        cfg, render_push=False, target_accu=args.target_accu
+        cfg, render_push=False, target_accu=args.target_accu,
+        profile_dir=args.profile_dir,
     )
 
     os.makedirs(args.out, exist_ok=True)

@@ -21,7 +21,7 @@ while [ "$(date +%s)" -lt "$END" ]; do
             --classes 50 --per_class 20 --test_per_class 6 --epochs 12 \
             --batch 32 --protos 10 --proto_dim 64 --mem_capacity 100 \
             --arch resnet18 --compute_dtype bfloat16 --cpu_devices 0 \
-            --target_accu 0.05 \
+            --target_accu 0.05 --profile_dir "$OUT/trace" \
             && [ -f "$OUT/summary.json" ]; then
             echo "[tpu_train_watch] TPU training run DONE -> $OUT"
             exit 0
