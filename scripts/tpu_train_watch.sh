@@ -14,7 +14,10 @@ END=$(( $(date +%s) + DURATION ))
 OUT=evidence/tpu_e2e
 echo "[tpu_train_watch] start $(date -Is) duration=${DURATION}s period=${PERIOD}s"
 while [ "$(date +%s)" -lt "$END" ]; do
-    if python scripts/tpu_probe.py --timeout 75 --quiet; then
+    # own probe log: tpu_watch.sh also probes on its own cadence, and two
+    # writers would double-count TPU_PROBE.jsonl's availability record
+    if python scripts/tpu_probe.py --timeout 75 --quiet \
+        --log TPU_TRAIN_PROBE.jsonl; then
         echo "[tpu_train_watch] $(date -Is) probe OK — starting TPU training run"
         if timeout 3000 python scripts/synthetic_convergence.py \
             --out "$OUT" --workdir /tmp/mgproto_tpu_e2e \

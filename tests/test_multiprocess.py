@@ -1,9 +1,11 @@
-"""Two-process jax.distributed CI (SURVEY.md §4's "multi-node without a
-cluster"): launches 2 coordinated CPU processes (4 virtual devices each) and
-drives the REAL multi-process branches of parallel/multihost.py,
-sharding.put_batch, ShardedTrainer, and the loader's shard_index>0 path —
-all of which single-process CI can only exercise as identity no-ops
-(multihost.py:15-17)."""
+"""Multi-process jax.distributed CI (SURVEY.md §4's "multi-node without a
+cluster"): launches 2 — and, in the slow tier, 4 — coordinated CPU processes
+(4 virtual devices each) and drives the REAL multi-process branches of
+parallel/multihost.py, sharding.put_batch, ShardedTrainer, and the loader's
+shard_index>0 path — all of which single-process CI can only exercise as
+identity no-ops (multihost.py:15-17). The 4-process shape (16 global
+devices, mesh data:8 x model:2) is the smallest where every host owns a
+strict minority of the mesh."""
 
 import os
 import socket
@@ -11,10 +13,10 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multiprocess_worker.py")
-NPROCS = 2
 
 
 def _free_port() -> int:
@@ -38,7 +40,18 @@ def _make_dataset(root) -> int:
     return n
 
 
-def test_two_process_distributed_end_to_end(tmp_path):
+@pytest.mark.parametrize(
+    "nprocs",
+    [
+        2,
+        # 4 coordinated processes (16 global devices, mesh data:8 x model:2):
+        # the smallest shape where every host owns a strict minority of the
+        # mesh and the loader splits 4 ways — slow on 1 vCPU, so opt-in with
+        # the rest of the slow tier
+        pytest.param(4, marks=pytest.mark.slow),
+    ],
+)
+def test_multi_process_distributed_end_to_end(tmp_path, nprocs):
     data_dir = str(tmp_path / "data")
     n = _make_dataset(data_dir)
     assert n == 18
@@ -51,7 +64,7 @@ def test_two_process_distributed_end_to_end(tmp_path):
 
     procs = [
         subprocess.Popen(
-            [sys.executable, "-u", WORKER, str(pid), str(NPROCS), str(port),
+            [sys.executable, "-u", WORKER, str(pid), str(nprocs), str(port),
              data_dir],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -59,12 +72,12 @@ def test_two_process_distributed_end_to_end(tmp_path):
             env=env,
             cwd=REPO,
         )
-        for pid in range(NPROCS)
+        for pid in range(nprocs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=540)
+            out, _ = p.communicate(timeout=540 if nprocs == 2 else 900)
             outs.append(out)
     finally:
         for p in procs:
