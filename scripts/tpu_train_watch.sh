@@ -8,6 +8,13 @@
 # Usage: tpu_train_watch.sh [duration_s] [period_s]
 set -u
 cd "$(dirname "$0")/.."
+# single-instance guard: two copies would double-write TPU_TRAIN_PROBE.jsonl
+# and race the same training workdir/output
+exec 9>/tmp/tpu_train_watch.lock
+if ! flock -n 9; then
+    echo "[tpu_train_watch] another instance holds the lock; exiting"
+    exit 1
+fi
 DURATION="${1:-36000}"
 PERIOD="${2:-600}"
 END=$(( $(date +%s) + DURATION ))

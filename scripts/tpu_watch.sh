@@ -12,6 +12,13 @@
 # Usage: tpu_watch.sh [duration_s] [period_s]
 set -u
 cd "$(dirname "$0")/.."
+# single-instance guard: two copies would double-write TPU_PROBE.jsonl (the
+# committed availability record) and race bench/test artifact writes
+exec 9>/tmp/tpu_watch.lock
+if ! flock -n 9; then
+    echo "[tpu_watch] another instance holds the lock; exiting"
+    exit 1
+fi
 
 DURATION="${1:-39600}"   # default 11h
 PERIOD="${2:-540}"       # default 9 min

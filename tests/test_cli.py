@@ -132,7 +132,8 @@ def test_packaging_entry_points_resolve():
         meta = tomllib.load(f)
     scripts = meta["project"]["scripts"]
     assert set(scripts) == {
-        "mgproto-train", "mgproto-eval", "mgproto-interpret", "mgproto-prep"
+        "mgproto-train", "mgproto-eval", "mgproto-interpret", "mgproto-prep",
+        "mgproto-export",
     }
     for target in scripts.values():
         mod_name, fn_name = target.split(":")

@@ -127,3 +127,14 @@ def test_full_train_step_runs_on_device():
         state, imgs, labels, use_mine=True, update_gmm=True, warm=False
     )
     assert np.isfinite(float(jax.device_get(m.loss)))
+
+
+@requires_tpu
+def test_fused_scoring_auto_resolves_on_tpu():
+    """fused_scoring=None must pick the Pallas path on a real TPU backend
+    (config.py:ModelConfig.fused_scoring; the CPU-side half of this contract
+    lives in tests/test_fused_scoring.py::test_fused_scoring_auto_resolution)."""
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+
+    assert Trainer(tiny_test_config(), steps_per_epoch=1)._fused is True
