@@ -171,9 +171,14 @@ def flops_from_cost_analysis(compiled, strict: bool = False):
     return None
 
 
-def run_config(fused: bool) -> dict:
+def run_config(fused: bool, eval_mode: bool = False) -> dict:
     """Steady-state throughput for one scoring path. Returns
-    {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}."""
+    {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}.
+
+    eval_mode=True times the INFERENCE step instead (forward + mixture
+    logits + log p(x), no losses/backward/EM — what a serving host runs,
+    incl. via an engine/export.py artifact). Not part of the driver-contract
+    plan; measure ad hoc with `python bench.py --measure eval_fused 256`."""
     if os.environ.get("BENCH_FAIL_INJECT"):
         # deterministic, instant child failure for the contract tests: fires
         # before any jax/model work so the retry ladder is cheap to exercise
@@ -212,6 +217,31 @@ def run_config(fused: bool) -> dict:
         host.rand(BATCH, cfg.model.img_size, cfg.model.img_size, 3),
         jnp.float32,
     )
+
+    if eval_mode:
+        eval_compiled = trainer._eval_step.lower(state, images, None).compile()
+        eval_flops = flops_from_cost_analysis(eval_compiled)
+
+        def eval_step():
+            return eval_compiled(state, images, None)
+
+        out = None
+        for _ in range(max(WARMUP, 1)):
+            out = eval_step()
+        float(jax.device_get(out.log_px[0]))
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = eval_step()
+        float(jax.device_get(out.log_px[0]))
+        dt = time.perf_counter() - t0
+        return {
+            "imgs_per_sec": BATCH * ITERS / dt,
+            "step_time_s": dt / ITERS,
+            "flops_per_step": eval_flops,
+            "device_kind": jax.devices()[0].device_kind,
+            "batch": BATCH,
+        }
+
     labels = jnp.asarray(
         host.randint(0, cfg.model.num_classes, size=(BATCH,)), jnp.int32
     )
@@ -511,6 +541,13 @@ if __name__ == "__main__":
         # entry); BENCH_BATCH env still works for plain 2-operand calls.
         if len(sys.argv) == 4:
             BATCH = int(sys.argv[3])
-        print(json.dumps(run_config(fused=sys.argv[2].startswith("fused"))))
+        measure = sys.argv[2]
+        valid = ("unfused", "fused", "eval_unfused", "eval_fused")
+        if measure not in valid:
+            raise SystemExit(f"--measure must be one of {valid}, got {measure!r}")
+        print(json.dumps(run_config(
+            fused=measure in ("fused", "eval_fused"),
+            eval_mode=measure.startswith("eval"),
+        )))
     else:
         main()
