@@ -198,20 +198,6 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
     trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
-    # steady state: all class queues full + touched, so EM is fully active
-    mem = state.memory
-    rng = jax.random.PRNGKey(1)
-    feats = jax.random.uniform(rng, mem.feats.shape, jnp.float32)
-    feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
-    state = state.replace(
-        memory=mem._replace(
-            feats=feats,
-            length=jnp.full_like(mem.length, mem.capacity),
-            cursor=jnp.zeros_like(mem.cursor),
-            updated=jnp.ones_like(mem.updated),
-        )
-    )
-
     host = np.random.RandomState(0)
     images = jnp.asarray(
         host.rand(BATCH, cfg.model.img_size, cfg.model.img_size, 3),
@@ -219,6 +205,8 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
     )
 
     if eval_mode:
+        # inference reads only params/batch_stats/gmm — the steady-state
+        # memory fill below is train-path-only and deliberately skipped
         eval_compiled = trainer._eval_step.lower(state, images, None).compile()
         eval_flops = flops_from_cost_analysis(eval_compiled)
 
@@ -228,6 +216,8 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
         out = None
         for _ in range(max(WARMUP, 1)):
             out = eval_step()
+        # sync via host readback — same load-bearing caveat as the train
+        # loop's sync point below (tunneled platforms + block_until_ready)
         float(jax.device_get(out.log_px[0]))
         t0 = time.perf_counter()
         for _ in range(ITERS):
@@ -241,6 +231,20 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
             "device_kind": jax.devices()[0].device_kind,
             "batch": BATCH,
         }
+
+    # steady state: all class queues full + touched, so EM is fully active
+    mem = state.memory
+    rng = jax.random.PRNGKey(1)
+    feats = jax.random.uniform(rng, mem.feats.shape, jnp.float32)
+    feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+    state = state.replace(
+        memory=mem._replace(
+            feats=feats,
+            length=jnp.full_like(mem.length, mem.capacity),
+            cursor=jnp.zeros_like(mem.cursor),
+            updated=jnp.ones_like(mem.updated),
+        )
+    )
 
     labels = jnp.asarray(
         host.randint(0, cfg.model.num_classes, size=(BATCH,)), jnp.int32
@@ -541,6 +545,8 @@ if __name__ == "__main__":
         # entry); BENCH_BATCH env still works for plain 2-operand calls.
         if len(sys.argv) == 4:
             BATCH = int(sys.argv[3])
+        if BATCH <= 0:
+            raise SystemExit(f"batch must be > 0, got {BATCH}")
         measure = sys.argv[2]
         valid = ("unfused", "fused", "eval_unfused", "eval_fused")
         if measure not in valid:

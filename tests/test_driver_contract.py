@@ -244,3 +244,27 @@ def test_bench_rejects_non_numeric_env_with_json_diagnostic():
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert "BENCH_ITERS" in out["error"] and "not an integer" in out["error"]
     assert out["attempts"] == 0
+
+
+def test_bench_child_eval_measure_mode():
+    """`bench.py --measure eval_unfused <batch>` (the ad-hoc inference
+    measurement) must emit one JSON line with a positive throughput, and an
+    unknown measure name must fail fast instead of silently measuring."""
+    env = _driver_env()
+    env.update(BENCH_WARMUP="0", BENCH_ITERS="1")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py"),
+         "--measure", "eval_unfused", "4"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stderr or proc.stdout)[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["imgs_per_sec"] > 0 and out["batch"] == 4
+
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--measure", "refused", "4"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert bad.returncode != 0
+    assert "must be one of" in (bad.stderr + bad.stdout)
