@@ -12,6 +12,7 @@ runs via `jax.export.deserialize` alone. See engine/export.py.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 from typing import Optional
@@ -56,8 +57,6 @@ def main(argv: Optional[list] = None) -> None:
     # the exported program always uses the portable XLA scoring path
     # (engine/export.py); forcing it here avoids constructing a fused-path
     # Trainer on TPU hosts only for export_eval to rebuild a portable one
-    import dataclasses
-
     cfg = cfg.replace(
         model=dataclasses.replace(cfg.model, fused_scoring=False)
     )

@@ -22,6 +22,7 @@ Artifact layout: a single zip (conventionally `*.mgproto`) holding
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import zipfile
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -29,6 +30,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import export as jax_export
+
+from mgproto_tpu.engine.train import Trainer
 
 _BLOB_NAME = "model.stablehlo"
 _META_NAME = "meta.json"
@@ -48,14 +51,10 @@ def export_eval(trainer, state, dynamic_batch: bool = True,
     multi-platform lowering — without it jax.export pins the artifact to the
     EXPORTING machine's backend, so a TPU-side export could not serve on a
     CPU host (the exact portability this feature promises)."""
-    from mgproto_tpu.engine.train import Trainer
-
     cfg = trainer.cfg
     if trainer._fused:
         # re-resolve on a plain Trainer with the portable path forced; the
         # SAME cfg/state produce identical numerics on the XLA path
-        import dataclasses
-
         portable = cfg.replace(
             model=dataclasses.replace(cfg.model, fused_scoring=False)
         )
