@@ -391,6 +391,12 @@ def main() -> None:
         "protos_per_class": args.protos,
         "mem_capacity": args.mem_capacity,
         "proto_dim": args.proto_dim,
+        # sharding provenance: mesh_model>1 means GMM/memory/EM trained
+        # class-sharded over the 'model' axis (the ImageNet-1000 stretch
+        # layout, SURVEY.md §2.3); cpu_devices=0 means the real TPU backend
+        "cpu_devices": args.cpu_devices,
+        "mesh_data": args.mesh_data,
+        "mesh_model": args.mesh_model,
         "chance_accuracy": 1.0 / args.classes,
         # queue-fill + EM-width evidence: first epoch where EVERY class queue
         # is full, and the max classes EM updated in one step
