@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# Single orchestrator for a TPU relay window. Replaces running tpu_watch.sh
+# and tpu_train_watch.sh concurrently (both would fire on the same window
+# and contend for the one chip, skewing the bench numbers).
+#
+# On each successful probe, runs IN ORDER, each at most once per watcher
+# lifetime, re-probing between stages so a relay drop mid-window skips
+# cleanly to the next window:
+#   1. bench.py                  -> BENCH_PROBE_RUN.json  (timed: needs a
+#                                    quiet chip, so it goes first)
+#   2. real-TPU execution tests  -> TPU_TESTS_RUN.txt
+#   3. inference measurements    -> BENCH_EVAL_RUN.json (eval_fused b256/b80)
+#   4. end-to-end training run   -> evidence/tpu_e2e (bf16, auto-fused,
+#                                    profiler trace; the long stage, last)
+#
+# Usage: tpu_window.sh [duration_s] [period_s]
+set -u
+cd "$(dirname "$0")/.."
+# take ALL THREE watcher locks: this script replaces tpu_watch.sh and
+# tpu_train_watch.sh, and must refuse to start while either still runs
+# (three probers on one chip is the contention this script eliminates)
+exec 9>/tmp/tpu_window.lock 8>/tmp/tpu_watch.lock 7>/tmp/tpu_train_watch.lock
+for fd in 9 8 7; do
+    if ! flock -n "$fd"; then
+        echo "[tpu_window] another watcher holds lock fd=$fd; exiting"
+        exit 1
+    fi
+done
+DURATION="${1:-21600}"
+PERIOD="${2:-540}"
+END=$(( $(date +%s) + DURATION ))
+BENCH_DONE=0; TESTS_DONE=0; EVAL_DONE=0; TRAIN_DONE=0
+OUT=evidence/tpu_e2e
+
+# the main loop probe feeds the committed availability record; stage-guard
+# re-probes (between long stages) go to their own file so they don't inflate
+# the record's sampling density
+probe() { python scripts/tpu_probe.py --timeout 75 --quiet --log TPU_PROBE.jsonl; }
+guard() { python scripts/tpu_probe.py --timeout 75 --quiet --log TPU_WINDOW_GUARD.jsonl; }
+
+echo "[tpu_window] start $(date -Is) duration=${DURATION}s period=${PERIOD}s"
+while [ "$(date +%s)" -lt "$END" ]; do
+    if probe; then
+        echo "[tpu_window] $(date -Is) probe OK"
+        if [ "$BENCH_DONE" -eq 0 ]; then
+            echo "[tpu_window] stage 1: bench.py"
+            # write to .tmp, promote only after validation: a truncated
+            # retry must never clobber previously captured good evidence
+            BENCH_SKIP_PROBE=1 timeout 2500 python bench.py \
+                > BENCH_PROBE_RUN.json.tmp 2> BENCH_PROBE_RUN.err \
+                && grep -q '"unit"' BENCH_PROBE_RUN.json.tmp \
+                && mv BENCH_PROBE_RUN.json.tmp BENCH_PROBE_RUN.json \
+                && BENCH_DONE=1 && echo "[tpu_window] bench OK"
+        fi
+        if [ "$TESTS_DONE" -eq 0 ] && guard; then
+            echo "[tpu_window] stage 2: on-hardware tests"
+            MGPROTO_TEST_TPU=1 timeout 1800 python -m pytest \
+                tests/test_tpu_execution.py -q > TPU_TESTS_RUN.txt.tmp 2>&1 \
+                && mv TPU_TESTS_RUN.txt.tmp TPU_TESTS_RUN.txt \
+                && TESTS_DONE=1 && echo "[tpu_window] TPU tests OK"
+        fi
+        if [ "$EVAL_DONE" -eq 0 ] && guard; then
+            echo "[tpu_window] stage 3: inference measurements"
+            {
+                echo -n '{"eval_fused_b256": '
+                timeout 500 python -u bench.py --measure eval_fused 256 \
+                    2>/dev/null | tail -1
+                echo -n ', "eval_fused_b80": '
+                timeout 500 python -u bench.py --measure eval_fused 80 \
+                    2>/dev/null | tail -1
+                echo '}'
+            } > BENCH_EVAL_RUN.json.tmp
+            python -c "import json; json.load(open('BENCH_EVAL_RUN.json.tmp'))" \
+                && mv BENCH_EVAL_RUN.json.tmp BENCH_EVAL_RUN.json \
+                && EVAL_DONE=1 && echo "[tpu_window] eval measurements OK"
+        fi
+        if [ "$TRAIN_DONE" -eq 0 ] && guard; then
+            echo "[tpu_window] stage 4: end-to-end training run"
+            if timeout 3000 python scripts/synthetic_convergence.py \
+                --out "$OUT" --workdir /tmp/mgproto_tpu_e2e \
+                --classes 50 --per_class 20 --test_per_class 6 --epochs 12 \
+                --batch 32 --protos 10 --proto_dim 64 --mem_capacity 100 \
+                --arch resnet18 --compute_dtype bfloat16 --cpu_devices 0 \
+                --target_accu 0.05 --profile_dir "$OUT/trace" \
+                && [ -f "$OUT/summary.json" ]; then
+                TRAIN_DONE=1
+                echo "[tpu_window] TPU training run OK -> $OUT"
+            fi
+        fi
+        if [ "$BENCH_DONE$TESTS_DONE$EVAL_DONE$TRAIN_DONE" = "1111" ]; then
+            echo "[tpu_window] all stages complete $(date -Is)"
+            PERIOD=1800  # availability heartbeat only
+        fi
+    else
+        echo "[tpu_window] $(date -Is) probe failed (relay down)"
+    fi
+    sleep "$PERIOD"
+done
+echo "[tpu_window] end $(date -Is) bench=$BENCH_DONE tests=$TESTS_DONE eval=$EVAL_DONE train=$TRAIN_DONE"
