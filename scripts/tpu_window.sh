@@ -94,6 +94,8 @@ while [ "$(date +%s)" -lt "$END" ]; do
     else
         echo "[tpu_window] $(date -Is) probe failed (relay down)"
     fi
-    sleep "$PERIOD"
+    # close the lock fds for the sleep child: an orphaned sleep must not
+    # keep holding the watcher locks after this script is killed
+    sleep "$PERIOD" 9>&- 8>&- 7>&-
 done
 echo "[tpu_window] end $(date -Is) bench=$BENCH_DONE tests=$TESTS_DONE eval=$EVAL_DONE train=$TRAIN_DONE"
