@@ -188,12 +188,30 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
         # bounded sleep so an orphaned child cannot linger past the test
         time.sleep(_env_int("BENCH_HANG_INJECT_S", 120))
         raise RuntimeError("BENCH_HANG_INJECT: child should have been killed")
+    t_birth = time.perf_counter()
+
+    def _phase(name: str) -> None:
+        # flushed per-phase breadcrumbs (child stdout): when an outer timeout
+        # kills this child (the r4 batch-512 DNF was never diagnosed because
+        # the child died silently), the captured partial output pinpoints
+        # which phase — trace, XLA compile, or execute — ate the window. The
+        # parent's robust_measure only reads the LAST stdout line of an
+        # rc==0 child, so these extra lines never contaminate the result.
+        _emit({
+            "error": f"in progress; killed during child phase {name!r}",
+            "event": "child_phase",
+            "phase": name,
+            "elapsed_s": round(time.perf_counter() - t_birth, 1),
+        })
+
+    _phase("import_jax")
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from mgproto_tpu.engine.train import Trainer
 
+    _phase("init_model")
     cfg = flagship_config(fused)
     trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
     state = trainer.init_state(jax.random.PRNGKey(0))
@@ -207,7 +225,9 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
     if eval_mode:
         # inference reads only params/batch_stats/gmm — the steady-state
         # memory fill below is train-path-only and deliberately skipped
+        t_c0 = time.perf_counter()
         eval_compiled = trainer._eval_step.lower(state, images, None).compile()
+        eval_compile_s = time.perf_counter() - t_c0
         eval_flops = flops_from_cost_analysis(eval_compiled)
 
         def eval_step():
@@ -225,8 +245,14 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
         float(jax.device_get(out.log_px[0]))
         dt = time.perf_counter() - t0
         return {
+            # "mode" disambiguates this line from a train-step number when it
+            # is read out of file context (ADVICE r4: the two were
+            # shape-identical and only distinguishable by which file wrapped
+            # them)
+            "mode": "eval",
             "imgs_per_sec": BATCH * ITERS / dt,
             "step_time_s": dt / ITERS,
+            "compile_s": round(eval_compile_s, 2),
             "flops_per_step": eval_flops,
             "device_kind": jax.devices()[0].device_kind,
             "batch": BATCH,
@@ -255,9 +281,14 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
     # lower().compile() with trainer.train_step would compile twice).
     use_mine_arr = jnp.asarray(1.0, jnp.float32)
     update_gmm_arr = jnp.asarray(True, bool)
-    compiled = trainer._train_step.lower(
+    _phase("trace_lower")
+    lowered = trainer._train_step.lower(
         state, images, labels, use_mine_arr, update_gmm_arr, warm=False
-    ).compile()
+    )
+    _phase("xla_compile")
+    t_c0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t_c0
 
     flops = flops_from_cost_analysis(compiled)  # best-effort: some PJRT
     # plugins return no cost model; MFU is then simply omitted
@@ -274,19 +305,30 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
     # tunneled device platforms block_until_ready can return before the device
     # actually finishes, which inflates throughput ~1000x.
     metrics = None
+    _phase("warmup_execute")
     for _ in range(max(WARMUP, 1)):  # >=1: the sync below needs a metrics
         state, metrics = step(state)
     float(jax.device_get(metrics.loss))
 
+    _phase("timed_loop")
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:
+        # wrap ONLY the timed loop: the trace then contains exactly ITERS
+        # steady-state steps — the artifact the MFU-headroom analysis reads
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         state, metrics = step(state)
     float(jax.device_get(metrics.loss))
     int(jax.device_get(state.step))
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
     return {
+        "mode": "train",
         "imgs_per_sec": BATCH * ITERS / dt,
         "step_time_s": dt / ITERS,
+        "compile_s": round(compile_s, 2),
         "flops_per_step": flops,
         "device_kind": jax.devices()[0].device_kind,
         "batch": BATCH,
@@ -388,7 +430,13 @@ def _summary(results: dict, errors: dict, attempts_total: int,
     throughput-optimal batch entry is reported via its own keys only
     (fused_b<N>_imgs_per_sec, best_batch*)."""
     reference = {k: v for k, v in results.items()
-                 if k in ("unfused", "fused")} or results
+                 if k in ("unfused", "fused")}
+    # if BOTH reference-batch paths failed but a bonus measurement (e.g.
+    # fused_b256) succeeded, fall back to it so the line still carries a real
+    # number — but flag it: vs_baseline is then NOT apples-to-apples with the
+    # batch-80 A100 estimate (ADVICE r4: winner_batch alone was easy to miss)
+    headline_degraded = not reference
+    reference = reference or results
     winner = max(reference, key=lambda k: reference[k]["imgs_per_sec"])
     best = results[winner]
     value = best["imgs_per_sec"]
@@ -415,6 +463,8 @@ def _summary(results: dict, errors: dict, attempts_total: int,
         "north_star_frac_per_chip": round(value / NORTH_STAR_PER_CHIP, 3),
         "attempts": attempts_total,
     }
+    if headline_degraded:
+        out["headline_degraded"] = True
     for name, r in results.items():
         if name not in ("unfused", "fused"):
             out[f"{name}_imgs_per_sec"] = round(r["imgs_per_sec"], 2)
@@ -431,6 +481,97 @@ def _summary(results: dict, errors: dict, attempts_total: int,
     if errors:
         out["errors"] = errors
     return out
+
+
+# Watcher-captured artifacts that may hold a real on-hardware measurement
+# from an earlier relay window (written by scripts/tpu_window.sh stage 1).
+# The newest parseable result line across them wins. Env-overridable
+# (colon-separated; empty string disables) so the failure-contract tests can
+# exercise the no-cache path from a repo that does contain the artifact.
+_raw_cached = os.environ.get("BENCH_CACHED_SOURCES")
+CACHED_SOURCES = tuple(
+    s for s in (
+        _raw_cached.split(":") if _raw_cached is not None
+        else ["BENCH_PROBE_RUN.json"]
+    ) if s
+)
+_BENCH_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _cached_result() -> dict | None:
+    """Most recent watcher-captured on-hardware result, or None.
+
+    VERDICT r4 item 1: for four rounds the driver-window artifact came up
+    empty whenever the relay happened to be down at driver time, while the
+    SAME round's real measurement sat in BENCH_PROBE_RUN.json captured hours
+    earlier by the window watcher. When the live probe gate fails, bench now
+    emits that measurement as the final line — explicitly labeled, so cached
+    is never presentable as live:
+
+      {"cached": true, "measured_at": ..., "source": ..., ...result keys}
+
+    The live attempt always comes first (probe diagnostics precede this), and
+    a cached line is only emitted when no live number could be produced."""
+    best = None
+    for path in CACHED_SOURCES:
+        full = os.path.join(_BENCH_DIR, path)
+        try:
+            with open(full) as f:
+                lines = f.read().strip().splitlines()
+        except OSError:
+            continue
+        measured_at = None
+        result = None
+        for line in lines:
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("event") == "start" and obj.get("ts"):
+                measured_at = obj["ts"]
+            if obj.get("unit") and obj.get("value") is not None:
+                result = obj  # last full/partial result line wins
+        if result is None:
+            continue
+        if measured_at is None:
+            measured_at = time.strftime(
+                "%Y-%m-%dT%H:%M:%S%z",
+                time.localtime(os.path.getmtime(full)),
+            )
+        cand = dict(result)
+        cand.update(cached=True, measured_at=measured_at, source=path)
+        if best is None or _ts_key(cand["measured_at"]) > _ts_key(
+                best["measured_at"]):
+            best = cand
+    return best
+
+
+def _ts_key(ts) -> tuple:
+    """Epoch-based sort key for an ISO-8601 %z timestamp; string fallback.
+    Plain string comparison mis-orders stamps with different UTC offsets
+    (the mtime fallback stamps local time) — normalize to epoch first."""
+    try:
+        import calendar
+        st = time.strptime(str(ts), "%Y-%m-%dT%H:%M:%S%z")
+        return (0, calendar.timegm(st) - (st.tm_gmtoff or 0), "")
+    except (ValueError, TypeError):
+        # unparseable stamps sort BEFORE any parsed one (they lose),
+        # comparing among themselves as strings
+        return (-1, 0, str(ts))
+
+
+def _fail(error_obj: dict) -> None:
+    """Terminal failure path: emit the live diagnostics, then — if a watcher
+    window ever captured a real number — the cached result as the final line
+    so the driver artifact is never numberless when a genuine number exists.
+    Exit 0 iff a (cached) number was emitted."""
+    cached = _cached_result()
+    if cached is None:
+        _emit(error_obj)
+        raise SystemExit(1)
+    cached["live_error"] = error_obj.get("error")
+    _emit(cached)
+    raise SystemExit(0)
 
 
 def _probe_gate() -> bool:
@@ -487,7 +628,7 @@ def main() -> None:
         raise SystemExit(1)
 
     if not _probe_gate():
-        _emit({
+        _fail({
             "error": (
                 "backend unreachable: a tiny-jit child probe failed "
                 f"{PROBE_ATTEMPTS}x within {PROBE_TIMEOUT_S}s each — relay "
@@ -497,7 +638,6 @@ def main() -> None:
             "attempts": 0,
             "errors": {"probe": "see probe event lines above"},
         })
-        raise SystemExit(1)
 
     plan = [("unfused", False, BATCH), ("fused", True, BATCH)]
     if BEST_BATCH > 0 and BEST_BATCH != BATCH:
@@ -530,12 +670,11 @@ def main() -> None:
             _emit(partial_line)
 
     if not results:
-        _emit({
+        _fail({
             "error": "all scoring paths failed after retries",
             "attempts": attempts_total,
             "errors": errors,
         })
-        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -2,7 +2,7 @@
 
 Thin wrapper over `mgproto_tpu.probe.probe_once` that appends each probe
 record as ONE timestamped JSON line to TPU_PROBE.jsonl at the repo root, so a
-round of probes (driven by scripts/tpu_watch.sh) is a machine-readable record
+round of probes (driven by scripts/tpu_window.sh) is a machine-readable record
 of when — if ever — the relay was reachable:
 
     {"ts": "...", "ok": true,  "elapsed_s": 31.2, "device_kind": "...", ...}

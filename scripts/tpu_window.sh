@@ -3,14 +3,20 @@
 # and tpu_train_watch.sh concurrently (both would fire on the same window
 # and contend for the one chip, skewing the bench numbers).
 #
-# On each successful probe, runs IN ORDER, each at most once per watcher
-# lifetime, re-probing between stages so a relay drop mid-window skips
-# cleanly to the next window:
+# On each successful probe, runs IN ORDER (VERDICT r4 item 8 priority),
+# each at most once per watcher lifetime, re-probing between stages so a
+# relay drop mid-window skips cleanly to the next window:
 #   1. bench.py                  -> BENCH_PROBE_RUN.json  (timed: needs a
 #                                    quiet chip, so it goes first)
-#   2. real-TPU execution tests  -> TPU_TESTS_RUN.txt
-#   3. inference measurements    -> BENCH_EVAL_RUN.json (eval_fused b256/b80)
-#   4. end-to-end training run   -> evidence/tpu_e2e (bf16, auto-fused,
+#   2. batch-512 diagnosis       -> BENCH_B512_DIAG.json (r4 DNF: phase
+#                                    breadcrumbs split compile vs execute)
+#   3. real-TPU execution tests  -> TPU_TESTS_RUN.txt
+#   4. profiler trace @ b256     -> BENCH_TRACE_RUN.json + evidence/
+#                                    tpu_trace_b256/ (MFU headroom evidence)
+#   5. inference measurements    -> BENCH_EVAL_RUN.json (eval_fused b256/b80,
+#                                    validated per measurement — a half-
+#                                    successful window keeps its half)
+#   6. end-to-end training run   -> evidence/tpu_e2e (bf16, auto-fused,
 #                                    profiler trace; the long stage, last)
 #
 # Usage: tpu_window.sh [duration_s] [period_s]
@@ -29,14 +35,46 @@ done
 DURATION="${1:-21600}"
 PERIOD="${2:-540}"
 END=$(( $(date +%s) + DURATION ))
-BENCH_DONE=0; TESTS_DONE=0; EVAL_DONE=0; TRAIN_DONE=0
+BENCH_DONE=0; B512_DONE=0; TESTS_DONE=0; TRACE_DONE=0; TRAIN_DONE=0
+EVAL_B256_DONE=0; EVAL_B80_DONE=0
 OUT=evidence/tpu_e2e
+TRACE_OUT=evidence/tpu_trace_b256
 
 # the main loop probe feeds the committed availability record; stage-guard
 # re-probes (between long stages) go to their own file so they don't inflate
 # the record's sampling density
 probe() { python scripts/tpu_probe.py --timeout 75 --quiet --log TPU_PROBE.jsonl; }
 guard() { python scripts/tpu_probe.py --timeout 75 --quiet --log TPU_WINDOW_GUARD.jsonl; }
+
+# one eval measurement -> its own validated .tmp; BENCH_EVAL_RUN.json is
+# reassembled from every part that has EVER succeeded, so a half-successful
+# window keeps its half and only the missing part reruns next window
+# (ADVICE r4: the old one-shot two-child heredoc discarded both on any miss)
+eval_measure() {  # $1 = batch
+    timeout 500 env BENCH_WARMUP=2 BENCH_ITERS=10 \
+        python -u bench.py --measure eval_fused "$1" \
+        > "BENCH_EVAL_b$1.json.tmp" 2>/dev/null \
+        && python -c "
+import json, sys
+last = open('BENCH_EVAL_b$1.json.tmp').read().strip().splitlines()[-1]
+assert json.loads(last)['imgs_per_sec'] > 0
+open('BENCH_EVAL_b$1.json', 'w').write(last + '\n')
+" && rm -f "BENCH_EVAL_b$1.json.tmp"
+}
+
+assemble_eval() {
+    python -c "
+import json, os
+parts = {}
+for b in (256, 80):
+    p = f'BENCH_EVAL_b{b}.json'
+    if os.path.exists(p):
+        parts[f'eval_fused_b{b}'] = json.loads(open(p).read())
+if parts:
+    with open('BENCH_EVAL_RUN.json', 'w') as f:
+        json.dump(parts, f)
+"
+}
 
 echo "[tpu_window] start $(date -Is) duration=${DURATION}s period=${PERIOD}s"
 while [ "$(date +%s)" -lt "$END" ]; do
@@ -45,37 +83,82 @@ while [ "$(date +%s)" -lt "$END" ]; do
         if [ "$BENCH_DONE" -eq 0 ]; then
             echo "[tpu_window] stage 1: bench.py"
             # write to .tmp, promote only after validation: a truncated
-            # retry must never clobber previously captured good evidence
-            BENCH_SKIP_PROBE=1 timeout 2500 python bench.py \
+            # retry must never clobber previously captured good evidence.
+            # BENCH_CACHED_SOURCES= : a window capture must be LIVE — the
+            # cached-fallback path would otherwise let bench re-emit this
+            # very file's old number and we'd promote it as a fresh capture
+            BENCH_SKIP_PROBE=1 BENCH_CACHED_SOURCES= timeout 2500 \
+                python bench.py \
                 > BENCH_PROBE_RUN.json.tmp 2> BENCH_PROBE_RUN.err \
                 && grep -q '"unit"' BENCH_PROBE_RUN.json.tmp \
+                && ! grep -q '"cached": true' BENCH_PROBE_RUN.json.tmp \
                 && mv BENCH_PROBE_RUN.json.tmp BENCH_PROBE_RUN.json \
                 && BENCH_DONE=1 && echo "[tpu_window] bench OK"
+            rm -f BENCH_PROBE_RUN.json.tmp  # no stale half-output lingers
+        fi
+        if [ "$B512_DONE" -eq 0 ] && guard; then
+            echo "[tpu_window] stage 2: batch-512 diagnosis"
+            # the r4 sweep's 512 point died silently in a 500s window; the
+            # child's flushed phase breadcrumbs (trace_lower / xla_compile /
+            # warmup_execute / timed_loop + compile_s in the result) make
+            # even a timeout a diagnosis, so the captured output is promoted
+            # whether or not the run finished
+            BENCH_WARMUP=1 BENCH_ITERS=10 timeout 1500 \
+                python -u bench.py --measure fused 512 \
+                > BENCH_B512_DIAG.json.tmp 2> BENCH_B512_DIAG.err
+            # a capture that reached a b512-specific phase (tracing onward —
+            # dying at trace_lower after 1500s IS a diagnosis: tracing ate
+            # the window) is promoted and ends the stage. A shallow capture
+            # (died at import_jax/init_model = relay hang, answers nothing)
+            # is kept only when no prior evidence exists, and the stage
+            # stays retryable — it must never clobber a deep diagnosis from
+            # an earlier watcher lifetime
+            DEEP='"phase": "(trace_lower|xla_compile|warmup_execute|timed_loop)"|"imgs_per_sec"'
+            if [ -s BENCH_B512_DIAG.json.tmp ]; then
+                if grep -qE "$DEEP" BENCH_B512_DIAG.json.tmp; then
+                    mv BENCH_B512_DIAG.json.tmp BENCH_B512_DIAG.json
+                    B512_DONE=1 && echo "[tpu_window] b512 diagnosis captured"
+                elif [ ! -f BENCH_B512_DIAG.json ]; then
+                    mv BENCH_B512_DIAG.json.tmp BENCH_B512_DIAG.json
+                    echo "[tpu_window] b512 capture too shallow; will retry"
+                fi
+            fi
+            rm -f BENCH_B512_DIAG.json.tmp
         fi
         if [ "$TESTS_DONE" -eq 0 ] && guard; then
-            echo "[tpu_window] stage 2: on-hardware tests"
+            echo "[tpu_window] stage 3: on-hardware tests"
             MGPROTO_TEST_TPU=1 timeout 1800 python -m pytest \
                 tests/test_tpu_execution.py -q > TPU_TESTS_RUN.txt.tmp 2>&1 \
                 && mv TPU_TESTS_RUN.txt.tmp TPU_TESTS_RUN.txt \
                 && TESTS_DONE=1 && echo "[tpu_window] TPU tests OK"
+            rm -f TPU_TESTS_RUN.txt.tmp
         fi
-        if [ "$EVAL_DONE" -eq 0 ] && guard; then
-            echo "[tpu_window] stage 3: inference measurements"
-            {
-                echo -n '{"eval_fused_b256": '
-                timeout 500 python -u bench.py --measure eval_fused 256 \
-                    2>/dev/null | tail -1
-                echo -n ', "eval_fused_b80": '
-                timeout 500 python -u bench.py --measure eval_fused 80 \
-                    2>/dev/null | tail -1
-                echo '}'
-            } > BENCH_EVAL_RUN.json.tmp
-            python -c "import json; json.load(open('BENCH_EVAL_RUN.json.tmp'))" \
-                && mv BENCH_EVAL_RUN.json.tmp BENCH_EVAL_RUN.json \
-                && EVAL_DONE=1 && echo "[tpu_window] eval measurements OK"
+        if [ "$TRACE_DONE" -eq 0 ] && guard; then
+            echo "[tpu_window] stage 4: profiler trace @ b256"
+            BENCH_PROFILE_DIR="$TRACE_OUT" BENCH_WARMUP=2 BENCH_ITERS=10 \
+                timeout 900 python -u bench.py --measure fused 256 \
+                > BENCH_TRACE_RUN.json.tmp 2> BENCH_TRACE_RUN.err \
+                && python -c "
+import json
+last = open('BENCH_TRACE_RUN.json.tmp').read().strip().splitlines()[-1]
+assert json.loads(last)['imgs_per_sec'] > 0
+" \
+                && mv BENCH_TRACE_RUN.json.tmp BENCH_TRACE_RUN.json \
+                && TRACE_DONE=1 && echo "[tpu_window] trace OK -> $TRACE_OUT"
+            rm -f BENCH_TRACE_RUN.json.tmp
+        fi
+        if [ "$EVAL_B256_DONE" -eq 0 ] && guard; then
+            echo "[tpu_window] stage 5a: eval_fused b256"
+            eval_measure 256 && EVAL_B256_DONE=1 && assemble_eval
+            rm -f BENCH_EVAL_b256.json.tmp
+        fi
+        if [ "$EVAL_B80_DONE" -eq 0 ] && guard; then
+            echo "[tpu_window] stage 5b: eval_fused b80"
+            eval_measure 80 && EVAL_B80_DONE=1 && assemble_eval
+            rm -f BENCH_EVAL_b80.json.tmp
         fi
         if [ "$TRAIN_DONE" -eq 0 ] && guard; then
-            echo "[tpu_window] stage 4: end-to-end training run"
+            echo "[tpu_window] stage 6: end-to-end training run"
             if timeout 3000 python scripts/synthetic_convergence.py \
                 --out "$OUT" --workdir /tmp/mgproto_tpu_e2e \
                 --classes 50 --per_class 20 --test_per_class 6 --epochs 12 \
@@ -87,7 +170,8 @@ while [ "$(date +%s)" -lt "$END" ]; do
                 echo "[tpu_window] TPU training run OK -> $OUT"
             fi
         fi
-        if [ "$BENCH_DONE$TESTS_DONE$EVAL_DONE$TRAIN_DONE" = "1111" ]; then
+        ALL="$BENCH_DONE$B512_DONE$TESTS_DONE$TRACE_DONE$EVAL_B256_DONE$EVAL_B80_DONE$TRAIN_DONE"
+        if [ "$ALL" = "1111111" ]; then
             echo "[tpu_window] all stages complete $(date -Is)"
             PERIOD=1800  # availability heartbeat only
         fi
@@ -98,4 +182,4 @@ while [ "$(date +%s)" -lt "$END" ]; do
     # keep holding the watcher locks after this script is killed
     sleep "$PERIOD" 9>&- 8>&- 7>&-
 done
-echo "[tpu_window] end $(date -Is) bench=$BENCH_DONE tests=$TESTS_DONE eval=$EVAL_DONE train=$TRAIN_DONE"
+echo "[tpu_window] end $(date -Is) bench=$BENCH_DONE b512=$B512_DONE tests=$TESTS_DONE trace=$TRACE_DONE eval=$EVAL_B256_DONE$EVAL_B80_DONE train=$TRAIN_DONE"
