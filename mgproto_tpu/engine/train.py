@@ -67,10 +67,14 @@ class Trainer:
         # fused_scoring=None resolves per backend: the Pallas kernel measured
         # 1.9x faster than the XLA path on real TPU (BENCH_PROBE_RUN.json)
         # so TPU defaults to it; CPU/GPU fall back to the XLA path (the
-        # interpret-mode kernel is correct but slow). ShardedTrainer further
-        # constrains auto-resolution (a pallas_call cannot be partitioned
-        # over a sharded class axis). Explicit True/False is always honored.
+        # interpret-mode kernel is correct but slow). On class-sharded meshes
+        # ShardedTrainer keeps the kernel via shard_map (_score_mesh below),
+        # dropping to the XLA path only when num_classes cannot shard over
+        # the model axis. Explicit True/False is always honored.
         self._fused = self._resolve_fused(cfg.model.fused_scoring)
+        # set by ShardedTrainer when the class axis is sharded: head_forward
+        # then shard_maps the Pallas kernel over this mesh (core/mgproto.py)
+        self._score_mesh = None
         self.joint_tx = make_joint_optimizer(cfg, steps_per_epoch)
         self.warm_tx = make_warm_optimizer(cfg)
         self.proto_tx = make_mean_optimizer(cfg.em)
@@ -128,7 +132,7 @@ class Trainer:
         )
         logits, pooled, enq = head_forward(
             proto_map, state.gmm, labels, self.cfg.model.mine_T,
-            fused=self._fused,
+            fused=self._fused, mesh=self._score_mesh,
         )
         ce = L.cross_entropy(logits[..., 0], labels)
         mine = L.mine_loss(logits, labels) * use_mine
@@ -229,7 +233,7 @@ class Trainer:
         )
         logits, _, _ = head_forward(
             proto_map, state.gmm, None, self.cfg.model.mine_T,
-            fused=self._fused,
+            fused=self._fused, mesh=self._score_mesh,
         )
         lvl0 = logits[..., 0]
         correct = (

@@ -143,7 +143,8 @@ def build_config(workdir: str, arch: str, classes: int, epochs: int,
                  batch: int, ood_dirs=(), compute_dtype: str = "float32",
                  aux_loss: str = "proxy_anchor", protos: int = 5,
                  mem_capacity: int = 64, proto_dim: int = 16,
-                 mesh_data: int = -1, mesh_model: int = 1):
+                 mesh_data: int = -1, mesh_model: int = 1,
+                 fused_scoring: str = "auto"):
     """The evidence Config shared by this script and synthetic_ood.py —
     the OoD evaluation must restore checkpoints under the EXACT training-time
     model config. protos/mem_capacity/proto_dim default to the tiny evidence
@@ -174,6 +175,12 @@ def build_config(workdir: str, arch: str, classes: int, epochs: int,
             mem_capacity=mem_capacity,
             pretrained=False,
             compute_dtype=compute_dtype,
+            # "on" forces the fused Pallas scoring path (shard_mapped when
+            # mesh_model > 1 — the r5 class-sharded kernel); "auto" resolves
+            # per backend (TPU fused, CPU unfused), "off" pins the XLA path
+            fused_scoring={"auto": None, "on": True, "off": False}[
+                fused_scoring
+            ],
         ),
         schedule=ScheduleConfig(
             num_train_epochs=epochs,
@@ -318,6 +325,11 @@ def main() -> None:
     p.add_argument("--mesh_model", type=int, default=1,
                    help="mesh model-axis size — class-shards GMM/memory/EM "
                         "(must divide both --cpu_devices and --classes)")
+    p.add_argument("--fused_scoring", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="density scoring path: auto (backend default), on "
+                        "(force the Pallas kernel; shard_mapped when "
+                        "--mesh_model > 1), off (XLA matmul+top_k)")
     p.add_argument("--profile_dir", default="",
                    help="write a jax.profiler trace of the first epoch here "
                         "(cli/common.py --profile_dir pass-through)")
@@ -343,6 +355,7 @@ def main() -> None:
         aux_loss=args.aux_loss, protos=args.protos,
         mem_capacity=args.mem_capacity, proto_dim=args.proto_dim,
         mesh_data=args.mesh_data, mesh_model=args.mesh_model,
+        fused_scoring=args.fused_scoring,
     )
     save_build_args(args.workdir, **build_kwargs)
     cfg = build_config(args.workdir, **build_kwargs)
@@ -397,6 +410,7 @@ def main() -> None:
         "cpu_devices": args.cpu_devices,
         "mesh_data": args.mesh_data,
         "mesh_model": args.mesh_model,
+        "fused_scoring": args.fused_scoring,
         "chance_accuracy": 1.0 / args.classes,
         # queue-fill + EM-width evidence: first epoch where EVERY class queue
         # is full, and the max classes EM updated in one step

@@ -84,6 +84,97 @@ def test_sharded_matches_single_device(cfg, model_axis):
     np.testing.assert_allclose(p1, p2, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize("model_axis", [2, 4])
+def test_fused_scoring_shard_mapped_matches_single_device(cfg, model_axis):
+    """VERDICT r4 item 2: the fused Pallas kernel must survive class-sharded
+    meshes via shard_map instead of silently downgrading to the XLA path.
+    One full train step (fwd + bwd + EM), fused + class-sharded, must match
+    the single-device UNFUSED step — proving kernel numerics, the shard_map
+    wrapper (incl. the transpose psum of grad_feat over 'model'), and the
+    sharding in one comparison."""
+    import dataclasses
+
+    cfg_f = cfg.replace(model=dataclasses.replace(
+        cfg.model, fused_scoring=True))
+    ref = Trainer(cfg, steps_per_epoch=4)  # default: unfused on CPU
+    sh = ShardedTrainer(
+        cfg_f, steps_per_epoch=4, mesh=make_mesh(model=model_axis)
+    )
+    assert sh._fused and sh._score_mesh is not None
+
+    state0 = ref.init_state(jax.random.PRNGKey(0))
+    state_sh = sh.prepare(state0)
+    images, labels = _batch()
+    s1, m1 = ref.train_step(
+        state0, jnp.asarray(images), jnp.asarray(labels),
+        use_mine=True, update_gmm=True,
+    )
+    s2, m2 = sh.train_step(
+        state_sh, images, labels, use_mine=True, update_gmm=True
+    )
+    np.testing.assert_allclose(m1.loss, jax.device_get(m2.loss), rtol=2e-5)
+    np.testing.assert_allclose(
+        jax.device_get(s1.gmm.means), jax.device_get(s2.gmm.means),
+        rtol=2e-5, atol=2e-6,
+    )
+    np.testing.assert_array_equal(
+        jax.device_get(s1.memory.length), jax.device_get(s2.memory.length)
+    )
+    # the backward path (custom VJP per shard + psum over 'model') trained
+    # the SAME parameters as the single-device unfused step
+    p1 = jax.device_get(jax.tree_util.tree_leaves(s1.params["net"])[0])
+    p2 = jax.device_get(jax.tree_util.tree_leaves(s2.params["net"])[0])
+    np.testing.assert_allclose(p1, p2, rtol=2e-5, atol=2e-6)
+    # eval path too (no labels, inference logits)
+    o1 = ref.eval_step(s1, jnp.asarray(images))
+    o2 = sh.eval_step(s2, images)
+    np.testing.assert_allclose(
+        jax.device_get(o1.logits), jax.device_get(o2.logits),
+        rtol=2e-5, atol=2e-6,
+    )
+
+
+def test_fused_explicit_with_indivisible_classes_raises():
+    """fused_scoring=True on a mesh whose model axis cannot shard the class
+    count must fail at construction with an actionable message, not an opaque
+    SPMD error at first step (ADVICE r4)."""
+    cfg5 = tiny_test_config(num_classes=5)
+    import dataclasses
+
+    cfg5 = cfg5.replace(model=dataclasses.replace(
+        cfg5.model, fused_scoring=True))
+    with pytest.raises(ValueError, match="divisible by the mesh model axis"):
+        ShardedTrainer(cfg5, steps_per_epoch=4, mesh=make_mesh(model=2))
+
+
+def test_fused_ragged_shape_falls_back_per_shape(cfg):
+    """head_forward called directly (the public API surface, not via the
+    ShardedTrainer whose loaders pad every batch) with a shape shard_map
+    cannot split — batch not divisible by 'data' — must fall back to the XLA
+    path for that shape instead of erroring, and still match it exactly."""
+    from mgproto_tpu.core.mgproto import head_forward
+    from mgproto_tpu.core.state import create_train_state
+    from mgproto_tpu.engine.train import Trainer
+
+    tr = Trainer(cfg, steps_per_epoch=4)
+    state = tr.init_state(jax.random.PRNGKey(0))
+    mesh = make_mesh(data=4, model=2)
+    rng = np.random.RandomState(3)
+    proto_map = jnp.asarray(
+        rng.rand(6, 8, 8, cfg.model.proto_dim), jnp.float32  # 6 % 4 != 0
+    )
+    labels = jnp.asarray(rng.randint(0, cfg.model.num_classes, 6), jnp.int32)
+    lf, pf, _ = head_forward(
+        proto_map, state.gmm, labels, cfg.model.mine_T, fused=True, mesh=mesh
+    )
+    lu, pu, _ = head_forward(
+        proto_map, state.gmm, labels, cfg.model.mine_T, fused=False
+    )
+    np.testing.assert_allclose(
+        jax.device_get(lf), jax.device_get(lu), rtol=1e-6, atol=1e-6
+    )
+
+
 def test_state_sharding_layout(cfg):
     """With a model axis, gmm/memory leaves are class-sharded."""
     sh = ShardedTrainer(cfg, steps_per_epoch=4, mesh=make_mesh(model=2))
@@ -127,10 +218,16 @@ def test_imagenet_scale_class_sharding():
     over the model axis; density/EM/memory shards stay class-local."""
     from mgproto_tpu.parallel import ShardedTrainer, make_mesh
 
+    import dataclasses
+
     cfg = tiny_test_config(
         num_classes=1000, prototypes_per_class=2, proto_dim=8,
         img_size=32, mem_capacity=8, mine_T=3,
     )
+    # fused + shard_map at the stretch layout: the configuration whose
+    # density matrix most needs the kernel (VERDICT r4 item 2)
+    cfg = cfg.replace(model=dataclasses.replace(
+        cfg.model, fused_scoring=True))
     mesh = make_mesh(data=2, model=4)
     tr = ShardedTrainer(cfg, steps_per_epoch=2, mesh=mesh)
     st = tr.init_state(jax.random.PRNGKey(0))
