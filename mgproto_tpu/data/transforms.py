@@ -289,37 +289,20 @@ def color_jitter(
     }
     order = rng.permutation(4)
     arr = np.asarray(img.convert("RGB"), np.uint8)
-    use_native = native.jitter_available()
+    # the native entry points each carry their own bit-exact numpy fallback
+    # (built from this module's _blend_u8/_luma_u8/_adjust_hue_array), so
+    # they are simply called unconditionally
     for t in order:
         if t == 0:
-            if use_native:
-                arr = native.jitter_brightness(arr, factors[0])
-            else:
-                arr = _blend_u8(
-                    np.float32(0), arr.astype(np.float32), factors[0]
-                )
+            arr = native.jitter_brightness(arr, factors[0])
         elif t == 1:
-            if use_native:
-                arr = native.jitter_contrast(arr, factors[1])
-            else:
-                # ImageEnhance.Contrast: degenerate = solid gray at the
-                # rounded mean of the L image
-                mean = np.float32(int(_luma_u8(arr).mean() + 0.5))
-                arr = _blend_u8(mean, arr.astype(np.float32), factors[1])
+            arr = native.jitter_contrast(arr, factors[1])
         elif t == 2:
-            if use_native:
-                arr = native.jitter_saturation(arr, factors[2])
-            else:
-                # ImageEnhance.Color: degenerate = L replicated into RGB
-                lum = _luma_u8(arr).astype(np.float32)[..., None]
-                arr = _blend_u8(lum, arr.astype(np.float32), factors[2])
+            arr = native.jitter_saturation(arr, factors[2])
         elif abs(factors[3]) >= 1e-8:
             # NB: the HSV round-trip is lossy, so it applies whenever the
             # PIL path would have (even when the uint8 shift lands on 0)
-            if use_native:
-                arr = native.hue_shift(arr, int(factors[3] * 255) % 256)
-            else:
-                arr = _adjust_hue_array(arr, factors[3])
+            arr = native.hue_shift(arr, int(factors[3] * 255) % 256)
     return Image.fromarray(arr)
 
 

@@ -19,9 +19,37 @@ from typing import List, Optional
 
 import numpy as np
 
-_LIB_NAME = "libmgproto_native.so"
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, os.pardir, os.pardir, "csrc", "mgproto_native.cc")
+
+
+def _host_tag() -> str:
+    """Short fingerprint of this host's ISA. The .so is built -march=native
+    and cached in the package dir; on a checkout shared across heterogeneous
+    hosts (NFS-mounted repo, image built on one CPU and run on another) a
+    same-named cache from a wider-ISA host would SIGILL here — keying the
+    filename by CPU feature flags makes each host build (and load) its own."""
+    try:
+        import hashlib
+        import platform
+
+        flags = ""
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                        break
+        except OSError:
+            pass
+        return hashlib.sha1(
+            (platform.machine() + ":" + flags).encode()
+        ).hexdigest()[:12]
+    except Exception:
+        return "generic"
+
+
+_LIB_NAME = f"libmgproto_native-{_host_tag()}.so"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

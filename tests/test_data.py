@@ -403,7 +403,7 @@ class TestFastColorJitter:
     def test_numpy_fallback_bit_exact(self, monkeypatch):
         from mgproto_tpu import native
 
-        monkeypatch.setattr(native, "jitter_available", lambda: False)
+        monkeypatch.setattr(native, "_load", lambda: None)
         for trial in range(10):
             self._trial(trial)
 
