@@ -164,3 +164,100 @@ def test_remat_preserves_outputs_params_and_grads():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
         )
+
+
+def test_bbn_shaped_state_dict_converts_end_to_end():
+    """Golden conversion test for the BBN-iNaturalist R50 checkpoint shape
+    (VERDICT r4 item 7): a fabricated state_dict with the BBN layout —
+    'module.backbone.' prefix, shared layer4.0/.1 plus cb_block/rb_block
+    (reference resnet_features.py:276-287) — must convert into a tree that
+    loads into build_backbone('resnet50') [3,4,6,4] EXACTLY (same structure
+    and shapes as a fresh init), land the cb/rb tensors at layer4_2/layer4_3,
+    and run a forward pass."""
+    from mgproto_tpu.models.convert import convert_resnet
+
+    rng = np.random.RandomState(0)
+    state = {}
+
+    def conv(name, cout, cin, k):
+        # small magnitudes: 50 layers of unit-variance weights would
+        # overflow f32 in the forward-pass smoke check below
+        state[name + ".weight"] = (
+            rng.normal(size=(cout, cin, k, k)) * 0.05
+        ).astype(np.float32)
+
+    def bn(name, c):
+        state[name + ".weight"] = rng.uniform(0.5, 1.5, size=(c,)).astype(
+            np.float32
+        )
+        state[name + ".bias"] = (rng.normal(size=(c,)) * 0.05).astype(
+            np.float32
+        )
+        state[name + ".running_mean"] = (
+            rng.normal(size=(c,)) * 0.05
+        ).astype(np.float32)
+        state[name + ".running_var"] = rng.uniform(
+            0.5, 2.0, size=(c,)
+        ).astype(np.float32)
+
+    conv("conv1", 64, 3, 7)
+    bn("bn1", 64)
+    inp = 64
+    for li, (blocks, planes) in enumerate(
+        zip((3, 4, 6, 4), (64, 128, 256, 512)), start=1
+    ):
+        for bi in range(blocks):
+            t = f"layer{li}.{bi}"
+            conv(f"{t}.conv1", planes, inp, 1)
+            bn(f"{t}.bn1", planes)
+            conv(f"{t}.conv2", planes, planes, 3)
+            bn(f"{t}.bn2", planes)
+            conv(f"{t}.conv3", planes * 4, planes, 1)
+            bn(f"{t}.bn3", planes * 4)
+            if bi == 0:
+                conv(f"{t}.downsample.0", planes * 4, inp, 1)
+                bn(f"{t}.downsample.1", planes * 4)
+            inp = planes * 4
+
+    # re-key into the BBN on-disk layout: layer4 blocks 2/3 are the
+    # cb/rb branch blocks, everything under module.backbone., plus the
+    # classifier head the converter must drop
+    bbn = {}
+    for k, v in state.items():
+        k = k.replace("layer4.2", "cb_block").replace("layer4.3", "rb_block")
+        bbn["module.backbone." + k] = v
+    # only key PRESENCE matters (the converter must drop these); tiny shapes
+    bbn["module.classifier.weight"] = np.zeros((4, 2048), np.float32)
+    bbn["module.classifier.bias"] = np.zeros((4,), np.float32)
+
+    variables = convert_resnet(bbn, (3, 4, 6, 4), bottleneck=True)
+
+    net = build_backbone("resnet50")
+    ref = net.init(
+        jax.random.PRNGKey(0), np.zeros((1, 64, 64, 3), np.float32),
+        train=False,
+    )
+    # structure AND shapes must match a fresh init exactly
+    conv_shapes = jax.tree.map(lambda x: x.shape, variables["params"])
+    ref_shapes = jax.tree.map(lambda x: x.shape, dict(ref["params"]))
+    assert conv_shapes == ref_shapes
+    stats_shapes = jax.tree.map(lambda x: x.shape, variables["batch_stats"])
+    ref_stats = jax.tree.map(lambda x: x.shape, dict(ref["batch_stats"]))
+    assert stats_shapes == ref_stats
+    assert not any("fc" in k or "classifier" in k for k in variables["params"])
+
+    # golden placement: cb_block -> layer4_2, rb_block -> layer4_3
+    np.testing.assert_array_equal(
+        variables["params"]["layer4_2"]["conv1"]["kernel"],
+        np.transpose(bbn["module.backbone.cb_block.conv1.weight"],
+                     (2, 3, 1, 0)),
+    )
+    np.testing.assert_array_equal(
+        variables["batch_stats"]["layer4_3"]["bn1"]["mean"],
+        bbn["module.backbone.rb_block.bn1.running_mean"],
+    )
+
+    # and the converted tree actually runs
+    out = net.apply(variables, np.zeros((1, 64, 64, 3), np.float32),
+                    train=False)
+    assert np.isfinite(np.asarray(out)).all()
