@@ -1,0 +1,201 @@
+"""MXU-utilization bound per conv/dot of the flagship step (MFU headroom).
+
+The on-device sweep measured 55.8% MFU at batch 256 (BENCH_SWEEP_TPU.json)
+with no statement of what bounds the remaining 44% (VERDICT r4 item 5/4).
+This script derives the STRUCTURAL part of the answer without hardware: it
+lowers the exact production train step (bench.flagship_config — the same
+program bench.py times), walks the PRE-OPTIMIZATION StableHLO for
+convolution/dot ops (backend-neutral shapes; XLA's later layout/fusion
+passes can still rewrite individual ops, so treat per-op rows as the
+program's math, not the chip's final schedule — and note the fused Pallas
+scoring kernel lowers to a custom_call whose internal matmuls are not
+counted), and computes each op's FLOP share together with an MXU
+tiling-efficiency bound from its contraction/output dimensions:
+
+    eff(op) ~= (K / ceil128(K)) * (N / ceil128(N))     [M is large: B*H*W]
+
+where K = contraction size (Cin * kh * kw for convs) and N = output
+channels. The 128s are the v5e MXU systolic array edge: a dimension not a
+multiple of 128 pads the array and caps that op's attainable share of peak.
+The FLOP-weighted mean of eff() is a CEILING on whole-step MFU from matrix
+units alone — on top of it sit HBM-bandwidth stalls on the low-intensity
+ops, inter-op bubbles, and the non-matmul tail, which only the profiler
+trace (tpu_window.sh stage 4 -> evidence/tpu_trace_b256) can apportion.
+
+Runs hermetically on CPU: conv/dot SHAPES are backend-portable (the jitted
+program is the same), only the measured times are not.
+
+Usage: python scripts/mfu_headroom.py [--batch 256] [--fused] [--out FILE]
+Prints one JSON line (top ops + weighted bound); paste-ready for PERF.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def ceil128(x: int) -> int:
+    return (x + 127) // 128 * 128
+
+
+_SIG = re.compile(
+    r":\s*\(tensor<([0-9x]+)x(?:bf16|f16|f32)>,\s*"
+    r"tensor<([0-9x]+)x(?:bf16|f16|f32)>\)\s*->\s*"
+    r"tensor<([0-9x]+)x(?:bf16|f16|f32)>"
+)
+
+
+def _dims(s: str):
+    return [int(d) for d in s.split("x") if d]
+
+
+def conv_flops_and_eff(line: str):
+    """(flops, eff_bound, desc) for one stablehlo.convolution line, or None.
+
+    Parses `... dim_numbers = [b, 0, 1, f]x[0, 1, i, o]->[...] ... :
+    (tensor<LHS>, tensor<RHS>) -> tensor<OUT>` — enough structure for
+    FLOPs = 2 * prod(out) * Cin * kh * kw and the MXU bound from
+    (Cin*kh*kw, Cout)."""
+    m = re.search(r"dim_numbers\s*=\s*\[[^\]]*\]x\[([^\]]*)\]", line)
+    sig = _SIG.search(line)
+    if not m or not sig:
+        return None
+    lhs, rhs, out = (_dims(g) for g in sig.groups())
+    rhs_labels = [t.strip() for t in m.group(1).split(",")]
+    if len(rhs_labels) != len(rhs):
+        return None
+    kh_kw = [rhs[i] for i, c in enumerate(rhs_labels) if c.isdigit()]
+    try:
+        cin = rhs[rhs_labels.index("i")]
+        cout = rhs[rhs_labels.index("o")]
+    except ValueError:
+        return None
+    k = cin * math.prod(kh_kw) if kh_kw else cin
+    flops = 2.0 * math.prod(out) * k
+    eff = (k / ceil128(k)) * (cout / ceil128(cout))
+    kdesc = "x".join(str(v) for v in kh_kw)
+    desc = (
+        f"conv {'x'.join(map(str, lhs))} * k{kdesc} io={cin}->{cout}"
+    )
+    return flops, eff, desc
+
+
+def dot_flops_and_eff(line: str):
+    sig = _SIG.search(line)
+    if not sig:
+        return None
+    lhs, rhs, out = (_dims(g) for g in sig.groups())
+    if not out or not lhs or not rhs:
+        return None
+    # batch dims (common leading prefix of all three shapes) must be divided
+    # OUT before solving for the contraction size: for lhs [B,M,K],
+    # rhs [B,K,N], out [B,M,N],  K^2 = (prod(lhs)/B)*(prod(rhs)/B)/(prod(out)/B)
+    b = 1
+    for dl, dr, do in zip(lhs, rhs, out):
+        if dl == dr == do:
+            b *= dl
+        else:
+            break
+    denom = math.prod(out)
+    k = math.sqrt(max(
+        (math.prod(lhs) / b) * (math.prod(rhs) / b) / max(denom / b, 1), 1.0
+    ))
+    n = out[-1]
+    flops = 2.0 * denom * k
+    eff = (k / ceil128(int(math.ceil(k)))) * (n / ceil128(n))
+    return (
+        flops, eff,
+        f"dot {'x'.join(map(str, lhs))} . {'x'.join(map(str, rhs))}"
+        f" -> {'x'.join(map(str, out))}",
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--fused", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="--no-fused analyzes the XLA (unfused) scoring path")
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    os.environ.setdefault("BENCH_BATCH", str(args.batch))
+    from bench import flagship_config
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mgproto_tpu.engine.train import Trainer
+
+    cfg = flagship_config(fused=args.fused)
+    trainer = Trainer(cfg, steps_per_epoch=100)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    host = np.random.RandomState(0)
+    images = jnp.asarray(
+        host.rand(args.batch, cfg.model.img_size, cfg.model.img_size, 3),
+        jnp.float32,
+    )
+    labels = jnp.asarray(
+        host.randint(0, cfg.model.num_classes, size=(args.batch,)), jnp.int32
+    )
+    lowered = trainer._train_step.lower(
+        state, images, labels, jnp.asarray(1.0, jnp.float32),
+        jnp.asarray(True, bool), warm=False,
+    )
+    hlo = lowered.as_text()  # StableHLO: backend-neutral shapes
+
+    ops = []
+    for line in hlo.splitlines():
+        entry = None
+        if "stablehlo.convolution" in line:
+            entry = conv_flops_and_eff(line)
+        elif "stablehlo.dot_general" in line:
+            entry = dot_flops_and_eff(line)
+        if entry:
+            ops.append(entry)
+
+    total = sum(f for f, _, _ in ops) or 1.0
+    weighted_eff = sum(f * e for f, e, _ in ops) / total
+
+    # aggregate identical descs (the backward pass repeats most convs)
+    agg = {}
+    for f, e, d in ops:
+        cur = agg.setdefault(d, [0.0, e])
+        cur[0] += f
+    top = sorted(agg.items(), key=lambda kv: -kv[1][0])[:12]
+
+    result = {
+        "what": (
+            "MXU tiling-efficiency bound per conv/dot of the flagship "
+            f"fused train step, batch {args.batch} (model-based; trace "
+            "apportionment pending a TPU window)"
+        ),
+        "batch": args.batch,
+        "matmul_flops_total": total,
+        "flop_weighted_mxu_eff_bound": round(weighted_eff, 4),
+        "top_ops": [
+            {
+                "op": d,
+                "flops_pct": round(100 * f / total, 1),
+                "mxu_eff_bound": round(e, 3),
+            }
+            for d, (f, e) in top
+        ],
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
