@@ -147,8 +147,23 @@ class ShardedTrainer(Trainer):
         return self.prepare(super().init_state(rng, for_restore=for_restore))
 
     def put_batch(self, batch: Any) -> Any:
-        """Host batch -> data-sharded device arrays (multi-host aware)."""
-        return put_batch(batch, self.mesh)
+        """Host batch -> data-sharded device arrays (multi-host aware).
+        Host-side dtype conversion happens here so device-prefetched batches
+        (engine/train.py train_epoch) arrive fully placed."""
+        images, labels = batch
+        if not isinstance(images, jax.Array):
+            images = np.asarray(images, np.float32)
+        if not isinstance(labels, jax.Array):
+            labels = np.asarray(labels, np.int32)
+        return put_batch((images, labels), self.mesh)
+
+    def _placed(self, x: Any) -> bool:
+        """True iff `x` already carries THIS trainer's batch sharding (i.e.
+        it came through put_batch). A merely-default-device jax.Array (e.g.
+        jnp.asarray in engine/evaluate.py) must still be placed: under
+        multi-host, skipping put_batch would hand a process-local array to a
+        step jitted over the global mesh."""
+        return isinstance(x, jax.Array) and x.sharding == self._batch_sh
 
     # ----------------------------------------------------------------- steps
     def train_step(
@@ -160,17 +175,19 @@ class ShardedTrainer(Trainer):
         update_gmm: bool,
         warm: bool = False,
     ) -> Tuple[TrainState, TrainMetrics]:
-        images = np.asarray(images, np.float32)
-        labels = np.asarray(labels, np.int32)
-        images, labels = self.put_batch((images, labels))
-        return super().train_step(state, images, labels, use_mine, update_gmm, warm)
+        if not (self._placed(images) and self._placed(labels)):
+            # not batch-sharded yet: place now (prefetched batches skip this)
+            images, labels = self.put_batch((images, labels))
+        return Trainer.train_step(
+            self, state, images, labels, use_mine, update_gmm, warm
+        )
 
     def eval_step(
         self, state: TrainState, images: jax.Array, labels=None
     ) -> EvalOutput:
-        images = np.asarray(images, np.float32)
         if labels is None:
             # sharded eval always carries a label array; -1 never matches argmax
-            labels = np.full((images.shape[0],), -1, np.int32)
-        images, labels = self.put_batch((images, np.asarray(labels, np.int32)))
+            labels = np.full((np.shape(images)[0],), -1, np.int32)
+        if not (self._placed(images) and self._placed(labels)):
+            images, labels = self.put_batch((images, labels))
         return self._eval_step(state, images, labels)

@@ -132,3 +132,67 @@ def test_epoch_flags(setup):
     flags = trainer.epoch_flags(state, epoch=0)
     assert flags["use_mine"] is True  # tiny config: mine_start=0
     assert flags["update_gmm"] is False  # memory empty
+
+
+def test_device_prefetch_preserves_order_and_depth():
+    from mgproto_tpu.data.loader import device_prefetch
+
+    placed = []
+    out = list(device_prefetch(iter(range(7)), lambda b: placed.append(b) or b,
+                               depth=2))
+    assert out == list(range(7))
+    assert placed == list(range(7))
+
+    # in-flight depth: when item K is yielded, at most K+depth items were put
+    events = []
+
+    def put(b):
+        events.append(("put", b))
+        return b
+
+    gen = device_prefetch(iter(range(5)), put, depth=2)
+    for item in gen:
+        events.append(("yield", item))
+    for i, (kind, v) in enumerate(events):
+        if kind == "yield":
+            puts_before = sum(1 for k, _ in events[:i] if k == "put")
+            assert puts_before <= v + 2
+
+
+def test_train_epoch_prefetch_matches_manual_steps(setup):
+    """train_epoch (device-prefetched batches) must equal stepping the same
+    batches by hand — prefetch is pipelining, not math."""
+    cfg, _, _ = setup
+    rng = np.random.RandomState(0)
+    batches = [
+        (
+            rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3).astype(
+                np.float32
+            ),
+            rng.randint(0, cfg.model.num_classes, size=(4,)).astype(np.int32),
+        )
+        for _ in range(3)
+    ]
+
+    t1 = Trainer(cfg, steps_per_epoch=3)
+    s1 = t1.init_state(jax.random.PRNGKey(0))
+    s1, m1 = t1.train_epoch(s1, iter(batches), epoch=1)
+
+    t2 = Trainer(cfg, steps_per_epoch=3)
+    s2 = t2.init_state(jax.random.PRNGKey(0))
+    flags = t2.epoch_flags(s2, 1)
+    for images, labels in batches:
+        s2, m2 = t2.train_step(
+            s2, images, labels,
+            use_mine=flags["use_mine"], update_gmm=flags["update_gmm"],
+            warm=flags["warm"],
+        )
+    np.testing.assert_allclose(
+        float(m1.loss), float(m2.loss), rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        jax.device_get(s1.memory.length), jax.device_get(s2.memory.length)
+    )
+    p1 = jax.device_get(jax.tree_util.tree_leaves(s1.params["net"])[0])
+    p2 = jax.device_get(jax.tree_util.tree_leaves(s2.params["net"])[0])
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-7)
