@@ -310,6 +310,24 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
         state, metrics = step(state)
     float(jax.device_get(metrics.loss))
 
+    # per-path telemetry (fresh registry: a child measures exactly one path).
+    # The timed loop runs the AOT executable, which by construction cannot
+    # retrace — so the watched jit handles' caches MUST stay empty, and
+    # `recompile_count` is an invariant check, not a live retrace monitor: a
+    # nonzero value means something dispatched the jit path mid-bench (i.e.
+    # the measurement no longer times only the compiled step). The expected
+    # compilation is the one explicit `lowered.compile()` above, reported as
+    # `compile_count`/`compile_s`. Live shape-driven recompile telemetry
+    # belongs to training runs (cli.train + StepMonitor).
+    from mgproto_tpu.telemetry import MetricRegistry, StepMonitor
+    from mgproto_tpu.telemetry.registry import percentile_from_buckets
+
+    reg = MetricRegistry()
+    mon = StepMonitor(registry=reg, phase="bench")
+    mon.watch(lambda: trainer.jit_handles)
+    mon.check_recompiles()  # baseline after warmup
+    mon.record_cost_analysis(compiled)
+
     _phase("timed_loop")
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     if profile_dir:
@@ -317,13 +335,38 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
         # steady-state steps — the artifact the MFU-headroom analysis reads
         jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
+    prev = t0
     for _ in range(ITERS):
         state, metrics = step(state)
+        now = time.perf_counter()
+        # dispatch-interval per step; the final device sync below lands in
+        # the headline dt only, so the histogram slightly undercounts the
+        # last step — the percentiles are still the right shape signal
+        mon.observe_step(BATCH, now - prev, check_recompiles=False)
+        prev = now
     float(jax.device_get(metrics.loss))
     int(jax.device_get(state.step))
     dt = time.perf_counter() - t0
     if profile_dir:
         jax.profiler.stop_trace()
+    mon.check_recompiles()
+    hist = reg.histogram("step_time_seconds").snapshot_series(phase="bench")
+    telemetry = {
+        "step_time_hist": {
+            "count": hist["count"],
+            "mean_s": hist["sum"] / max(hist["count"], 1),
+            "p50_s": percentile_from_buckets(hist, 50),
+            "p90_s": percentile_from_buckets(hist, 90),
+            "min_s": hist["min"],
+            "max_s": hist["max"],
+        },
+        # the one AOT compile of the measured step (wall time: compile_s)
+        "compile_count": 1,
+        # invariant check (see comment above): 0 = the timed loop ran ONLY
+        # the AOT executable; nonzero = a stray jit dispatch contaminated
+        # the measurement
+        "stray_jit_recompiles": mon.recompile_count,
+    }
     return {
         "mode": "train",
         "imgs_per_sec": BATCH * ITERS / dt,
@@ -332,6 +375,7 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
         "flops_per_step": flops,
         "device_kind": jax.devices()[0].device_kind,
         "batch": BATCH,
+        "telemetry": telemetry,
     }
 
 
@@ -465,6 +509,10 @@ def _summary(results: dict, errors: dict, attempts_total: int,
     }
     if headline_degraded:
         out["headline_degraded"] = True
+    if best.get("telemetry"):
+        # winner's step-time histogram + recompile count: the BENCH_*.json
+        # trajectory then carries its own dispersion/compile-health evidence
+        out["telemetry"] = best["telemetry"]
     for name, r in results.items():
         if name not in ("unfused", "fused"):
             out[f"{name}_imgs_per_sec"] = round(r["imgs_per_sec"], 2)

@@ -3,6 +3,7 @@
 `python -m mgproto_tpu.cli.train`  — full training driver
 `python -m mgproto_tpu.cli.evaluate` — test / OoD / interpretability metrics
 `python -m mgproto_tpu.cli.prep`  — offline dataset preparation
+`python -m mgproto_tpu.cli.telemetry` — summarize a run's telemetry dir
 """
 
 from mgproto_tpu.cli.common import DATASET_PRESETS, config_from_args

@@ -124,6 +124,17 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="checkpoint path, or 'auto' for latest in model_dir")
     p.add_argument("--profile_dir", default="",
                    help="write a jax.profiler trace of one epoch here")
+    # telemetry (metric registry + tracing spans + step/health monitors);
+    # both dash and underscore spellings resolve to the same dest
+    p.add_argument("--telemetry-dir", "--telemetry_dir", dest="telemetry_dir",
+                   default="",
+                   help="telemetry output dir (metrics.prom / metrics.jsonl /"
+                        " health.jsonl / trace.json; default: "
+                        "<model_dir>/telemetry); summarize with "
+                        "`mgproto-telemetry <dir>`")
+    p.add_argument("--no-telemetry", "--no_telemetry", dest="no_telemetry",
+                   action="store_true",
+                   help="disable the telemetry subsystem entirely")
     p.add_argument("--target_accu", type=float, default=0.0,
                    help="save checkpoints only above this test accuracy")
 

@@ -8,7 +8,9 @@ stability / purity) live in `mgproto_tpu.cli.interpret`.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
+import os
 from typing import Optional
 
 import jax
@@ -21,6 +23,7 @@ from mgproto_tpu.cli.common import (
 from mgproto_tpu.cli.train import _test
 from mgproto_tpu.data import build_pipelines
 from mgproto_tpu.parallel import ShardedTrainer
+from mgproto_tpu.telemetry import make_session
 from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
 from mgproto_tpu.utils.checkpoint import adopt_checkpoint_train_config
 
@@ -64,10 +67,31 @@ def main(argv: Optional[list] = None) -> None:
     state = trainer.prepare(restore_checkpoint(path, state))
     print(f"loaded {path}")
 
-    accu, results = _test(
-        trainer, state, test_loader, ood_loaders, print,
-        score_rule=args.ood_score,
+    # telemetry (eval-side): span + eval-step recompile watch + a health
+    # record of the restored checkpoint, in <model_dir>/telemetry_eval so a
+    # co-located training run's artifacts are never clobbered
+    telem = make_session(
+        args.telemetry_dir or os.path.join(cfg.model_dir, "telemetry_eval"),
+        not args.no_telemetry,
     )
+    if telem:
+        telem.monitor.watch(lambda: trainer.jit_handles)
+
+    try:
+        with telem.span("evaluate", checkpoint=path) if telem else (
+            contextlib.nullcontext()
+        ):
+            accu, results = _test(
+                trainer, state, test_loader, ood_loaders, print,
+                score_rule=args.ood_score,
+            )
+        if telem:
+            telem.monitor.check_recompiles()
+            telem.health.record(state)
+            telem.flush()
+    finally:
+        if telem:
+            telem.close()
     print(json.dumps({"checkpoint": path, "accuracy": accu, **results}))
 
 

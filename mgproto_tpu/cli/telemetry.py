@@ -1,0 +1,284 @@
+"""Run-summary driver: summarize a telemetry directory.
+
+`mgproto-telemetry <dir>` (or `python -m mgproto_tpu.cli.telemetry <dir>`)
+reads the artifacts a TelemetrySession wrote — metrics.jsonl (registry
+snapshots), health.jsonl (per-epoch ModelHealth records), trace.json
+(Chrome-trace spans) — and renders what a run operator asks first: how fast
+were steps (final EMA + percentiles), did anything recompile mid-run, did
+the model stay healthy (entropy / collapse / memory-fill trajectory), and
+where did the wall time go (per-span totals). Accepts the run's model_dir
+too (falls back to its telemetry/ subdirectory). `--json` emits the summary
+as one JSON object for scripts; the default is an aligned text table.
+
+Host-side and jax-free: summarizing must work on a laptop with nothing but
+the run directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from mgproto_tpu.telemetry.registry import percentile_from_buckets
+from mgproto_tpu.telemetry.session import (
+    HEALTH_FILE,
+    METRICS_FILE,
+    PROM_FILE,
+    TRACE_FILE,
+)
+
+STEP_PERCENTILES = (50.0, 90.0, 99.0)
+
+# the health keys whose first->last trajectory the table shows
+HEALTH_TRAJECTORY_KEYS = (
+    "prior_entropy_mean",
+    "min_interproto_dist",
+    "collapse_frac",
+    "memory_occupancy",
+)
+
+
+def resolve_dir(path: str) -> str:
+    """Accept a telemetry dir directly or a run dir containing telemetry/."""
+    if os.path.isfile(os.path.join(path, METRICS_FILE)) or os.path.isfile(
+        os.path.join(path, HEALTH_FILE)
+    ):
+        return path
+    sub = os.path.join(path, "telemetry")
+    if os.path.isdir(sub):
+        return sub
+    return path
+
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    if not os.path.isfile(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # a torn tail line (killed run) is not an error
+    return out
+
+
+def _series_value(snapshot: Dict, name: str, default=None):
+    """Latest-snapshot scalar: sums counters across label sets, takes the
+    max-labeled single series otherwise (phase-labeled gauges have one)."""
+    m = snapshot.get(name)
+    if not m or not m.get("series"):
+        return default
+    vals = [s.get("value") for s in m["series"] if s.get("value") is not None]
+    if not vals:
+        return default
+    if m.get("type") == "counter":
+        return sum(vals)
+    return vals[-1]
+
+
+def _hist_series(snapshot: Dict, name: str) -> Optional[Dict]:
+    """Merge a histogram's label series into one (same bounds by construction)."""
+    m = snapshot.get(name)
+    if not m or m.get("type") != "histogram" or not m.get("series"):
+        return None
+    merged: Optional[Dict[str, Any]] = None
+    for s in m["series"]:
+        if merged is None:
+            merged = {
+                "bounds": list(s["bounds"]),
+                "bucket_counts": list(s["bucket_counts"]),
+                "count": s["count"],
+                "sum": s["sum"],
+                "min": s["min"],
+                "max": s["max"],
+            }
+        else:
+            merged["bucket_counts"] = [
+                a + b
+                for a, b in zip(merged["bucket_counts"], s["bucket_counts"])
+            ]
+            merged["count"] += s["count"]
+            merged["sum"] += s["sum"]
+            for k, pick in (("min", min), ("max", max)):
+                if s[k] is not None:
+                    merged[k] = (
+                        s[k] if merged[k] is None else pick(merged[k], s[k])
+                    )
+    return merged
+
+
+def summarize(telemetry_dir: str) -> Dict[str, Any]:
+    """The whole summary as one JSON-able dict."""
+    d = resolve_dir(telemetry_dir)
+    snapshots = _read_jsonl(os.path.join(d, METRICS_FILE))
+    health = _read_jsonl(os.path.join(d, HEALTH_FILE))
+    last = snapshots[-1]["metrics"] if snapshots else {}
+
+    summary: Dict[str, Any] = {
+        "telemetry_dir": os.path.abspath(d),
+        "snapshots": len(snapshots),
+        "artifacts": {
+            name: os.path.isfile(os.path.join(d, name))
+            for name in (METRICS_FILE, HEALTH_FILE, TRACE_FILE, PROM_FILE)
+        },
+    }
+
+    steps: Dict[str, Any] = {
+        "steps_total": _series_value(last, "steps_total"),
+        "images_total": _series_value(last, "images_total"),
+        "step_time_ema_seconds": _series_value(last, "step_time_ema_seconds"),
+        "images_per_sec": _series_value(last, "images_per_sec"),
+        "epoch_images_per_sec_global": _series_value(
+            last, "epoch_images_per_sec_global"
+        ),
+        "host_transfer_bytes_total": _series_value(
+            last, "host_transfer_bytes_total"
+        ),
+    }
+    hist = _hist_series(last, "step_time_seconds")
+    if hist:
+        steps["step_time_mean_seconds"] = (
+            hist["sum"] / hist["count"] if hist["count"] else None
+        )
+        for p in STEP_PERCENTILES:
+            steps[f"step_time_p{p:g}_seconds"] = percentile_from_buckets(
+                hist, p
+            )
+        steps["step_time_max_seconds"] = hist["max"]
+    summary["steps"] = steps
+
+    summary["recompiles"] = {
+        "jit_recompiles_total": _series_value(last, "jit_recompiles_total"),
+        "jit_cache_size": _series_value(last, "jit_cache_size"),
+    }
+
+    if health:
+        traj = {}
+        for key in HEALTH_TRAJECTORY_KEYS:
+            vals = [r[key] for r in health if key in r]
+            if vals:
+                traj[key] = {"first": vals[0], "last": vals[-1]}
+        summary["health"] = {
+            "records": len(health),
+            "first_epoch": health[0].get("epoch"),
+            "last_epoch": health[-1].get("epoch"),
+            "trajectory": traj,
+            "last": {
+                k: v
+                for k, v in health[-1].items()
+                if isinstance(v, (int, float)) and k not in ("time", "epoch")
+            },
+        }
+
+    trace_path = os.path.join(d, TRACE_FILE)
+    if os.path.isfile(trace_path):
+        try:
+            with open(trace_path) as f:
+                events = json.load(f).get("traceEvents", [])
+        except ValueError:
+            events = None
+        if events is not None:
+            per_name: Dict[str, Dict[str, float]] = {}
+            for e in events:
+                s = per_name.setdefault(
+                    e.get("name", "?"), {"count": 0, "total_s": 0.0}
+                )
+                s["count"] += 1
+                s["total_s"] += e.get("dur", 0.0) / 1e6
+            summary["spans"] = {
+                name: {"count": s["count"], "total_s": round(s["total_s"], 4)}
+                for name, s in sorted(
+                    per_name.items(), key=lambda kv: -kv[1]["total_s"]
+                )
+            }
+    return summary
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        if v != 0 and (abs(v) < 1e-3 or abs(v) >= 1e6):
+            return f"{v:.3e}"
+        return f"{v:.4f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def render_table(summary: Dict[str, Any]) -> str:
+    rows: List = []
+
+    def section(title: str):
+        rows.append(None)
+        rows.append((title, ""))
+
+    rows.append(("telemetry dir", summary["telemetry_dir"]))
+    rows.append(("snapshots", summary["snapshots"]))
+    rows.append((
+        "artifacts",
+        " ".join(
+            f"{n}{'' if ok else '(missing)'}"
+            for n, ok in summary["artifacts"].items()
+        ),
+    ))
+
+    section("steps")
+    for k, v in summary.get("steps", {}).items():
+        rows.append((k, v))
+    section("recompiles")
+    for k, v in summary.get("recompiles", {}).items():
+        rows.append((k, v))
+    if "health" in summary:
+        h = summary["health"]
+        section(
+            f"model health ({h['records']} records, epochs "
+            f"{h.get('first_epoch')}..{h.get('last_epoch')})"
+        )
+        for k, t in h["trajectory"].items():
+            rows.append((k, f"{_fmt(t['first'])} -> {_fmt(t['last'])}"))
+        for k, v in h["last"].items():
+            if k not in h["trajectory"]:
+                rows.append((k, v))
+    if "spans" in summary:
+        section("tracing spans (total wall seconds)")
+        for name, s in list(summary["spans"].items())[:12]:
+            rows.append((name, f"{s['total_s']} ({s['count']}x)"))
+
+    width = max(len(str(r[0])) for r in rows if r is not None)
+    lines = []
+    for r in rows:
+        if r is None:
+            lines.append("")
+        else:
+            k, v = r
+            lines.append(f"{str(k):<{width}}  {_fmt(v)}" if v != "" else str(k))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="Summarize an mgproto-tpu telemetry directory"
+    )
+    p.add_argument("dir", help="telemetry dir (or a run dir containing "
+                               "telemetry/)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as one JSON object")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        raise SystemExit(f"not a directory: {args.dir}")
+    summary = summarize(args.dir)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_table(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ from mgproto_tpu.utils import (
     save_state_w_condition,
     timed_span,
 )
+from mgproto_tpu.telemetry import make_session, trace_span
 from mgproto_tpu.utils.checkpoint import (
     adopt_checkpoint_train_config,
     load_metadata,
@@ -71,6 +72,8 @@ def run_training(
     profile_dir: str = "",
     target_accu: float = 0.0,
     render_push: bool = True,
+    telemetry_dir: str = "",
+    telemetry: bool = True,
 ):
     """Run the full schedule; returns (final_state, last_test_accuracy)."""
     # resolve --resume FIRST: a typo'd path must fail fast, before any
@@ -137,8 +140,65 @@ def run_training(
     push_ds = push_loader.dataset
     accu = 0.0
 
+    # telemetry: registry + tracing spans + step/health monitors, sunk to
+    # <telemetry_dir> on host 0 only (see telemetry/session.py). The jit
+    # handles are watched through a provider because ShardedTrainer builds
+    # its sharded jits lazily.
+    telem = make_session(
+        telemetry_dir or os.path.join(cfg.model_dir, "telemetry"), telemetry
+    )
+    if telem:
+        telem.monitor.watch(lambda: trainer.jit_handles)
+
     log("start training")
-    for epoch in range(start_epoch, cfg.schedule.num_train_epochs):
+    try:
+        for epoch in range(start_epoch, cfg.schedule.num_train_epochs):
+            state, accu = _run_epoch(
+                cfg, trainer, state, epoch, start_epoch, profile_dir,
+                train_loader, test_loader, push_loader, push_ds, ood_loaders,
+                log, metrics, telem, run_meta, img_dir, render_push,
+                target_accu,
+            )
+            if telem:
+                telem.end_epoch(state, epoch=epoch, step=int(state.step))
+
+        # pruning (reference main.py:285-287); top_m can't exceed K per class
+        last_epoch = max(cfg.schedule.num_train_epochs - 1, start_epoch)
+        top_m = min(cfg.schedule.prune_top_m, cfg.model.prototypes_per_class)
+        state = state.replace(
+            gmm=prune_top_m(
+                state.gmm, top_m, renormalize=cfg.schedule.prune_renormalize
+            )
+        )
+        with trace_span("prune"):
+            accu, test_results = _test(
+                trainer, state, test_loader, ood_loaders, log
+            )
+        metrics.write(
+            int(state.step),
+            {"epoch": last_epoch, "stage": "prune", **test_results},
+        )
+        save_state_w_condition(
+            cfg.model_dir, state, last_epoch, "prune", accu, target_accu,
+            metadata=run_meta,
+        )
+        log("training done")
+    finally:
+        if telem:
+            telem.close()
+        metrics.close()
+        log.close()
+    return state, accu
+
+
+def _run_epoch(
+    cfg, trainer, state, epoch, start_epoch, profile_dir,
+    train_loader, test_loader, push_loader, push_ds, ood_loaders,
+    log, metrics, telem, run_meta, img_dir, render_push, target_accu,
+):
+    """One epoch of the reference main.py flow (train / test / conditional
+    push), under an `epoch` tracing span so the stage spans nest."""
+    with trace_span("epoch", epoch=epoch):
         log(f"epoch: \t{epoch}")
         flags = trainer.epoch_flags(state, epoch)
         log(f"use mining: \t{flags['use_mine']}")
@@ -151,7 +211,8 @@ def run_training(
         )
         with timed_span(log, "train"), trace:
             state, last = trainer.train_epoch(
-                state, _labeled(train_loader), epoch
+                state, _labeled(train_loader), epoch,
+                monitor=telem.monitor if telem else None,
             )
         if last is not None:
             m = jax.device_get(last._asdict())
@@ -211,26 +272,6 @@ def run_training(
                 metadata=run_meta,
             )
 
-    # pruning (reference main.py:285-287); top_m can't exceed K per class
-    last_epoch = max(cfg.schedule.num_train_epochs - 1, start_epoch)
-    top_m = min(cfg.schedule.prune_top_m, cfg.model.prototypes_per_class)
-    state = state.replace(
-        gmm=prune_top_m(
-            state.gmm, top_m, renormalize=cfg.schedule.prune_renormalize
-        )
-    )
-    accu, test_results = _test(trainer, state, test_loader, ood_loaders, log)
-    metrics.write(
-        int(state.step), {"epoch": last_epoch, "stage": "prune", **test_results}
-    )
-    save_state_w_condition(
-        cfg.model_dir, state, last_epoch, "prune", accu, target_accu,
-        metadata=run_meta,
-    )
-
-    log("training done")
-    metrics.close()
-    log.close()
     return state, accu
 
 
@@ -247,6 +288,8 @@ def main(argv: Optional[list] = None) -> None:
         resume=args.resume,
         profile_dir=args.profile_dir,
         target_accu=args.target_accu,
+        telemetry_dir=args.telemetry_dir,
+        telemetry=not args.no_telemetry,
     )
 
 

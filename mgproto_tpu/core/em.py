@@ -48,6 +48,70 @@ class EMAux(NamedTuple):
     log_likelihood: jax.Array  # mean E-step log-likelihood over active classes
 
 
+def em_health_diagnostics(
+    gmm: GMMState,
+    memory: Memory,
+    collapse_tol: float = 1e-3,
+    sigma_floor: float = 1e-3,
+    eps: float = 1e-10,
+) -> dict:
+    """Pure, jittable EM/prototype health diagnostics — the hook point
+    telemetry's ModelHealth reads each epoch. Returns scalars only (so the
+    output is replicated and host-readable under any mesh sharding):
+
+      prior_entropy_mean/min: per-class mixture-prior entropy in nats over
+        the renormalized priors (momentum write-back keeps sums near but not
+        exactly 1). Entropy -> 0 means one prototype owns the class — the
+        mixture has effectively collapsed to a single mode.
+      min_interproto_dist: smallest intra-class distance between prototype
+        means, over all classes. -> 0 means duplicate prototypes (the
+        diversity cost failing).
+      collapse_frac: fraction of intra-class prototype pairs closer than
+        `collapse_tol` (euclidean).
+      sigma_floor_frac: fraction of sigma entries at or below `sigma_floor`
+        — the covariance-floor analogue for this model family (sigmas are
+        frozen by design, so nonzero here means a checkpoint/restore or
+        future trainable-sigma path drove them degenerate).
+      memory_occupancy: mean fill fraction of the per-class queues.
+      memory_full_frac: fraction of classes with a full queue (the EM
+        eligibility gate).
+      memory_updated_frac: fraction of classes touched since the last EM.
+    """
+    p = gmm.priors / jnp.maximum(
+        jnp.sum(gmm.priors, axis=-1, keepdims=True), eps
+    )
+    ent = -jnp.sum(jnp.where(p > 0, p * jnp.log(p + eps), 0.0), axis=-1)  # [C]
+
+    k = gmm.k_per_class
+    if k > 1:
+        sq = jax.vmap(pairwise_sq_dists)(gmm.means, gmm.means)  # [C, K, K]
+        off = 1.0 - jnp.eye(k)
+        sq_off = jnp.where(off > 0, sq, jnp.inf)
+        min_d = jnp.sqrt(jnp.maximum(jnp.min(sq_off), 0.0))
+        n_pairs = jnp.sum(off) * gmm.num_classes
+        collapse = jnp.sum(sq_off < collapse_tol**2) / n_pairs
+    else:
+        # a 1-component mixture has no pairs to collapse
+        min_d = jnp.zeros(())
+        collapse = jnp.zeros(())
+
+    cap = memory.capacity
+    return {
+        "prior_entropy_mean": jnp.mean(ent),
+        "prior_entropy_min": jnp.min(ent),
+        "min_interproto_dist": min_d,
+        "collapse_frac": collapse,
+        "sigma_floor_frac": jnp.mean(
+            (gmm.sigmas <= sigma_floor).astype(jnp.float32)
+        ),
+        "memory_occupancy": jnp.mean(memory.length / cap),
+        "memory_full_frac": jnp.mean(
+            (memory.length == cap).astype(jnp.float32)
+        ),
+        "memory_updated_frac": jnp.mean(memory.updated.astype(jnp.float32)),
+    }
+
+
 def make_mean_optimizer(cfg: EMConfig) -> optax.GradientTransformation:
     """Adam on the means (reference main.py:223-227; its StepLR is created but
     never stepped — main.py:229 — so the lr is constant)."""

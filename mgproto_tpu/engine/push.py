@@ -29,6 +29,7 @@ import numpy as np
 
 from mgproto_tpu.core.mgproto import GMMState, patch_log_densities
 from mgproto_tpu.core.state import TrainState
+from mgproto_tpu.telemetry.tracing import trace_span
 from mgproto_tpu.utils import vis
 from mgproto_tpu.utils.images import preprocess_input
 
@@ -161,20 +162,22 @@ def push_prototypes(
     all_vals: List[np.ndarray] = []
     all_idxs: List[np.ndarray] = []
     all_fvecs: List[np.ndarray] = []
-    for images, labels, image_ids in batches:
-        images = normalize(np.asarray(images, np.float32))
-        val, idx, fvec = scan(
-            params_h,
-            stats_h,
-            gmm_h,
-            jnp.asarray(images),
-            jnp.asarray(labels, jnp.int32),
-        )
-        all_labels.append(np.asarray(labels))
-        all_ids.append(np.asarray(image_ids))
-        all_vals.append(jax.device_get(val))
-        all_idxs.append(jax.device_get(idx))
-        all_fvecs.append(jax.device_get(fvec))
+    with trace_span("push/scan") as scan_attrs:
+        for images, labels, image_ids in batches:
+            images = normalize(np.asarray(images, np.float32))
+            val, idx, fvec = scan(
+                params_h,
+                stats_h,
+                gmm_h,
+                jnp.asarray(images),
+                jnp.asarray(labels, jnp.int32),
+            )
+            all_labels.append(np.asarray(labels))
+            all_ids.append(np.asarray(image_ids))
+            all_vals.append(jax.device_get(val))
+            all_idxs.append(jax.device_get(idx))
+            all_fvecs.append(jax.device_get(fvec))
+        scan_attrs["batches"] = len(all_labels)
 
     if not all_labels:
         raise ValueError("push set is empty")
@@ -188,7 +191,11 @@ def push_prototypes(
     fvecs = allgather_rows(np.concatenate(all_fvecs))
 
     c = state.gmm.num_classes
-    new_means, result = _greedy_assign(labels, image_ids, vals, idxs, fvecs, c)
+    with trace_span("push/assign") as assign_attrs:
+        new_means, result = _greedy_assign(
+            labels, image_ids, vals, idxs, fvecs, c
+        )
+        assign_attrs["pushed"] = int(result.pushed.sum())
 
     # write-back inside jit: state.gmm.means may be a cross-host-sharded
     # global array (outside-jit jnp.where cannot touch those); new_means /
@@ -210,7 +217,8 @@ def push_prototypes(
             else save_dir
         )
         vis.makedir(out)
-        _render(trainer, new_state, result, load_image, normalize, out)
+        with trace_span("push/render"):
+            _render(trainer, new_state, result, load_image, normalize, out)
 
     return new_state, result
 

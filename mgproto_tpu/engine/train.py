@@ -90,6 +90,16 @@ class Trainer:
             donate_argnums=(0,) if donate else (),
         )
         self._eval_step = jax.jit(self._eval)
+        # the live jit callables, for telemetry's recompile detection
+        # (StepMonitor reads their _cache_size deltas). ShardedTrainer
+        # rebinds this when it builds its sharded jits.
+        self._jit_handles = [self._train_step, self._eval_step]
+
+    @property
+    def jit_handles(self):
+        """Current jitted step callables (telemetry watches these for
+        cache-miss/recompile growth)."""
+        return list(self._jit_handles)
 
     def _resolve_fused(self, fused: Optional[bool]) -> bool:
         if fused is not None:
@@ -270,23 +280,37 @@ class Trainer:
             np.asarray(images, np.float32), np.asarray(labels, np.int32)
         ))
 
-    def train_epoch(self, state, batches, epoch: int):
+    def train_epoch(self, state, batches, epoch: int, monitor=None):
         """Drive one epoch over an iterable of (images, labels) host batches.
 
         Batches are device-prefetched (data/loader.py device_prefetch): batch
         N+1's host->device copy overlaps step N's compute — the first
         post-55.8%-MFU lever named in PERF.md.
 
+        `monitor` (a telemetry StepMonitor) observes each step: wall time,
+        throughput, batch transfer bytes, recompile detection. Each interval
+        runs from the END of the previous step call to the end of this one,
+        so loader/prefetch wait is charged to the step that waited — the
+        intervals sum to true epoch wall time and an input-bound epoch shows
+        up as slow steps, not as phantom throughput. Observation never syncs
+        the device: a single interval is dispatch+wait time, but the queue
+        must drain across the epoch, so EMA/throughput are honest in steady
+        state.
+
         The returned metrics are the LAST step's, except `em_active` and
         `full_mem_ratio`, which are epoch maxima: EM width varies per step
         with batch label composition (the step where queues first fill can
         touch every class at once), so a last-step sample would understate
         it. The max runs on-device (no per-step host sync)."""
+        import time
+
         from mgproto_tpu.data.loader import device_prefetch
+        from mgproto_tpu.telemetry.monitor import tree_transfer_bytes
 
         flags = self.epoch_flags(state, epoch)
         last = None
         em_max = fm_max = None
+        t_prev = time.perf_counter()
         for images, labels in device_prefetch(batches, self.put_batch):
             # already device-placed: train_step sees jax.Arrays and skips
             # its host-conversion path
@@ -298,6 +322,14 @@ class Trainer:
                 update_gmm=flags["update_gmm"],
                 warm=flags["warm"],
             )
+            if monitor is not None:
+                now = time.perf_counter()
+                monitor.observe_step(
+                    int(images.shape[0]),
+                    now - t_prev,
+                    transfer_bytes=tree_transfer_bytes((images, labels)),
+                )
+                t_prev = now
             em_max = (
                 last.em_active if em_max is None
                 else jnp.maximum(em_max, last.em_active)

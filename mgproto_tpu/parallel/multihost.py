@@ -30,6 +30,12 @@ import jax
 import numpy as np
 
 
+def is_primary_host() -> bool:
+    """True on the one process that owns run-wide side effects (telemetry
+    sinks, checkpoints' metadata): process 0. Single process: True."""
+    return jax.process_index() == 0
+
+
 def host_local_rows(arr: jax.Array) -> np.ndarray:
     """Rows of a leading-axis-sharded global array that live on THIS process,
     in ascending global-row order. Single process: the whole array."""

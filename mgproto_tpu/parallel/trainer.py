@@ -135,6 +135,9 @@ class ShardedTrainer(Trainer):
             in_shardings=(state_sh, self._batch_sh, self._batch_sh),
             out_shardings=eval_out_sh,
         )
+        # telemetry recompile detection must watch the REAL jit objects, not
+        # the dispatching lambda above (which has no _cache_size)
+        self._jit_handles = list(jits.values()) + [self._eval_step]
 
     def prepare(self, state: TrainState) -> TrainState:
         """Pin `state` to its mesh sharding (and build the sharded jits)."""

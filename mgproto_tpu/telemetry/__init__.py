@@ -1,0 +1,55 @@
+"""Telemetry subsystem: metric registry, tracing spans, step/health monitors.
+
+Layering (host-side; nothing here runs on device except the jitted health
+diagnostics in `core.em`):
+
+  registry  — process-wide counters/gauges/histograms with labels; JSONL
+              snapshot + Prometheus text sinks (`MetricRegistry`).
+  tracing   — nesting wall-clock spans with attributes; Chrome-trace JSON
+              export (`Tracer`, `trace_span`).
+  monitor   — `StepMonitor`: step latency EMA, images/sec, jit cache-miss /
+              recompile detection, host-transfer bytes.
+  health    — `ModelHealth`: per-epoch EM/prototype diagnostics (prior
+              entropy, collapse score, sigma floor, memory occupancy).
+  session   — `TelemetrySession`: wires the above to a telemetry directory
+              (metrics.prom / metrics.jsonl / health.jsonl / trace.json),
+              host-0-only sinks under multi-host.
+
+`cli.telemetry` (the `mgproto-telemetry` subcommand) summarizes a telemetry
+directory; `utils.log.Logger` / `MetricsWriter` are thin wrappers over the
+same plumbing so pre-telemetry call sites keep working.
+"""
+
+from mgproto_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlWriter,
+    MetricRegistry,
+    default_registry,
+    percentile_from_buckets,
+    write_jsonl_snapshot,
+)
+from mgproto_tpu.telemetry.tracing import Tracer, default_tracer, trace_span
+from mgproto_tpu.telemetry.monitor import StepMonitor, tree_transfer_bytes
+from mgproto_tpu.telemetry.health import ModelHealth
+from mgproto_tpu.telemetry.session import TelemetrySession, make_session
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlWriter",
+    "MetricRegistry",
+    "default_registry",
+    "percentile_from_buckets",
+    "write_jsonl_snapshot",
+    "Tracer",
+    "default_tracer",
+    "trace_span",
+    "StepMonitor",
+    "tree_transfer_bytes",
+    "ModelHealth",
+    "TelemetrySession",
+    "make_session",
+]

@@ -1,0 +1,78 @@
+"""ModelHealth: per-epoch EM/prototype diagnostics from a TrainState.
+
+MGProto's failure modes are model-health failures before they are loss
+failures: prototype collapse (duplicate means), mixture-prior entropy going
+to zero (one prototype owns a class), memory banks never filling (EM never
+fires), degenerate sigmas. The math lives in `core.em.em_health_diagnostics`
+(pure, jittable, returns scalars — so it runs SPMD over any mesh sharding
+and the host reads back replicated scalars); this class is the recording
+side: gauges in the registry + one JSONL record per call.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from mgproto_tpu.core.em import em_health_diagnostics
+from mgproto_tpu.telemetry.registry import (
+    JsonlWriter,
+    MetricRegistry,
+    default_registry,
+)
+
+_HEALTH_HELP = {
+    "prior_entropy_mean": "mean per-class mixture-prior entropy (nats)",
+    "prior_entropy_min": "min per-class mixture-prior entropy (nats)",
+    "min_interproto_dist": "smallest intra-class inter-prototype distance",
+    "collapse_frac": "fraction of intra-class prototype pairs within tol",
+    "sigma_floor_frac": "fraction of sigma entries at/below the floor",
+    "memory_occupancy": "mean per-class memory-queue fill fraction",
+    "memory_full_frac": "fraction of classes with a full memory queue",
+    "memory_updated_frac": "fraction of classes touched since last EM",
+}
+
+
+class ModelHealth:
+    """Computes + records health diagnostics; `record(state, epoch=...)`
+    returns the scalars as plain floats."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricRegistry] = None,
+        writer: Optional[JsonlWriter] = None,
+        collapse_tol: float = 1e-3,
+        sigma_floor: float = 1e-3,
+    ):
+        self.registry = registry if registry is not None else default_registry()
+        self.writer = writer
+        # tolerances are trace-time constants; one compiled diagnostic per
+        # (gmm/memory shape) thanks to jit's own cache
+        self._diag = jax.jit(
+            functools.partial(
+                em_health_diagnostics,
+                collapse_tol=collapse_tol,
+                sigma_floor=sigma_floor,
+            )
+        )
+        self.history: list = []
+
+    def record(
+        self, state: Any, epoch: Optional[int] = None, **extra
+    ) -> Dict[str, float]:
+        vals = jax.device_get(self._diag(state.gmm, state.memory))
+        out = {k: float(v) for k, v in vals.items()}
+        for k, v in out.items():
+            self.registry.gauge(f"model_{k}", _HEALTH_HELP.get(k, "")).set(v)
+        rec: Dict[str, Any] = {"time": time.time()}
+        if epoch is not None:
+            rec["epoch"] = int(epoch)
+        rec.update(extra)
+        rec.update(out)
+        self.history.append(rec)
+        if self.writer is not None:
+            self.writer.write(rec)
+        return out

@@ -1,0 +1,235 @@
+"""StepMonitor: per-step runtime instrumentation around jitted step calls.
+
+Records into the metric registry, per observed step:
+
+  * `step_time_seconds` histogram + `step_time_ema_seconds` gauge — host
+    wall time per step call. jax dispatch is async, so a single interval is
+    dispatch time; across an epoch the intervals sum to true wall time
+    (the queue must drain), which is what throughput is derived from.
+  * `images_per_sec` gauge (EMA-based) + `images_total` / `steps_total`
+    counters.
+  * `jit_recompiles_total` counter + `jit_cache_size` gauge — cache-miss /
+    recompilation detection via `_cache_size()` deltas on the watched
+    `jax.jit` functions ("Memory Safe Computations with XLA" (PAPERS.md):
+    compiler behavior must be observed, not assumed). The FIRST compile of
+    each variant counts too — a steady-state run therefore shows exactly
+    its number of compiled variants, and any later growth is a genuine
+    shape-driven retrace.
+  * `host_transfer_bytes_total` counter — host->device bytes for the step's
+    operands (`tree_transfer_bytes` of the batch).
+
+Compile-time cost analysis (FLOPs / bytes accessed of an AOT-compiled step)
+can be attached via `record_cost_analysis` — bench.py uses it so its
+telemetry block carries the compiled step's cost next to the measured times.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Iterable, List, Optional, Union
+
+from mgproto_tpu.telemetry.registry import (
+    MetricRegistry,
+    default_registry,
+)
+
+# a jit fn, or a zero-arg provider returning jit fns (re-resolved every
+# check, so ShardedTrainer's lazily (re)built jits are picked up)
+WatchTarget = Union[Callable, Callable[[], Iterable[Callable]]]
+
+
+def tree_transfer_bytes(tree: Any) -> int:
+    """Total nbytes of the array leaves of a pytree-ish value (host or
+    device arrays; anything with .nbytes counts, scalars don't)."""
+    total = 0
+    stack = [tree]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, (tuple, list)):
+            stack.extend(x)
+        elif isinstance(x, dict):
+            stack.extend(x.values())
+        else:
+            nbytes = getattr(x, "nbytes", None)
+            if nbytes is not None:
+                total += int(nbytes)
+    return total
+
+
+def _cache_size(fn: Callable) -> Optional[int]:
+    """Compiled-variant count of a jax.jit callable; None when the wrapper
+    (or a plain function) doesn't expose one."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+class StepMonitor:
+    """Wraps step calls: `with monitor.step(n_images, batch): ...` or
+    explicit `observe_step(n_images, seconds, ...)`."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricRegistry] = None,
+        ema_alpha: float = 0.1,
+        phase: str = "train",
+    ):
+        self.registry = registry if registry is not None else default_registry()
+        self.ema_alpha = float(ema_alpha)
+        self.phase = phase
+        self._watched: List[WatchTarget] = []
+        self._last_sizes: dict = {}
+        self._ema: Optional[float] = None
+        self._epoch_images = 0
+        self._epoch_seconds = 0.0
+        r = self.registry
+        self._h_step = r.histogram(
+            "step_time_seconds", "per-step host wall time"
+        )
+        self._g_ema = r.gauge(
+            "step_time_ema_seconds", "EMA of per-step wall time"
+        )
+        self._g_ips = r.gauge(
+            "images_per_sec", "instantaneous throughput (from the step EMA)"
+        )
+        self._c_steps = r.counter("steps_total", "steps observed")
+        self._c_images = r.counter("images_total", "images processed")
+        self._c_recompiles = r.counter(
+            "jit_recompiles_total",
+            "jit cache misses on watched step functions (first compiles "
+            "included)",
+        )
+        self._g_cache = r.gauge(
+            "jit_cache_size", "total compiled variants across watched jits"
+        )
+        self._c_transfer = r.counter(
+            "host_transfer_bytes_total", "host->device bytes for step operands"
+        )
+
+    # ------------------------------------------------------------- recompiles
+    def watch(self, *targets: WatchTarget) -> "StepMonitor":
+        """Watch jit fns (or zero-arg providers of them) for cache growth."""
+        self._watched.extend(targets)
+        return self
+
+    def _resolve(self) -> List[Callable]:
+        fns: List[Callable] = []
+        for t in self._watched:
+            if _cache_size(t) is not None:
+                fns.append(t)
+            else:
+                try:
+                    fns.extend(t())
+                except TypeError:
+                    fns.append(t)  # un-introspectable fn: counted as size None
+        return fns
+
+    def check_recompiles(self) -> int:
+        """Cache-size delta across watched jits since the last check;
+        increments `jit_recompiles_total` and returns the delta."""
+        new = 0
+        total = 0
+        for fn in self._resolve():
+            size = _cache_size(fn)
+            if size is None:
+                continue
+            total += size
+            prev = self._last_sizes.get(id(fn), 0)
+            if size > prev:
+                new += size - prev
+            self._last_sizes[id(fn)] = size
+        self._g_cache.set(total, phase=self.phase)
+        if new:
+            self._c_recompiles.inc(new, phase=self.phase)
+        return new
+
+    @property
+    def recompile_count(self) -> int:
+        return int(self._c_recompiles.value(phase=self.phase))
+
+    # ------------------------------------------------------------------ steps
+    def observe_step(
+        self,
+        n_images: int,
+        seconds: float,
+        transfer_bytes: int = 0,
+        check_recompiles: bool = True,
+    ) -> None:
+        ph = self.phase
+        self._h_step.observe(seconds, phase=ph)
+        self._ema = (
+            seconds
+            if self._ema is None
+            else self.ema_alpha * seconds + (1 - self.ema_alpha) * self._ema
+        )
+        self._g_ema.set(self._ema, phase=ph)
+        if self._ema > 0:
+            self._g_ips.set(n_images / self._ema, phase=ph)
+        self._c_steps.inc(1, phase=ph)
+        self._c_images.inc(n_images, phase=ph)
+        if transfer_bytes:
+            self._c_transfer.inc(transfer_bytes, phase=ph)
+        self._epoch_images += int(n_images)
+        self._epoch_seconds += float(seconds)
+        if check_recompiles:
+            self.check_recompiles()
+
+    @contextlib.contextmanager
+    def step(self, n_images: int, batch: Any = None):
+        """Time a step call: `with monitor.step(len(images), (images, labels)):
+        state, m = trainer.train_step(...)`."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe_step(
+                n_images,
+                time.perf_counter() - t0,
+                transfer_bytes=tree_transfer_bytes(batch) if batch is not None else 0,
+            )
+
+    @property
+    def ema_seconds(self) -> Optional[float]:
+        return self._ema
+
+    # ------------------------------------------------------------------ epoch
+    def begin_epoch(self) -> None:
+        self._epoch_images = 0
+        self._epoch_seconds = 0.0
+
+    @property
+    def epoch_images(self) -> int:
+        return self._epoch_images
+
+    @property
+    def epoch_seconds(self) -> float:
+        return self._epoch_seconds
+
+    # ---------------------------------------------------------- cost analysis
+    def record_cost_analysis(self, compiled: Any) -> None:
+        """Pull FLOPs / bytes-accessed gauges from a compiled module's XLA
+        cost analysis (best effort: some PJRT plugins return none)."""
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+        except Exception:
+            return
+        if not ca:
+            return
+        flops = ca.get("flops")
+        if flops and flops > 0:
+            self.registry.gauge(
+                "step_flops", "compiled step FLOPs (XLA cost analysis)"
+            ).set(float(flops), phase=self.phase)
+        nbytes = ca.get("bytes accessed")
+        if nbytes and nbytes > 0:
+            self.registry.gauge(
+                "step_bytes_accessed",
+                "compiled step bytes accessed (XLA cost analysis)",
+            ).set(float(nbytes), phase=self.phase)

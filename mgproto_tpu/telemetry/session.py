@@ -1,0 +1,150 @@
+"""TelemetrySession: one run's telemetry wiring + on-disk artifacts.
+
+Owns (or borrows) a registry and tracer, a StepMonitor and a ModelHealth
+recorder, and writes a telemetry directory:
+
+    metrics.prom    latest Prometheus text snapshot (atomic overwrite)
+    metrics.jsonl   one registry snapshot per flush (summarize input)
+    health.jsonl    one ModelHealth record per epoch
+    trace.json      Chrome-trace export of the span tracer
+
+Multi-host: every process computes (SPMD steps and the scalar health
+diagnostics need all hosts), but ONLY host 0 sinks to disk — the other
+processes keep their writers None, so the artifact set is exactly one
+directory per run, not one per host. Cross-host throughput goes through
+`parallel.multihost.allgather_sum` in `end_epoch` (every process must call
+it: it is a collective).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from mgproto_tpu.telemetry.health import ModelHealth
+from mgproto_tpu.telemetry.monitor import StepMonitor
+from mgproto_tpu.telemetry.registry import (
+    JsonlWriter,
+    MetricRegistry,
+    write_jsonl_snapshot,
+)
+from mgproto_tpu.telemetry.tracing import Tracer
+
+PROM_FILE = "metrics.prom"
+METRICS_FILE = "metrics.jsonl"
+HEALTH_FILE = "health.jsonl"
+TRACE_FILE = "trace.json"
+
+
+def _is_primary_host() -> bool:
+    from mgproto_tpu.parallel.multihost import is_primary_host
+
+    return is_primary_host()
+
+
+class TelemetrySession:
+    def __init__(
+        self,
+        out_dir: str,
+        registry: Optional[MetricRegistry] = None,
+        tracer: Optional[Tracer] = None,
+        primary: Optional[bool] = None,
+    ):
+        self.out_dir = out_dir
+        # a FRESH registry/tracer per session (unless the caller brings
+        # their own), installed as process-current so classic call sites
+        # (timed_span, MetricsWriter mirroring, engine trace_span) route
+        # into THIS session — and a second run in the same process starts
+        # from zero instead of exporting the first run's totals and spans.
+        # close() restores whatever was current before.
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.tracer = tracer if tracer is not None else Tracer()
+        from mgproto_tpu.telemetry.registry import set_current_registry
+        from mgproto_tpu.telemetry.tracing import set_current_tracer
+
+        self._prev_registry = set_current_registry(self.registry)
+        self._prev_tracer = set_current_tracer(self.tracer)
+        self.primary = _is_primary_host() if primary is None else bool(primary)
+        self._closed = False
+        metrics_writer = None
+        health_writer = None
+        if self.primary:
+            os.makedirs(out_dir, exist_ok=True)
+            metrics_writer = JsonlWriter(os.path.join(out_dir, METRICS_FILE))
+            health_writer = JsonlWriter(os.path.join(out_dir, HEALTH_FILE))
+        self._metrics_writer = metrics_writer
+        self.monitor = StepMonitor(registry=self.registry)
+        self.health = ModelHealth(registry=self.registry, writer=health_writer)
+        self._g_epoch_ips = self.registry.gauge(
+            "epoch_images_per_sec_global",
+            "whole-epoch throughput summed across hosts",
+        )
+        self._g_epoch = self.registry.gauge("epoch", "last completed epoch")
+
+    def span(self, name: str, **attrs):
+        return self.tracer.span(name, **attrs)
+
+    # ------------------------------------------------------------------ sinks
+    def flush(self, step: Optional[int] = None, extra: Optional[Dict] = None):
+        """Write the current registry + trace state (primary host only)."""
+        if not self.primary or self._closed:
+            return
+        self.registry.write_prometheus(os.path.join(self.out_dir, PROM_FILE))
+        if self._metrics_writer is not None:
+            write_jsonl_snapshot(
+                self.registry, self._metrics_writer, step=step, extra=extra
+            )
+        self.tracer.export_chrome_trace(os.path.join(self.out_dir, TRACE_FILE))
+
+    def end_epoch(
+        self,
+        state: Any,
+        epoch: int,
+        step: Optional[int] = None,
+        aggregate: bool = True,
+    ) -> Dict[str, float]:
+        """Per-epoch bookkeeping: ModelHealth record, global throughput from
+        the monitor's epoch accumulators (allgather across hosts when
+        `aggregate` — EVERY process must make this call then), flush, and
+        reset of the epoch accumulators. Returns the health scalars."""
+        local_images = float(self.monitor.epoch_images)
+        seconds = self.monitor.epoch_seconds
+        if aggregate:
+            from mgproto_tpu.parallel.multihost import allgather_sum
+
+            images = allgather_sum(local_images)
+        else:
+            images = local_images
+        if seconds > 0:
+            self._g_epoch_ips.set(images / seconds)
+        self._g_epoch.set(epoch)
+        health = self.health.record(state, epoch=epoch)
+        self.flush(step=step, extra={"epoch": int(epoch)})
+        self.monitor.begin_epoch()
+        return health
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.flush()
+        if self._metrics_writer is not None:
+            self._metrics_writer.close()
+        if self.health.writer is not None:
+            self.health.writer.close()
+        self._closed = True
+        # restore whatever registry/tracer was current before this session
+        from mgproto_tpu.telemetry.registry import set_current_registry
+        from mgproto_tpu.telemetry.tracing import set_current_tracer
+
+        set_current_registry(self._prev_registry)
+        set_current_tracer(self._prev_tracer)
+
+
+def make_session(
+    telemetry_dir: str, enabled: bool = True, **kw
+) -> Optional[TelemetrySession]:
+    """`None` when disabled — call sites guard with `if telem:`."""
+    if not enabled or not telemetry_dir:
+        return None
+    return TelemetrySession(telemetry_dir, **kw)

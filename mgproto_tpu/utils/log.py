@@ -1,111 +1,151 @@
-"""Logging / metrics / profiling.
+"""Logging / metrics / profiling — thin wrappers over `mgproto_tpu.telemetry`.
 
-Covers the reference's three observability channels (SURVEY.md §5.5):
-file logger with periodic fsync (reference utils/log.py:4-17), wandb scalar
-streams (reference train_and_test.py:73-80 — disabled by default there,
-main.py:53; here a local JSONL stream with the same keys), and wall-clock
-spans (reference train_and_test.py:17,87-89). Adds what the reference lacks:
-a `jax.profiler` trace harness for real TPU profiling.
+Covers the reference's three observability channels (SURVEY.md §5.5): file
+logger with periodic fsync (reference utils/log.py:4-17), wandb scalar
+streams (reference train_and_test.py:73-80 — here a local JSONL stream with
+the same keys), and wall-clock spans (reference train_and_test.py:17,87-89).
+
+These classes predate the telemetry subsystem and stay for their call sites
+and tests; the machinery is telemetry's: the file core is
+`telemetry.registry.JsonlWriter` (batched flush+fsync, write-after-close
+guard), `MetricsWriter` mirrors every numeric scalar into the process
+metric registry (so the run's Prometheus/JSONL snapshots carry loss/acc/...
+without new call sites), and `timed_span` records a real tracing span on
+the default tracer in addition to its log line. The deeper instrumentation
+— step monitors, model health, Chrome traces — lives in `telemetry/`.
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
-import os
 import sys
 import time
 from typing import Any, Dict, Optional
 
+from mgproto_tpu.telemetry.registry import (
+    JsonlWriter,
+    MetricRegistry,
+    default_registry,
+)
+from mgproto_tpu.telemetry.tracing import trace_span
+
 
 class Logger:
     """Append-file + stdout logger, fsync every `flush_every` lines
-    (reference utils/log.py:4-17 closure, as a class with close())."""
+    (reference utils/log.py:4-17 closure, as a class with close()).
+    Logging after `close()` still prints but never touches the closed
+    file (the old implementation could raise `ValueError: I/O operation
+    on closed file` from late callers, e.g. an exception handler logging
+    after the normal shutdown path ran)."""
 
     def __init__(self, log_path: Optional[str], flush_every: int = 10):
         self.path = log_path
-        self.flush_every = flush_every
-        self._count = 0
-        self._f = None
-        if log_path:
-            os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
-            self._f = open(log_path, "a")
+        self._w = JsonlWriter(log_path, flush_every=flush_every)
 
     def log(self, message: str) -> None:
         print(message)
         sys.stdout.flush()
-        if self._f is None:
-            return
-        self._f.write(message + "\n")
-        self._count += 1
-        if self._count % self.flush_every == 0:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+        self._w.write_line(message)
 
     __call__ = log
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.flush()
-            self._f.close()
-            self._f = None
+        self._w.close()
 
 
 class MetricsWriter:
     """JSONL scalar stream — the local stand-in for the reference's wandb
     channel (reference main.py:53-54, train_and_test.py:73-80). One JSON
-    object per `write()`, always stamped with step and wall time."""
+    object per `write()`, always stamped with step and wall time; fsync is
+    batched (every `flush_every` writes) like `Logger`, not per line. The
+    tradeoff is explicit: a hard kill (no close()) can lose up to
+    `flush_every - 1` buffered records — callers streaming at epoch cadence
+    who need per-record durability should pass `flush_every=1`.
 
-    def __init__(self, path: Optional[str]):
+    Every numeric scalar is also mirrored into the metric registry as a
+    `run_<key>` gauge, so telemetry's Prometheus/JSONL sinks see the same
+    stream without a second call site."""
+
+    def __init__(
+        self,
+        path: Optional[str],
+        flush_every: int = 10,
+        registry: Optional[MetricRegistry] = None,
+    ):
         self.path = path
-        self._f = None
-        if path:
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            self._f = open(path, "a")
+        # None = resolve per write: the process-CURRENT registry, so a
+        # TelemetrySession installed after this writer is constructed still
+        # receives the mirrored scalars
+        self._registry = registry
+        self._w = JsonlWriter(path, flush_every=flush_every)
+
+    @property
+    def registry(self) -> MetricRegistry:
+        return self._registry if self._registry is not None else default_registry()
 
     def write(self, step: int, scalars: Dict[str, Any]) -> None:
-        if self._f is None:
+        if self.path is None:
             return
         rec = {"step": int(step), "time": time.time()}
         for k, v in scalars.items():
-            if isinstance(v, (str, bool, type(None))):
+            if isinstance(v, (str, bool, type(None), dict, list, tuple)):
                 rec[k] = v
                 continue
             try:
                 rec[k] = float(v)
             except (TypeError, ValueError):
                 rec[k] = str(v)
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+            else:
+                try:
+                    self.registry.gauge(f"run_{k}").set(rec[k])
+                except ValueError:
+                    pass  # key not a legal metric name; JSONL still has it
+        self._w.write(rec)
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        self._w.close()
 
 
 @contextlib.contextmanager
 def timed_span(logger: Logger, name: str):
-    """Wall-clock span (reference train_and_test.py:17,87-89 semantics)."""
+    """Wall-clock span (reference train_and_test.py:17,87-89 semantics).
+    Also records a nesting tracing span on the default tracer, so runs
+    driven through the classic call sites still produce a Chrome trace."""
     t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        logger.log(f"\t{name} time: \t{time.perf_counter() - t0:.2f}s")
+    with trace_span(name):
+        try:
+            yield
+        finally:
+            logger.log(f"\t{name} time: \t{time.perf_counter() - t0:.2f}s")
 
 
 @contextlib.contextmanager
-def profiler_trace(logdir: Optional[str]):
+def profiler_trace(logdir: Optional[str], create_perfetto_link: bool = False):
     """jax.profiler trace around a block; no-op when logdir is falsy.
     View with TensorBoard / xprof. The reference has no profiler hooks
-    (SURVEY.md §5.1) — this is the TPU-native upgrade."""
+    (SURVEY.md §5.1) — this is the TPU-native upgrade.
+
+    Exception-safe: `stop_trace` runs only if `start_trace` succeeded, and
+    a `stop_trace` failure during exception unwind never masks the body's
+    exception. `create_perfetto_link=True` passes through to jax (prints a
+    Perfetto UI link when the trace closes; older jax without the kwarg
+    falls back silently)."""
     if not logdir:
         yield
         return
     import jax
 
-    jax.profiler.start_trace(logdir)
+    try:
+        jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    except TypeError:
+        # jax predating the kwarg
+        jax.profiler.start_trace(logdir)
     try:
         yield
-    finally:
-        jax.profiler.stop_trace()
+    except BaseException:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass  # don't mask the body's exception with a stop failure
+        raise
+    jax.profiler.stop_trace()

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Lint: no bare `print(` calls inside mgproto_tpu/ library code.
+
+Library modules must log through `utils.log.Logger` (or take a `log=`
+callable, as engine/evaluate.py does) so output reaches the run's log file
+and telemetry, not just whichever stdout happens to be attached. Allowed:
+
+  * mgproto_tpu/cli/   — drivers own their stdout (JSON result lines etc.)
+  * mgproto_tpu/utils/log.py — the Logger implementation itself prints
+
+AST-based, so `print` inside strings/comments (e.g. probe.py's child
+source) and `log=print` default arguments don't trip it; only actual
+`print(...)` call sites do. Run from anywhere:
+
+    python scripts/check_no_print.py [repo_root]
+
+Exit 0 when clean, 1 with one `path:line` per offender otherwise. Wired
+into tier-1 via tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, Tuple
+
+ALLOWED_DIRS = ("cli",)
+ALLOWED_FILES = (os.path.join("utils", "log.py"),)
+
+
+def _print_calls(tree: ast.AST) -> Iterator[int]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield node.lineno
+
+
+def offenders(repo_root: str) -> Iterator[Tuple[str, int]]:
+    pkg = os.path.join(repo_root, "mgproto_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg)
+            if rel in ALLOWED_FILES or rel.split(os.sep)[0] in ALLOWED_DIRS:
+                continue
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    yield (os.path.relpath(path, repo_root), e.lineno or 0)
+                    continue
+            for lineno in _print_calls(tree):
+                yield (os.path.relpath(path, repo_root), lineno)
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = list(offenders(root))
+    for path, lineno in found:
+        print(f"{path}:{lineno}: bare print() in library code "
+              f"(use utils.log.Logger or a log= callable)")
+    if found:
+        return 1
+    print("check_no_print: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,557 @@
+"""Telemetry subsystem: registry semantics, span nesting + Chrome-trace
+round trip, recompile detection, ModelHealth on hand-built states, the
+Logger/MetricsWriter wrapper contracts, the no-print lint, and the
+summarize subcommand. Marker-free: all of this is tier-1."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.telemetry import (
+    MetricRegistry,
+    ModelHealth,
+    StepMonitor,
+    TelemetrySession,
+    Tracer,
+    percentile_from_buckets,
+    tree_transfer_bytes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ registry
+def test_counter_gauge_semantics():
+    r = MetricRegistry()
+    c = r.counter("requests_total", "help text")
+    c.inc(2, phase="train")
+    c.inc(phase="train")
+    c.inc(5, phase="eval")
+    assert c.value(phase="train") == 3
+    assert c.value(phase="eval") == 5
+    assert c.value(phase="missing") == 0
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = r.gauge("temp")
+    g.set(1.5)
+    g.set(2.5)  # last write wins
+    assert g.value() == 2.5
+
+    # same name, different type is a registration error
+    with pytest.raises(TypeError):
+        r.gauge("requests_total")
+    # invalid names rejected
+    with pytest.raises(ValueError):
+        r.counter('bad name{}"')
+
+
+def test_histogram_buckets_and_percentiles():
+    r = MetricRegistry()
+    h = r.histogram("lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot_series()
+    assert snap["count"] == 4
+    assert snap["bucket_counts"] == [1, 2, 1, 0]  # le .1, 1, 10, +Inf
+    assert snap["sum"] == pytest.approx(6.05)
+    assert snap["min"] == 0.05 and snap["max"] == 5.0
+    p50 = h.percentile(50)
+    assert 0.1 <= p50 <= 1.0
+    # estimates are clamped to the observed range
+    assert h.percentile(0) >= 0.05
+    assert h.percentile(100) == 5.0
+    assert h.percentile(50, phase="never") is None
+    assert percentile_from_buckets({"count": 0}, 50) is None
+
+
+def test_prometheus_text_rendering():
+    r = MetricRegistry()
+    r.counter("steps_total", "steps").inc(3, phase="train")
+    r.gauge("ips").set(120.5)
+    r.histogram("lat", buckets=(0.1, 1.0)).observe(0.5, phase="x")
+    text = r.to_prometheus()
+    assert "# TYPE steps_total counter" in text
+    assert 'steps_total{phase="train"} 3' in text
+    assert "# HELP steps_total steps" in text
+    assert "ips 120.5" in text
+    assert 'lat_bucket{phase="x",le="0.1"} 0' in text
+    assert 'lat_bucket{phase="x",le="+Inf"} 1' in text
+    assert 'lat_count{phase="x"} 1' in text
+    # snapshot is JSON-able and carries the same series
+    snap = r.snapshot()
+    json.dumps(snap)
+    assert snap["steps_total"]["type"] == "counter"
+
+
+# ------------------------------------------------------------------- tracing
+def test_span_nesting_and_chrome_trace_roundtrip(tmp_path):
+    t = Tracer()
+    with t.span("epoch", epoch=3):
+        with t.span("train"):
+            with t.span("step"):
+                pass
+        with t.span("test"):
+            pass
+    spans = {s["name"]: s for s in t.spans()}
+    assert spans["epoch"]["depth"] == 0 and spans["epoch"]["parent"] == -1
+    assert spans["train"]["parent"] == spans["epoch"]["id"]
+    assert spans["step"]["parent"] == spans["train"]["id"]
+    assert spans["step"]["depth"] == 2
+    assert spans["test"]["parent"] == spans["epoch"]["id"]
+    assert spans["epoch"]["attrs"] == {"epoch": 3}
+    # children are contained in the parent's [ts, ts+dur] window
+    for child in ("train", "test"):
+        assert spans[child]["ts"] >= spans["epoch"]["ts"]
+        assert (
+            spans[child]["ts"] + spans[child]["dur"]
+            <= spans["epoch"]["ts"] + spans["epoch"]["dur"] + 1e-9
+        )
+
+    path = str(tmp_path / "trace.json")
+    t.export_chrome_trace(path)
+    with open(path) as f:
+        data = json.load(f)
+    events = data["traceEvents"]
+    assert len(events) == 4
+    by_name = {e["name"]: e for e in events}
+    assert by_name["step"]["ph"] == "X"
+    assert by_name["step"]["args"]["depth"] == 2
+    assert by_name["epoch"]["args"]["epoch"] == 3
+    # µs timestamps preserve containment
+    e, s = by_name["epoch"], by_name["step"]
+    assert e["ts"] <= s["ts"] and s["ts"] + s["dur"] <= e["ts"] + e["dur"] + 1
+
+
+def test_tracer_span_closes_on_exception_and_caps():
+    t = Tracer(max_spans=2)
+    with pytest.raises(RuntimeError):
+        with t.span("outer"):
+            raise RuntimeError("boom")
+    assert t.spans()[0]["name"] == "outer"
+    with t.span("a"):
+        pass
+    with t.span("b"):
+        pass
+    assert len(t.spans()) == 2 and t.dropped == 1
+
+
+# ------------------------------------------------------------------- monitor
+def test_recompile_detection_fires_exactly_once_on_shape_change():
+    r = MetricRegistry()
+    mon = StepMonitor(registry=r)
+    f = jax.jit(lambda x: x * 2)
+    mon.watch(f)
+    f(jnp.ones((2,)))
+    assert mon.check_recompiles() == 1  # the first compile is a miss too
+    f(jnp.ones((2,)))
+    assert mon.check_recompiles() == 0  # cache hit
+    f(jnp.ones((3,)))  # deliberate shape change
+    assert mon.check_recompiles() == 1  # fires exactly once
+    assert mon.check_recompiles() == 0  # and not again
+    assert mon.recompile_count == 2
+    assert r.gauge("jit_cache_size").value(phase="train") == 2
+
+
+def test_step_monitor_observe_and_epoch_accumulators():
+    r = MetricRegistry()
+    mon = StepMonitor(registry=r, ema_alpha=0.5)
+    mon.observe_step(8, 0.1, transfer_bytes=100)
+    mon.observe_step(8, 0.3, transfer_bytes=100)
+    assert mon.ema_seconds == pytest.approx(0.2)
+    assert r.counter("steps_total").value(phase="train") == 2
+    assert r.counter("images_total").value(phase="train") == 16
+    assert r.counter("host_transfer_bytes_total").value(phase="train") == 200
+    assert r.gauge("images_per_sec").value(phase="train") == pytest.approx(40.0)
+    assert mon.epoch_images == 16
+    assert mon.epoch_seconds == pytest.approx(0.4)
+    mon.begin_epoch()
+    assert mon.epoch_images == 0
+
+    with mon.step(4, batch=(np.zeros((4, 2), np.float32),)):
+        pass
+    assert r.counter("images_total").value(phase="train") == 20
+    assert r.counter("host_transfer_bytes_total").value(phase="train") == 232
+
+
+def test_tree_transfer_bytes():
+    imgs = np.zeros((2, 4, 4, 3), np.float32)
+    lbls = np.zeros((2,), np.int32)
+    assert tree_transfer_bytes((imgs, lbls)) == imgs.nbytes + lbls.nbytes
+    assert tree_transfer_bytes({"a": [imgs], "b": 3}) == imgs.nbytes
+
+
+# -------------------------------------------------------------- model health
+@pytest.fixture(scope="module")
+def tiny_state():
+    from mgproto_tpu.engine import Trainer
+
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=2)
+    return cfg, trainer.init_state(jax.random.PRNGKey(0))
+
+
+def test_model_health_collapsed_vs_spread(tiny_state):
+    cfg, state = tiny_state
+    health = ModelHealth(registry=MetricRegistry())
+    base = health.record(state, epoch=0)
+    # fresh init: distinct prototypes, uniform priors, empty memory
+    k = cfg.model.prototypes_per_class
+    assert base["prior_entropy_mean"] == pytest.approx(np.log(k), rel=1e-4)
+    assert base["min_interproto_dist"] > 1e-2
+    assert base["collapse_frac"] == 0.0
+    assert base["memory_occupancy"] == 0.0
+    assert base["sigma_floor_frac"] == 0.0
+
+    # hand-collapse: every prototype of class 0 = the same vector, and a
+    # one-hot prior on class 1
+    means = np.asarray(state.gmm.means).copy()
+    means[0] = means[0][0]
+    priors = np.asarray(state.gmm.priors).copy()
+    priors[1] = 0.0
+    priors[1, 0] = 1.0
+    collapsed = state.replace(
+        gmm=state.gmm._replace(
+            means=jnp.asarray(means), priors=jnp.asarray(priors)
+        )
+    )
+    got = health.record(collapsed, epoch=1)
+    assert got["min_interproto_dist"] == 0.0
+    # class 0's K*(K-1) identical pairs out of C*K*(K-1) total
+    assert got["collapse_frac"] == pytest.approx(1.0 / cfg.model.num_classes)
+    assert got["prior_entropy_min"] == pytest.approx(0.0, abs=1e-6)
+    assert got["prior_entropy_mean"] < base["prior_entropy_mean"]
+    # history kept in order for trajectory rendering
+    assert [r["epoch"] for r in health.history] == [0, 1]
+
+
+def test_model_health_memory_occupancy(tiny_state):
+    from tests.conftest import prefill_full_memory
+
+    _, state = tiny_state
+    health = ModelHealth(registry=MetricRegistry())
+    full = health.record(prefill_full_memory(state))
+    assert full["memory_occupancy"] == 1.0
+    assert full["memory_full_frac"] == 1.0
+    assert full["memory_updated_frac"] == 1.0
+
+
+def test_degenerate_sigma_hits_floor(tiny_state):
+    _, state = tiny_state
+    health = ModelHealth(registry=MetricRegistry(), sigma_floor=1e-3)
+    bad = state.replace(
+        gmm=state.gmm._replace(sigmas=jnp.zeros_like(state.gmm.sigmas))
+    )
+    assert health.record(bad)["sigma_floor_frac"] == 1.0
+
+
+# ------------------------------------------------------- session + summarize
+def test_session_artifacts_and_summarize(tmp_path, capsys):
+    d = str(tmp_path / "telemetry")
+    sess = TelemetrySession(d, registry=MetricRegistry(), tracer=Tracer())
+    f = jax.jit(lambda x: x + 1)
+    sess.monitor.watch(f)
+    with sess.span("epoch", epoch=0):
+        with sess.span("train"):
+            f(jnp.ones((2,)))
+            sess.monitor.observe_step(8, 0.05, transfer_bytes=64)
+
+    class _FakeState:  # duck-typed: health only reads .gmm / .memory
+        pass
+
+    from mgproto_tpu.core.memory import init_memory
+    from mgproto_tpu.core.mgproto import init_gmm
+
+    cfg = tiny_test_config()
+    fake = _FakeState()
+    fake.gmm = init_gmm(cfg.model, jax.random.PRNGKey(0))
+    fake.memory = init_memory(4, 8, cfg.model.proto_dim)
+    sess.end_epoch(fake, epoch=0, step=1)
+    sess.close()
+
+    prom = open(os.path.join(d, "metrics.prom")).read()
+    names = {
+        ln.split()[2] for ln in prom.splitlines() if ln.startswith("# TYPE")
+    }
+    assert len(names) >= 8, names  # the acceptance floor, at one epoch
+    assert os.path.isfile(os.path.join(d, "trace.json"))
+    assert os.path.isfile(os.path.join(d, "health.jsonl"))
+    snapshots = [
+        json.loads(l) for l in open(os.path.join(d, "metrics.jsonl"))
+    ]
+    assert snapshots and "metrics" in snapshots[-1]
+
+    # double close is safe; writes after close drop silently
+    sess.close()
+    sess.flush()
+
+    from mgproto_tpu.cli.telemetry import main as telemetry_main
+
+    telemetry_main([d, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["steps"]["steps_total"] == 1
+    assert out["recompiles"]["jit_recompiles_total"] == 1
+    assert out["health"]["records"] == 1
+    assert "epoch" in out["spans"] and "train" in out["spans"]
+
+    # table mode renders without error on the same dir (and accepts the
+    # parent run dir)
+    telemetry_main([str(tmp_path)])
+    table = capsys.readouterr().out
+    assert "steps_total" in table and "model health" in table
+
+
+def test_sessions_isolate_runs_in_one_process(tmp_path):
+    """Two sequential sessions in one process (a sweep driver, tests) must
+    produce independent artifacts: each installs a fresh process-current
+    registry/tracer, classic call sites (timed_span) route into the LIVE
+    session, and close() restores the previous current."""
+    from mgproto_tpu.telemetry import default_registry
+    from mgproto_tpu.utils.log import Logger, timed_span
+
+    prev_reg = default_registry()
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+
+    s1 = TelemetrySession(d1)
+    assert default_registry() is s1.registry  # installed as current
+    with timed_span(Logger(None), "probe_one"):
+        pass
+    s1.monitor.observe_step(4, 0.1)
+    s1.close()
+    assert default_registry() is prev_reg  # restored
+
+    s2 = TelemetrySession(d2)
+    with timed_span(Logger(None), "probe_two"):
+        pass
+    s2.monitor.observe_step(2, 0.1)
+    s2.close()
+
+    names1 = {
+        e["name"]
+        for e in json.load(open(os.path.join(d1, "trace.json")))["traceEvents"]
+    }
+    names2 = {
+        e["name"]
+        for e in json.load(open(os.path.join(d2, "trace.json")))["traceEvents"]
+    }
+    assert "probe_one" in names1 and "probe_two" not in names1
+    assert "probe_two" in names2 and "probe_one" not in names2
+    snap2 = [
+        json.loads(l) for l in open(os.path.join(d2, "metrics.jsonl"))
+    ][-1]["metrics"]
+    total = sum(s["value"] for s in snap2["steps_total"]["series"])
+    assert total == 1  # run 2's counters started from zero
+
+
+def test_summarize_empty_dir_is_graceful(tmp_path, capsys):
+    from mgproto_tpu.cli.telemetry import main as telemetry_main
+
+    telemetry_main([str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["snapshots"] == 0
+
+
+# ------------------------------------------- Logger / MetricsWriter wrappers
+def test_logger_write_after_close_is_guarded(tmp_path, capsys):
+    from mgproto_tpu.utils.log import Logger
+
+    path = str(tmp_path / "train.log")
+    log = Logger(path, flush_every=2)
+    log("one")
+    log.close()
+    log("after close")  # must not raise, still prints
+    log.close()  # idempotent
+    assert open(path).read().splitlines() == ["one"]
+    assert "after close" in capsys.readouterr().out
+    assert log._w.dropped == 1
+
+
+def test_metrics_writer_batches_fsync(tmp_path, monkeypatch):
+    from mgproto_tpu.utils.log import MetricsWriter
+
+    fsyncs = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: fsyncs.append(fd) or real_fsync(fd))
+    path = str(tmp_path / "m.jsonl")
+    mw = MetricsWriter(path, flush_every=5, registry=MetricRegistry())
+    for i in range(4):
+        mw.write(i, {"loss": 1.0 / (i + 1)})
+    assert fsyncs == []  # batched: below the flush threshold, no fsync yet
+    mw.write(4, {"loss": 0.2})
+    assert len(fsyncs) == 1  # the 5th line triggered exactly one
+    mw.close()
+    recs = [json.loads(l) for l in open(path)]
+    assert len(recs) == 5 and recs[0]["step"] == 0 and "time" in recs[0]
+    mw.write(9, {"loss": 0.1})  # after close: dropped, not raised
+    assert len(open(path).read().splitlines()) == 5
+
+
+def test_metrics_writer_mirrors_scalars_into_registry(tmp_path):
+    from mgproto_tpu.utils.log import MetricsWriter
+
+    reg = MetricRegistry()
+    mw = MetricsWriter(str(tmp_path / "m.jsonl"), registry=reg)
+    mw.write(3, {"loss": 0.5, "note": "text", "nested": {"a": 1}})
+    mw.close()
+    assert reg.gauge("run_loss").value() == 0.5
+    rec = json.loads(open(str(tmp_path / "m.jsonl")).read())
+    assert rec["note"] == "text" and rec["nested"] == {"a": 1}
+
+
+def test_timed_span_records_tracing_span(capsys):
+    from mgproto_tpu.telemetry import default_tracer
+    from mgproto_tpu.utils.log import Logger, timed_span
+
+    t = default_tracer()
+    before = len(t.spans())
+    with timed_span(Logger(None), "unit_probe"):
+        pass
+    spans = t.spans()
+    assert len(spans) == before + 1 and spans[-1]["name"] == "unit_probe"
+    assert "unit_probe time:" in capsys.readouterr().out
+
+
+def test_profiler_trace_failed_start_does_not_stop(monkeypatch):
+    from mgproto_tpu.utils.log import profiler_trace
+
+    calls = []
+
+    class FakeProfiler:
+        def start_trace(self, logdir, create_perfetto_link=False):
+            calls.append(("start", create_perfetto_link))
+            raise RuntimeError("profiler backend unavailable")
+
+        def stop_trace(self):
+            calls.append(("stop", None))
+
+    import jax as jax_mod
+
+    monkeypatch.setattr(jax_mod, "profiler", FakeProfiler())
+    with pytest.raises(RuntimeError, match="unavailable"):
+        with profiler_trace("/tmp/anywhere", create_perfetto_link=True):
+            pass
+    # the failed start must NOT be followed by a stop_trace attempt
+    assert calls == [("start", True)]
+
+
+def test_profiler_trace_stop_failure_does_not_mask_body_exception(monkeypatch):
+    from mgproto_tpu.utils.log import profiler_trace
+
+    class FakeProfiler:
+        def start_trace(self, logdir, create_perfetto_link=False):
+            pass
+
+        def stop_trace(self):
+            raise RuntimeError("stop failed")
+
+    import jax as jax_mod
+
+    monkeypatch.setattr(jax_mod, "profiler", FakeProfiler())
+    with pytest.raises(ValueError, match="the real error"):
+        with profiler_trace("/tmp/anywhere"):
+            raise ValueError("the real error")
+    # and with a healthy body, the stop failure itself surfaces
+    with pytest.raises(RuntimeError, match="stop failed"):
+        with profiler_trace("/tmp/anywhere"):
+            pass
+
+
+# ----------------------------------------------------------------- lint gate
+def test_no_bare_print_in_library_code():
+    """The tier-1 wiring of scripts/check_no_print.py: the lint must pass on
+    the repo as-is."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_no_print.py"),
+         REPO],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_no_print_lint_catches_planted_offender(tmp_path):
+    pkg = tmp_path / "mgproto_tpu"
+    (pkg / "cli").mkdir(parents=True)
+    (pkg / "engine").mkdir()
+    (pkg / "engine" / "bad.py").write_text(
+        "def f():\n    print('offender')\n"
+    )
+    (pkg / "cli" / "ok.py").write_text("print('drivers may print')\n")
+    (pkg / "strings.py").write_text("SRC = \"print('in a string')\"\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_no_print.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "engine/bad.py:2" in proc.stdout.replace(os.sep, "/")
+    assert "ok.py" not in proc.stdout and "strings.py" not in proc.stdout
+
+
+# ------------------------------------------------ end-to-end telemetry smoke
+def test_trainer_epoch_with_monitor_and_shape_change_recompile(tmp_path):
+    """The acceptance-shaped smoke without the data pipeline: a monitored
+    tiny Trainer run whose second epoch uses a different batch shape must
+    produce the full artifact set, a nonzero recompile count that grows on
+    the shape change, and a per-epoch health record — and the summarize
+    subcommand renders it."""
+    from mgproto_tpu.engine import Trainer
+
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=2)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+
+    d = str(tmp_path / "telemetry")
+    sess = TelemetrySession(d, registry=MetricRegistry(), tracer=Tracer())
+    sess.monitor.watch(lambda: trainer.jit_handles)
+
+    rng = np.random.RandomState(0)
+
+    def make_batch(b):
+        return (
+            rng.rand(b, cfg.model.img_size, cfg.model.img_size, 3).astype(
+                np.float32
+            ),
+            rng.randint(0, cfg.model.num_classes, size=(b,)).astype(np.int32),
+        )
+
+    with sess.span("epoch", epoch=0):
+        state, _ = trainer.train_epoch(
+            state, iter([make_batch(8), make_batch(8)]), 0,
+            monitor=sess.monitor,
+        )
+    sess.end_epoch(state, epoch=0, step=int(state.step))
+    first_epoch_recompiles = sess.monitor.recompile_count
+    assert first_epoch_recompiles >= 1  # the first compile
+
+    # deliberately shape-varying second epoch
+    with sess.span("epoch", epoch=1):
+        state, _ = trainer.train_epoch(
+            state, iter([make_batch(4)]), 1, monitor=sess.monitor
+        )
+    sess.end_epoch(state, epoch=1, step=int(state.step))
+    assert sess.monitor.recompile_count == first_epoch_recompiles + 1
+    sess.close()
+
+    prom = open(os.path.join(d, "metrics.prom")).read()
+    names = {
+        ln.split()[2] for ln in prom.splitlines() if ln.startswith("# TYPE")
+    }
+    assert len(names) >= 8, names
+    trace = json.load(open(os.path.join(d, "trace.json")))
+    assert len(trace["traceEvents"]) >= 2
+    health = [json.loads(l) for l in open(os.path.join(d, "health.jsonl"))]
+    assert [r["epoch"] for r in health] == [0, 1]
+
+    from mgproto_tpu.cli.telemetry import summarize
+
+    out = summarize(d)
+    assert out["recompiles"]["jit_recompiles_total"] >= 2
+    assert out["steps"]["steps_total"] == 3
+    assert out["health"]["records"] == 2
