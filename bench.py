@@ -94,6 +94,11 @@ ATTEMPT_TIMEOUT_S = _env_int("BENCH_ATTEMPT_TIMEOUT_S", 420)
 DEADLINE_S = _env_int("BENCH_DEADLINE_S", 900)  # whole-run cap
 PROBE_TIMEOUT_S = _env_int("BENCH_PROBE_TIMEOUT_S", 75)
 PROBE_ATTEMPTS = _env_int("BENCH_PROBE_ATTEMPTS", 2)
+# staleness bound on the cached-fallback result (ADVICE r5): beyond this
+# age a dead relay must not keep presenting an old watcher capture as a
+# healthy exit — the line is still emitted (flagged "stale": true) but the
+# process exits 1 so the driver sees the failure
+CACHED_MAX_AGE_S = _env_int("BENCH_CACHED_MAX_AGE_S", 4 * 86400)
 _START = time.monotonic()
 
 # Each measurement attempt runs in a CHILD process: SIGALRM cannot interrupt a
@@ -608,16 +613,34 @@ def _ts_key(ts) -> tuple:
         return (-1, 0, str(ts))
 
 
+def _cached_age_s(cached: dict) -> float:
+    """Age of a cached result in seconds; +inf for unparseable stamps (an
+    unknown age must count as stale, not as fresh)."""
+    kind, epoch, _ = _ts_key(cached.get("measured_at"))
+    if kind != 0:
+        return float("inf")
+    return max(0.0, time.time() - epoch)
+
+
 def _fail(error_obj: dict) -> None:
     """Terminal failure path: emit the live diagnostics, then — if a watcher
     window ever captured a real number — the cached result as the final line
     so the driver artifact is never numberless when a genuine number exists.
-    Exit 0 iff a (cached) number was emitted."""
+    Exit 0 iff a FRESH (age <= BENCH_CACHED_MAX_AGE_S) cached number was
+    emitted; a stale one is still emitted for reference but flagged
+    "stale": true with exit 1, so a long-dead relay cannot keep reporting
+    months-old numbers as a healthy run (ADVICE r5)."""
     cached = _cached_result()
     if cached is None:
         _emit(error_obj)
         raise SystemExit(1)
     cached["live_error"] = error_obj.get("error")
+    age = _cached_age_s(cached)
+    cached["cached_age_s"] = None if age == float("inf") else round(age, 1)
+    if age > CACHED_MAX_AGE_S:
+        cached["stale"] = True
+        _emit(cached)
+        raise SystemExit(1)
     _emit(cached)
     raise SystemExit(0)
 

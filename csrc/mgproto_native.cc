@@ -135,6 +135,9 @@ void mg_jitter_brightness(const uint8_t* src, int64_t n_px, float factor,
 // Contrast: blend(solid gray at round(mean(L)), img, factor).
 void mg_jitter_contrast(const uint8_t* src, int64_t n_px, float factor,
                         uint8_t* out) {
+  // zero-pixel guard: sum/n_px would be NaN and the float->int cast of NaN
+  // is undefined behavior (ADVICE r5). Nothing to write either way.
+  if (n_px <= 0) return;
   double sum = 0.0;  // ImageStat sums the integer L histogram
   for (int64_t i = 0; i < n_px; ++i) sum += luma_u8(src + 3 * i);
   const float gray =

@@ -121,7 +121,31 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="data-axis size (-1 = all devices)")
     p.add_argument("--mesh_model", type=int, default=1)
     p.add_argument("--resume", default="",
-                   help="checkpoint path, or 'auto' for latest in model_dir")
+                   help="checkpoint path, or 'auto' for the latest "
+                        "manifest-verified checkpoint in model_dir "
+                        "(preempted runs resume mid-epoch, bit-exactly)")
+    # fault tolerance (resilience subsystem; README 'Fault tolerance')
+    p.add_argument("--max-bad-steps", "--max_bad_steps",
+                   dest="max_bad_steps", type=int, default=3,
+                   help="divergence guard: consecutive non-finite steps "
+                        "(updates are skipped in-step) before rolling back "
+                        "to the last good checkpoint (0 disables rollback)")
+    p.add_argument("--divergence_check_every", type=int, default=8,
+                   help="host-sync cadence (steps) of the divergence streak "
+                        "poll and multi-host preemption agreement")
+    p.add_argument("--max_rollbacks", type=int, default=2,
+                   help="divergence rollbacks before the run gives up")
+    p.add_argument("--keep_last", type=int, default=0,
+                   help="checkpoint retention: keep only the newest N "
+                        "checkpoints (plus --keep_best by accuracy); "
+                        "0 keeps everything")
+    p.add_argument("--keep_best", type=int, default=1,
+                   help="always-retained best-accuracy checkpoints when "
+                        "--keep_last is active")
+    p.add_argument("--no_preempt_handlers", action="store_true",
+                   help="do not install SIGTERM/SIGINT graceful-preemption "
+                        "handlers (default: installed; first signal "
+                        "checkpoints + exits 0, second kills)")
     p.add_argument("--profile_dir", default="",
                    help="write a jax.profiler trace of one epoch here")
     # telemetry (metric registry + tracing spans + step/health monitors);

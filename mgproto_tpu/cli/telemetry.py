@@ -40,11 +40,29 @@ HEALTH_TRAJECTORY_KEYS = (
 )
 
 
+def _is_telemetry_dir(path: str) -> bool:
+    """True when `path` holds TelemetrySession artifacts. A run's model_dir
+    ALSO contains a metrics.jsonl (the MetricsWriter train-metrics stream,
+    one scalar dict per step — no "metrics" key), so the jsonl name alone
+    cannot identify a telemetry dir: check the unambiguous artifacts first,
+    then the shape of the first parseable jsonl record."""
+    for name in (PROM_FILE, HEALTH_FILE, TRACE_FILE):
+        if os.path.isfile(os.path.join(path, name)):
+            return True
+    m = os.path.join(path, METRICS_FILE)
+    if os.path.isfile(m):
+        with open(m) as f:
+            for line in f:
+                try:
+                    return "metrics" in json.loads(line)
+                except ValueError:
+                    continue
+    return False
+
+
 def resolve_dir(path: str) -> str:
     """Accept a telemetry dir directly or a run dir containing telemetry/."""
-    if os.path.isfile(os.path.join(path, METRICS_FILE)) or os.path.isfile(
-        os.path.join(path, HEALTH_FILE)
-    ):
+    if _is_telemetry_dir(path):
         return path
     sub = os.path.join(path, "telemetry")
     if os.path.isdir(sub):
@@ -118,7 +136,7 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     d = resolve_dir(telemetry_dir)
     snapshots = _read_jsonl(os.path.join(d, METRICS_FILE))
     health = _read_jsonl(os.path.join(d, HEALTH_FILE))
-    last = snapshots[-1]["metrics"] if snapshots else {}
+    last = snapshots[-1].get("metrics", {}) if snapshots else {}
 
     summary: Dict[str, Any] = {
         "telemetry_dir": os.path.abspath(d),
@@ -157,6 +175,17 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
         "jit_recompiles_total": _series_value(last, "jit_recompiles_total"),
         "jit_cache_size": _series_value(last, "jit_cache_size"),
     }
+
+    # recovery events (resilience subsystem): retries, sentinel rows,
+    # skipped non-finite steps, rollbacks, preemption saves, chaos faults
+    from mgproto_tpu.resilience.metrics import ALL_COUNTERS
+
+    resilience = {
+        name: _series_value(last, name)
+        for name in ALL_COUNTERS
+    }
+    if any(v is not None for v in resilience.values()):
+        summary["resilience"] = resilience
 
     if health:
         traj = {}
@@ -235,6 +264,10 @@ def render_table(summary: Dict[str, Any]) -> str:
     section("recompiles")
     for k, v in summary.get("recompiles", {}).items():
         rows.append((k, v))
+    if "resilience" in summary:
+        section("resilience (recovery events)")
+        for k, v in summary["resilience"].items():
+            rows.append((k, v))
     if "health" in summary:
         h = summary["health"]
         section(

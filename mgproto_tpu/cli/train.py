@@ -9,6 +9,16 @@ Differences by design: checkpoints carry the FULL train state and `--resume`
 continues bit-exactly (the reference deletes its model dir on restart,
 main.py:31-33); the step runs SPMD over the configured mesh; metrics stream
 to a local JSONL instead of wandb.
+
+Fault tolerance (ISSUE 2): the epoch loop is wrapped in a recovery driver —
+SIGTERM/SIGINT (or a chaos-simulated preemption) finishes the in-flight
+step, saves an unconditional "preempt" checkpoint recording the mid-epoch
+position, writes a PREEMPTED.json marker and returns cleanly, so the next
+`--resume auto` invocation continues bit-exactly; `--max-bad-steps`
+consecutive non-finite steps (updates already skipped inside the jitted
+step) roll the run back to the last good checkpoint and replay it (the
+loaders are (seed, epoch)-deterministic, so the replay is exact). Chaos
+injection for drills comes from MGPROTO_CHAOS_* env knobs (see --help).
 """
 
 from __future__ import annotations
@@ -32,10 +42,13 @@ from mgproto_tpu.core.mgproto import prune_top_m
 from mgproto_tpu.data import build_pipelines
 from mgproto_tpu.engine import evaluate, evaluate_with_ood, push_prototypes
 from mgproto_tpu.parallel import ShardedTrainer
+from mgproto_tpu.resilience import chaos as chaos_mod
+from mgproto_tpu.resilience import metrics as res_metrics
+from mgproto_tpu.resilience import preemption
+from mgproto_tpu.resilience.guard import DivergenceError, EpochGuard
 from mgproto_tpu.utils import (
     Logger,
     MetricsWriter,
-    latest_checkpoint,
     restore_checkpoint,
     save_state_w_condition,
     timed_span,
@@ -43,7 +56,12 @@ from mgproto_tpu.utils import (
 from mgproto_tpu.telemetry import make_session, trace_span
 from mgproto_tpu.utils.checkpoint import (
     adopt_checkpoint_train_config,
+    apply_retention,
+    checkpoint_name,
+    find_latest_checkpoint,
+    latest_checkpoint,
     load_metadata,
+    save_checkpoint,
 )
 from mgproto_tpu.utils.log import profiler_trace
 
@@ -74,15 +92,48 @@ def run_training(
     render_push: bool = True,
     telemetry_dir: str = "",
     telemetry: bool = True,
+    max_bad_steps: int = 3,
+    divergence_check_every: int = 8,
+    max_rollbacks: int = 2,
+    keep_last: int = 0,
+    keep_best: int = 1,
+    chaos=None,
 ):
-    """Run the full schedule; returns (final_state, last_test_accuracy)."""
+    """Run the full schedule; returns (final_state, last_test_accuracy).
+
+    Recovery knobs: `max_bad_steps` consecutive non-finite steps trigger a
+    rollback to the last good checkpoint (0 disables; at most
+    `max_rollbacks` before giving up); `divergence_check_every` is the
+    host-sync cadence of the streak poll; `keep_last`/`keep_best` drive
+    checkpoint retention (keep_last <= 0 keeps everything); `chaos` is an
+    optional resilience.ChaosState for fault-injection drills (its one-shot
+    bookkeeping intentionally survives across invocations, so a resumed
+    run does not re-inject). A preemption (signal or chaos) checkpoints and
+    returns early — check `resilience.get_handler().requested()`."""
     # resolve --resume FIRST: a typo'd path must fail fast, before any
-    # data-pipeline or device work happens
+    # data-pipeline or device work happens. 'auto' resumes only from
+    # manifest-verified checkpoints (torn saves and .tmp dirs never qualify)
     resume_path = None
+    legacy_resume_note = ""
     if resume:
-        resume_path = latest_checkpoint(cfg.model_dir) if resume == "auto" else resume
-        if resume != "auto" and not os.path.exists(resume_path):
-            raise FileNotFoundError(resume_path)
+        if resume == "auto":
+            resume_path = find_latest_checkpoint(cfg.model_dir)
+            if resume_path is None:
+                # pre-manifest (legacy) checkpoints never qualify for the
+                # strict listing; silently retraining from scratch in the
+                # same model_dir would discard their progress — fall back,
+                # loudly
+                resume_path = latest_checkpoint(cfg.model_dir)
+                if resume_path is not None:
+                    legacy_resume_note = (
+                        f"note: resuming manifest-less legacy checkpoint "
+                        f"{resume_path} (integrity cannot be verified; "
+                        "newer saves carry a manifest)"
+                    )
+        else:
+            resume_path = resume
+            if not os.path.exists(resume_path):
+                raise FileNotFoundError(resume_path)
     adoption_notes: list = []
     if resume_path:
         # resume under the checkpoint's own training-time settings: without
@@ -94,6 +145,8 @@ def run_training(
 
     os.makedirs(cfg.model_dir, exist_ok=True)
     log = Logger(os.path.join(cfg.model_dir, "train.log"))
+    if legacy_resume_note:
+        log(legacy_resume_note)
     for note in adoption_notes:
         # adoption ran before the Logger existed; the overrides it made are
         # exactly the decisions a run's own log must record
@@ -112,6 +165,7 @@ def run_training(
         jax.random.PRNGKey(cfg.seed), for_restore=bool(resume_path)
     )
     start_epoch = 0
+    skip_batches = 0
     if resume_path:
         meta = load_metadata(resume_path) or {}
         state = trainer.prepare(restore_checkpoint(resume_path, state))
@@ -120,8 +174,20 @@ def run_training(
             metrics.close()
             log.close()
             return state, float(meta.get("accuracy", 0.0))
-        start_epoch = int(meta.get("epoch", -1)) + 1
-        log(f"resumed {resume_path} -> epoch {start_epoch}")
+        if meta.get("stage") == "preempt":
+            # mid-epoch resume: re-enter the SAME epoch, skipping the
+            # batches the preempted invocation already applied (the loader's
+            # (seed, epoch)-deterministic order makes this bit-exact)
+            start_epoch = int(meta.get("epoch", 0))
+            skip_batches = int(meta.get("batch_in_epoch", 0))
+            log(
+                f"resumed preempted {resume_path} -> epoch {start_epoch} "
+                f"(skipping {skip_batches} completed batches)"
+            )
+        else:
+            start_epoch = int(meta.get("epoch", -1)) + 1
+            log(f"resumed {resume_path} -> epoch {start_epoch}")
+        preemption.clear_marker(cfg.model_dir)
 
     img_dir = os.path.join(cfg.model_dir, "img")
     # persisted so eval/interpret adopt the training-time trunk numerics
@@ -150,40 +216,141 @@ def run_training(
     if telem:
         telem.monitor.watch(lambda: trainer.jit_handles)
 
+    # recovery wiring: preemption flag (signal handlers, if any, are
+    # installed by main(); chaos raises the same flag), active chaos state,
+    # multi-host stop agreement
+    handler = preemption.get_handler()
+    handler.reset()
+    prev_chaos = None
+    chaos_installed = chaos is not None
+    if chaos_installed:
+        prev_chaos = chaos_mod.set_active(chaos)
+    multihost = jax.process_count() > 1
+
     log("start training")
+    preempted = False
+    rollbacks = 0
     try:
-        for epoch in range(start_epoch, cfg.schedule.num_train_epochs):
-            state, accu = _run_epoch(
-                cfg, trainer, state, epoch, start_epoch, profile_dir,
-                train_loader, test_loader, push_loader, push_ds, ood_loaders,
-                log, metrics, telem, run_meta, img_dir, render_push,
-                target_accu,
+        epoch = start_epoch
+        while epoch < cfg.schedule.num_train_epochs:
+            # pin the loader's epoch so resume/rollback replays see the SAME
+            # shuffle + augmentation streams an uninterrupted run would
+            train_loader.epoch = epoch
+            guard = EpochGuard(
+                max_bad_steps=max_bad_steps,
+                check_every=divergence_check_every,
+                chaos=chaos_mod.get_active(),
+                preemption=handler,
+                already_done=skip_batches,
+                multihost=multihost,
             )
+            try:
+                state, accu = _run_epoch(
+                    cfg, trainer, state, epoch, start_epoch, profile_dir,
+                    train_loader, test_loader, push_loader, push_ds,
+                    ood_loaders, log, metrics, telem, run_meta, img_dir,
+                    render_push, target_accu, guard, skip_batches,
+                )
+            except DivergenceError as e:
+                rollbacks += 1
+                res_metrics.counter(res_metrics.ROLLBACKS).inc()
+                if rollbacks > max_rollbacks:
+                    log(f"rollback budget exhausted ({max_rollbacks}); giving up")
+                    raise
+                last_good = find_latest_checkpoint(cfg.model_dir)
+                if last_good is None:
+                    raise RuntimeError(
+                        f"{e}; no checkpoint to roll back to — adjust the "
+                        "config (lower lr / check the data) and restart"
+                    ) from e
+                log(f"{e}; rolling back to {last_good} "
+                    f"({rollbacks}/{max_rollbacks})")
+                target = trainer.init_state(
+                    jax.random.PRNGKey(cfg.seed), for_restore=True
+                )
+                state = trainer.prepare(restore_checkpoint(last_good, target))
+                rb_meta = load_metadata(last_good) or {}
+                if rb_meta.get("stage") == "preempt":
+                    epoch = int(rb_meta.get("epoch", 0))
+                    skip_batches = int(rb_meta.get("batch_in_epoch", 0))
+                else:
+                    epoch = int(rb_meta.get("epoch", -1)) + 1
+                    skip_batches = 0  # a stale mid-epoch skip would drop
+                    # batches the restored state never saw
+                continue  # replay from the restored position
+            skip_batches = 0
+
+            if guard.preempted:
+                # preemption: the in-flight step finished inside train_epoch;
+                # save the FULL state unconditionally (no accuracy gate — a
+                # preempted epoch has no test score yet), record the
+                # mid-epoch position, leave the marker, exit cleanly
+                preempted = True
+                name = checkpoint_name(epoch, "preempt", max(accu, 0.0))
+                path = save_checkpoint(
+                    cfg.model_dir, state, name,
+                    metadata={
+                        **run_meta,
+                        "epoch": epoch,
+                        "stage": "preempt",
+                        "accuracy": accu,
+                        "batch_in_epoch": guard.batches_done,
+                        "reason": handler.reason or "",
+                    },
+                )
+                res_metrics.counter(res_metrics.PREEMPTION_SAVES).inc()
+                from mgproto_tpu.parallel.multihost import is_primary_host
+
+                if is_primary_host():
+                    preemption.write_marker(
+                        cfg.model_dir, path, reason=handler.reason or "",
+                        extra={"epoch": epoch,
+                               "batch_in_epoch": guard.batches_done},
+                    )
+                if telem:
+                    telem.flush(step=int(state.step),
+                                extra={"event": "preemption"})
+                log(
+                    f"preempted ({handler.reason}); saved {path} at epoch "
+                    f"{epoch} batch {guard.batches_done}; resume with "
+                    "--resume auto"
+                )
+                break
+
             if telem:
                 telem.end_epoch(state, epoch=epoch, step=int(state.step))
+            if keep_last > 0:
+                apply_retention(cfg.model_dir, keep_last, keep_best)
+            epoch += 1
 
-        # pruning (reference main.py:285-287); top_m can't exceed K per class
-        last_epoch = max(cfg.schedule.num_train_epochs - 1, start_epoch)
-        top_m = min(cfg.schedule.prune_top_m, cfg.model.prototypes_per_class)
-        state = state.replace(
-            gmm=prune_top_m(
-                state.gmm, top_m, renormalize=cfg.schedule.prune_renormalize
+        if not preempted:
+            # pruning (reference main.py:285-287); top_m <= K per class
+            last_epoch = max(cfg.schedule.num_train_epochs - 1, start_epoch)
+            top_m = min(
+                cfg.schedule.prune_top_m, cfg.model.prototypes_per_class
             )
-        )
-        with trace_span("prune"):
-            accu, test_results = _test(
-                trainer, state, test_loader, ood_loaders, log
+            state = state.replace(
+                gmm=prune_top_m(
+                    state.gmm, top_m,
+                    renormalize=cfg.schedule.prune_renormalize,
+                )
             )
-        metrics.write(
-            int(state.step),
-            {"epoch": last_epoch, "stage": "prune", **test_results},
-        )
-        save_state_w_condition(
-            cfg.model_dir, state, last_epoch, "prune", accu, target_accu,
-            metadata=run_meta,
-        )
-        log("training done")
+            with trace_span("prune"):
+                accu, test_results = _test(
+                    trainer, state, test_loader, ood_loaders, log
+                )
+            metrics.write(
+                int(state.step),
+                {"epoch": last_epoch, "stage": "prune", **test_results},
+            )
+            save_state_w_condition(
+                cfg.model_dir, state, last_epoch, "prune", accu, target_accu,
+                metadata=run_meta,
+            )
+            log("training done")
     finally:
+        if chaos_installed:
+            chaos_mod.set_active(prev_chaos)
         if telem:
             telem.close()
         metrics.close()
@@ -195,9 +362,17 @@ def _run_epoch(
     cfg, trainer, state, epoch, start_epoch, profile_dir,
     train_loader, test_loader, push_loader, push_ds, ood_loaders,
     log, metrics, telem, run_meta, img_dir, render_push, target_accu,
+    guard=None, skip_batches=0,
 ):
     """One epoch of the reference main.py flow (train / test / conditional
-    push), under an `epoch` tracing span so the stage spans nest."""
+    push), under an `epoch` tracing span so the stage spans nest.
+
+    `guard` carries the recovery policy (divergence rollback raises out of
+    here; a preemption stop returns early with the trained-so-far state and
+    no test pass — the caller checkpoints it). `skip_batches` > 0 re-enters
+    a preempted epoch mid-way."""
+    import itertools
+
     with trace_span("epoch", epoch=epoch):
         log(f"epoch: \t{epoch}")
         flags = trainer.epoch_flags(state, epoch)
@@ -209,26 +384,39 @@ def _run_epoch(
             if (profile_dir and epoch == start_epoch)
             else contextlib.nullcontext()
         )
+        batches = _labeled(train_loader)
+        if skip_batches:
+            # mid-epoch resume: drop the batches the preempted invocation
+            # already applied (decode cost only; identical sample streams)
+            batches = itertools.islice(batches, skip_batches, None)
         with timed_span(log, "train"), trace:
             state, last = trainer.train_epoch(
-                state, _labeled(train_loader), epoch,
+                state, batches, epoch,
                 monitor=telem.monitor if telem else None,
+                guard=guard,
             )
         if last is not None:
             m = jax.device_get(last._asdict())
             if not np.isfinite(float(m["loss"])):
-                # failure detection the reference lacks (SURVEY.md §5.2/§5.3):
-                # stop with state intact rather than training on NaNs; the
-                # last good checkpoint in model_dir is the resume point
-                last_ckpt = latest_checkpoint(cfg.model_dir)
-                hint = (
-                    f"resume from {last_ckpt} with --resume auto"
-                    if last_ckpt
-                    else "no checkpoint was saved yet; adjust the config"
-                )
-                raise RuntimeError(
-                    f"non-finite loss {float(m['loss'])} at epoch {epoch} "
-                    f"(step {int(state.step)}); {hint}"
+                if guard is None:
+                    # failure detection the reference lacks (SURVEY.md
+                    # §5.2/§5.3): with no guard wired in, stop with state
+                    # intact rather than training on NaNs
+                    last_ckpt = latest_checkpoint(cfg.model_dir)
+                    hint = (
+                        f"resume from {last_ckpt} with --resume auto"
+                        if last_ckpt
+                        else "no checkpoint was saved yet; adjust the config"
+                    )
+                    raise RuntimeError(
+                        f"non-finite loss {float(m['loss'])} at epoch {epoch} "
+                        f"(step {int(state.step)}); {hint}"
+                    )
+                # guarded: the update was skipped inside the step; counters
+                # carry the event and the divergence policy decides rollback
+                log(
+                    f"\tnon-finite loss at step {int(state.step)} — update "
+                    "skipped (divergence guard)"
                 )
             log(
                 "\tloss: {loss:.4f}  ce: {cross_entropy:.4f}  mine: {mine:.4f}"
@@ -240,6 +428,10 @@ def _run_epoch(
                 int(state.step),
                 {"epoch": epoch, **{k: float(v) for k, v in m.items()}},
             )
+        if guard is not None and guard.preempted:
+            # no test pass on a preempted epoch: the caller saves the state
+            # and the resumed invocation finishes the epoch properly
+            return state, 0.0
 
         with timed_span(log, "test"):
             accu, test_results = _test(
@@ -275,14 +467,34 @@ def _run_epoch(
     return state, accu
 
 
+CHAOS_ENV_HELP = """\
+chaos-injection env knobs (fault drills; all off by default):
+  MGPROTO_CHAOS_SEED            seed for the deterministic fault schedule
+  MGPROTO_CHAOS_LOADER_IO_RATE  fraction of sample loads that raise IOError
+  MGPROTO_CHAOS_LOADER_IO_FAILS attempts each chosen sample fails (1 =
+                                transient, heals on first retry)
+  MGPROTO_CHAOS_NAN_AT_STEP     NaN-poison the batch of this global step
+  MGPROTO_CHAOS_PREEMPT_AT_STEP simulate SIGTERM at this global step
+  MGPROTO_CHAOS_CKPT_FAILS      fail the first N checkpoint writes
+"""
+
+
 def main(argv: Optional[list] = None) -> None:
     p = argparse.ArgumentParser(
-        description="Train MGProto-TPU (reference main.py equivalent)"
+        description="Train MGProto-TPU (reference main.py equivalent)",
+        epilog=CHAOS_ENV_HELP,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     add_train_args(p)
     args = p.parse_args(argv)
     maybe_init_distributed(args)
     cfg = config_from_args(args)
+    # graceful preemption: SIGTERM/SIGINT finish the in-flight step,
+    # checkpoint, and exit 0 (the ONLY signal-handler install site)
+    if not args.no_preempt_handlers:
+        preemption.install_handlers()
+    chaos_plan = chaos_mod.plan_from_env()
+    chaos_state = chaos_mod.ChaosState(chaos_plan) if chaos_plan else None
     run_training(
         cfg,
         resume=args.resume,
@@ -290,7 +502,15 @@ def main(argv: Optional[list] = None) -> None:
         target_accu=args.target_accu,
         telemetry_dir=args.telemetry_dir,
         telemetry=not args.no_telemetry,
+        max_bad_steps=args.max_bad_steps,
+        divergence_check_every=args.divergence_check_every,
+        max_rollbacks=args.max_rollbacks,
+        keep_last=args.keep_last,
+        keep_best=args.keep_best,
+        chaos=chaos_state,
     )
+    # a preempted run exits 0: the scheduler sees a clean shutdown and the
+    # marker file + checkpoint make the next invocation resume bit-exactly
 
 
 if __name__ == "__main__":

@@ -18,8 +18,23 @@ Two backends (`worker_backend`):
     children (jax explicitly does not support it); spawn children import a
     fresh interpreter and never touch jax. The dataset is pickled ONCE into
     each worker (initializer), not per task; only finished (img, label, id)
-    tuples cross IPC afterwards. Worker death surfaces as a RuntimeError
-    after a generous per-sample timeout instead of a silent hang.
+    tuples cross IPC afterwards.
+
+Self-healing (ISSUE 2): a failing sample load retries with exponential
+backoff + deterministic jitter inside `_load_sample` (transient NFS/GCS
+hiccups heal invisibly; retries count into
+`resilience_retries_total{scope="loader"}`); a sample that exhausts its
+retries is SUBSTITUTED by a sentinel row (zero image, label -1 — counted in
+`loader_sentinel_rows_total`, never fatal: one rotted JPEG must not kill a
+pod run). A process worker that never returns (OOM-kill, segfault) no
+longer raises RuntimeError: the pool is RESTARTED once per incident
+(`loader_worker_restarts_total`) and the lost sample is recovered in-parent
+through the same deterministic `_load_sample` path, so the batch content is
+identical to an incident-free run. Process-backend caveat: retries happen
+inside spawn workers whose metric registry is separate, so parent telemetry
+sees sentinel substitutions and pool restarts but NOT worker-side retry
+counts (thread/sync backends count everything); chaos loader-IO injection
+IS re-armed inside workers (the pool initializer ships the plan).
 
 Determinism: sample i of epoch e is transformed with a generator seeded by
 (seed, epoch, sample index) — reproducible regardless of worker scheduling
@@ -32,20 +47,72 @@ from __future__ import annotations
 import multiprocessing
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+# per-sample retry budget: attempts = retries + 1, backoff base * 2^k with
+# deterministic jitter (seeded by sample identity, so a chaos-injected run
+# is bit-reproducible)
+_SAMPLE_RETRIES = 3
+_RETRY_BASE_DELAY_S = 0.05
+_RETRY_MAX_DELAY_S = 2.0
 
-def _load_sample(dataset, seed: int, index: int, epoch: int):
+# IPC-safe marker for a sample that failed every attempt: compared by VALUE
+# (a spawn worker's module object differs from the parent's, so an `is`
+# sentinel would not survive pickling)
+_FAILED = "__mgproto_load_failed__"
+
+
+def _count(name: str, amount: float = 1.0, **labels) -> None:
+    """Resilience counter inc (lazy import: spawn workers touch this module
+    before the parent package finishes importing; telemetry is jax-free)."""
+    from mgproto_tpu.resilience import metrics as _m
+
+    _m.counter(name).inc(amount, **labels)
+
+
+def _load_sample(dataset, seed: int, index: int, epoch: int,
+                 retries: int = _SAMPLE_RETRIES):
     """The ONE sample-load path both backends share: deterministic per
-    (seed, epoch, index), so backends are interchangeable mid-experiment."""
+    (seed, epoch, index), so backends are interchangeable mid-experiment.
+
+    Retries transient load failures with backoff + seeded jitter; returns
+    (`_FAILED`, index, repr(err)) after the budget is exhausted — the
+    parent substitutes a sentinel row and counts it."""
     if index < 0:  # sentinel pad row (multi-host tail alignment)
         return None
-    rng = np.random.default_rng([seed, epoch, int(index)])
-    img, label, sid = dataset.load(int(index), rng)
-    return np.asarray(img, np.float32), label, sid
+    from mgproto_tpu.resilience import metrics as _m
+    from mgproto_tpu.resilience.chaos import get_active
+    from mgproto_tpu.resilience.retry import backoff_delays
+
+    last_err = None
+    delays = backoff_delays(
+        retries, _RETRY_BASE_DELAY_S, _RETRY_MAX_DELAY_S,
+        rng=np.random.default_rng([seed, epoch, int(index), 0xBACC0FF]),
+    )
+    for attempt in range(retries + 1):
+        try:
+            chaos = get_active()
+            if chaos is not None and chaos.loader_should_fail(
+                seed, epoch, index, attempt
+            ):
+                raise IOError(
+                    f"chaos: injected loader IO error (epoch {epoch}, "
+                    f"sample {index}, attempt {attempt})"
+                )
+            rng = np.random.default_rng([seed, epoch, int(index)])
+            img, label, sid = dataset.load(int(index), rng)
+            return np.asarray(img, np.float32), label, sid
+        except Exception as e:  # decode/IO errors; never KeyboardInterrupt
+            last_err = e
+            if attempt >= retries:
+                break
+            _count(_m.RETRIES, scope="loader")
+            time.sleep(next(delays))
+    return (_FAILED, int(index), repr(last_err))
 
 
 # per-worker state for process workers: the initializer receives the
@@ -58,9 +125,19 @@ _WORKER_STATE: dict = {}
 _RESULT_TIMEOUT_S = 120.0
 
 
-def _proc_worker_init(dataset, seed: int) -> None:
+def _proc_worker_init(dataset, seed: int, chaos_plan=None) -> None:
     _WORKER_STATE["dataset"] = dataset
     _WORKER_STATE["seed"] = seed
+    if chaos_plan is not None:
+        # re-arm chaos inside the spawn worker (the parent's ChaosState is
+        # not inherited): per-sample IO injection is (epoch, index)-
+        # deterministic so per-worker states agree; the one-shot kinds
+        # (nan/preempt/checkpoint) never run in workers. Worker-side retry
+        # COUNTERS stay in the worker's registry — parent telemetry sees
+        # sentinel substitutions and pool restarts, not worker retries.
+        from mgproto_tpu.resilience.chaos import ChaosState, set_active
+
+        set_active(ChaosState(chaos_plan))
 
 
 def _proc_load_one(args: Tuple[int, int]):
@@ -128,6 +205,8 @@ class DataLoader:
         self.epoch = 0
         self._template = None  # (shape,) of a sample image, for sentinel rows
         self._pool = None  # lazy persistent process pool (backend="process")
+        self._pool_gen = 0  # bumped on every restart (stale-future detection)
+        self._pool_lock = threading.Lock()
 
     def _ensure_pool(self):
         """The process pool, created on first use and reused across epochs
@@ -135,10 +214,16 @@ class DataLoader:
         not per epoch). Pool workers are daemonic: they die with the parent,
         so an unclosed loader cannot outlive the process."""
         if self._pool is None:
+            from mgproto_tpu.resilience.chaos import get_active
+
+            active = get_active()
             self._pool = multiprocessing.get_context("spawn").Pool(
                 self.num_workers,
                 initializer=_proc_worker_init,
-                initargs=(self.dataset, self.seed),
+                initargs=(
+                    self.dataset, self.seed,
+                    active.plan if active is not None else None,
+                ),
             )
         return self._pool
 
@@ -149,6 +234,24 @@ class DataLoader:
             self._pool.terminate()
             self._pool.join()
             self._pool = None
+
+    def _restart_pool(self, gen: int) -> None:
+        """Replace a wedged/dead process pool (self-healing path). `gen` is
+        the generation the caller observed failing: if another thread
+        already restarted past it, do nothing — one incident must trigger
+        at most one restart, not one per in-flight batch."""
+        from mgproto_tpu.resilience import metrics as _m
+
+        with self._pool_lock:
+            if self._pool_gen != gen:
+                return
+            if self._pool is not None:
+                self._pool.terminate()
+                self._pool.join()
+                self._pool = None
+            self._pool_gen += 1
+            _count(_m.WORKER_RESTARTS)
+            self._ensure_pool()
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -194,13 +297,30 @@ class DataLoader:
         epoch = self.epoch
         self.epoch += 1
 
+        def is_failed(r) -> bool:
+            return (
+                isinstance(r, tuple) and len(r) == 3
+                and isinstance(r[0], str) and r[0] == _FAILED
+            )
+
         def assemble(results):
+            failed = sum(1 for r in results if is_failed(r))
+            if failed:
+                # exhausted-retry substitutions: counted, never fatal (one
+                # rotted file must not kill a pod run)
+                from mgproto_tpu.resilience import metrics as _m
+
+                _count(_m.SENTINEL_ROWS, failed)
             if self._template is None:
                 for r in results:  # learn the sentinel shape from any real
-                    if r is not None:  # row (process workers can't set it —
-                        self._template = r[0].shape  # separate memory)
+                    if r is not None and not is_failed(r):  # row (process
+                        self._template = r[0].shape  # workers can't set it)
                         break
-            results = [r if r is not None else self._sentinel_row() for r in results]
+            results = [
+                r if r is not None and not is_failed(r)
+                else self._sentinel_row()
+                for r in results
+            ]
             imgs = np.stack([r[0] for r in results])
             labels = np.asarray([r[1] for r in results], np.int32)
             ids = np.asarray([r[2] for r in results], np.int64)
@@ -229,22 +349,37 @@ class DataLoader:
         stop = threading.Event()
 
         if self.worker_backend == "process":
-            pool = self._ensure_pool()  # persistent across epochs
-            submit = lambda i: pool.apply_async(_proc_load_one, ((i, epoch),))
+            self._ensure_pool()  # persistent across epochs
+            pool = None  # looked up per submit: a restart swaps the pool
 
-            def result_of(f):
+            def submit(i):
+                # (handle, index, generation): the index makes a lost task
+                # recoverable in-parent, the generation makes restart
+                # decisions idempotent across in-flight batches
+                with self._pool_lock:
+                    p, gen = self._pool, self._pool_gen
+                return p.apply_async(_proc_load_one, ((i, epoch),)), i, gen
+
+            def result_of(item):
+                handle, index, gen = item
                 try:
-                    return f.get(timeout=_RESULT_TIMEOUT_S)
+                    return handle.get(timeout=_RESULT_TIMEOUT_S)
                 except multiprocessing.TimeoutError:
-                    raise RuntimeError(
-                        f"loader process-worker did not return a sample "
-                        f"within {_RESULT_TIMEOUT_S:.0f}s — a worker likely "
-                        "died (OOM/segfault); Pool cannot complete its task"
-                    ) from None
+                    # a worker died/hung: Pool will never complete this
+                    # AsyncResult. Restart the pool (once per incident) and
+                    # recover THIS sample in-parent via the same
+                    # deterministic path — identical batch content, no
+                    # RuntimeError (the seed behavior this replaces).
+                    self._restart_pool(gen)
+                    return self._load_one(index, epoch)
         else:
             pool = ThreadPoolExecutor(max_workers=self.num_workers)
-            submit = lambda i: pool.submit(self._load_one, i, epoch)
-            result_of = lambda f: f.result()
+
+            def submit(i):
+                return pool.submit(self._load_one, i, epoch), i, 0
+
+            def result_of(item):
+                return item[0].result()
 
         try:
             def put_or_stop(item) -> bool:

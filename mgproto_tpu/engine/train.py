@@ -47,6 +47,7 @@ class TrainMetrics(NamedTuple):
     accuracy: jax.Array
     full_mem_ratio: jax.Array  # fraction of classes with a full queue
     em_active: jax.Array  # classes EM touched this step
+    nonfinite: jax.Array  # bool: this step's update was SKIPPED (bad loss/grads)
 
 
 class EvalOutput(NamedTuple):
@@ -172,18 +173,39 @@ class Trainer:
             state.params, state, images, labels, use_mine
         )
 
-        tx = self.warm_tx if warm else self.joint_tx
-        opt_state = state.warm_opt_state if warm else state.opt_state
-        updates, opt_state = tx.update(grads, opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        # divergence guard: a non-finite loss or gradient freezes EVERY state
+        # mutation this step — params, optimizer moments, BatchNorm running
+        # stats (already poisoned by the forward on a NaN batch), memory
+        # enqueue and EM. lax.cond keeps the step pure (no host callback) and
+        # skips the update compute at runtime; the host-side policy
+        # (resilience.guard.EpochGuard) reads the `nonfinite` metric and
+        # rolls back after K consecutive bad steps.
+        finite = jnp.isfinite(loss)
+        for g in jax.tree_util.tree_leaves(grads):
+            # NaN/Inf propagate through the sum: one scalar check per leaf
+            finite = finite & jnp.isfinite(jnp.sum(g))
 
-        # memory enqueue (reference model.py:228-252, inside forward)
-        memory = memory_push(state.memory, *enq)
+        tx = self.warm_tx if warm else self.joint_tx
+        opt_state0 = state.warm_opt_state if warm else state.opt_state
+
+        def _apply(_):
+            updates, new_opt = tx.update(grads, opt_state0, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            # memory enqueue (reference model.py:228-252, inside forward)
+            new_memory = memory_push(state.memory, *enq)
+            return new_params, new_opt, new_stats, new_memory
+
+        def _skip(_):
+            return state.params, opt_state0, state.batch_stats, state.memory
+
+        params, opt_state, batch_stats, memory = jax.lax.cond(
+            finite, _apply, _skip, None
+        )
 
         # EM gate (reference train_and_test.py:61-63): epoch-level flag AND
-        # anything in memory AND step % interval == 0
+        # anything in memory AND step % interval == 0 (AND a finite step)
         interval_ok = (state.step % self.cfg.em.update_interval) == 0
-        do_em = update_gmm & interval_ok & (jnp.sum(memory.length) > 0)
+        do_em = update_gmm & interval_ok & (jnp.sum(memory.length) > 0) & finite
 
         def run_em(args):
             gmm, mem, popt = args
@@ -201,9 +223,12 @@ class Trainer:
         )
 
         new_state = state.replace(
+            # step counts ATTEMPTS (a skipped step still advances it, so the
+            # host's global-step bookkeeping and the EM interval phase never
+            # depend on how many steps diverged)
             step=state.step + 1,
             params=params,
-            batch_stats=new_stats,
+            batch_stats=batch_stats,
             gmm=gmm,
             memory=memory,
             opt_state=state.opt_state if warm else opt_state,
@@ -220,6 +245,7 @@ class Trainer:
                 (memory.length == memory.capacity).astype(jnp.float32)
             ),
             em_active=em_active,
+            nonfinite=~finite,
         )
         return new_state, metrics
 
@@ -280,7 +306,8 @@ class Trainer:
             np.asarray(images, np.float32), np.asarray(labels, np.int32)
         ))
 
-    def train_epoch(self, state, batches, epoch: int, monitor=None):
+    def train_epoch(self, state, batches, epoch: int, monitor=None,
+                    guard=None):
         """Drive one epoch over an iterable of (images, labels) host batches.
 
         Batches are device-prefetched (data/loader.py device_prefetch): batch
@@ -301,13 +328,23 @@ class Trainer:
         `full_mem_ratio`, which are epoch maxima: EM width varies per step
         with batch label composition (the step where queues first fill can
         touch every class at once), so a last-step sample would understate
-        it. The max runs on-device (no per-step host sync)."""
+        it. The max runs on-device (no per-step host sync).
+
+        `guard` (a resilience EpochGuard) wraps the batch stream (chaos
+        injection) and observes each completed step: it may STOP the epoch
+        (preemption — the in-flight step finishes first, matching the
+        SIGTERM contract) or raise DivergenceError (consecutive non-finite
+        steps — the driver rolls back). The guard's accounting runs on
+        device at step cadence; host syncs only at its check_every cadence."""
         import time
 
         from mgproto_tpu.data.loader import device_prefetch
         from mgproto_tpu.telemetry.monitor import tree_transfer_bytes
 
         flags = self.epoch_flags(state, epoch)
+        if guard is not None:
+            guard.begin_epoch(epoch, state)
+            batches = guard.wrap_batches(batches)
         last = None
         em_max = fm_max = None
         t_prev = time.perf_counter()
@@ -338,6 +375,10 @@ class Trainer:
                 last.full_mem_ratio if fm_max is None
                 else jnp.maximum(fm_max, last.full_mem_ratio)
             )
+            if guard is not None and guard.after_step(state, last):
+                break  # preemption: stop AFTER the completed step
+        if guard is not None:
+            guard.end_epoch()
         if last is not None:
             last = last._replace(em_active=em_max, full_mem_ratio=fm_max)
         return state, last

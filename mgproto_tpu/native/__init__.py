@@ -229,11 +229,21 @@ def jitter_available() -> bool:
     return _load() is not None
 
 
+def _check_jitter_img(img: np.ndarray, op: str) -> None:
+    """Reject empty images BEFORE they reach native code: a zero-pixel
+    array sent to mg_jitter_contrast divided by n_px == 0 (NaN + an
+    undefined float->int cast, ADVICE r5); the numpy fallbacks would
+    likewise produce nonsense means. An explicit error beats either."""
+    if img.size == 0:
+        raise ValueError(f"{op}: empty image (zero pixels)")
+
+
 def jitter_brightness(img: np.ndarray, factor: float) -> np.ndarray:
     """PIL ImageEnhance.Brightness.enhance(factor), bit-exact, one pass
     (bit-exact numpy fallback without the library, like every other entry
     point here)."""
     lib = _load()
+    _check_jitter_img(np.asarray(img), 'jitter_brightness')
     img = np.ascontiguousarray(img, np.uint8)
     if lib is None:
         from mgproto_tpu.data import transforms as _t
@@ -252,6 +262,7 @@ def jitter_contrast(img: np.ndarray, factor: float) -> np.ndarray:
     """PIL ImageEnhance.Contrast.enhance(factor), bit-exact, one pass
     (plus the internal L-mean reduction)."""
     lib = _load()
+    _check_jitter_img(np.asarray(img), 'jitter_contrast')
     img = np.ascontiguousarray(img, np.uint8)
     if lib is None:
         from mgproto_tpu.data import transforms as _t
@@ -268,6 +279,7 @@ def jitter_contrast(img: np.ndarray, factor: float) -> np.ndarray:
 def jitter_saturation(img: np.ndarray, factor: float) -> np.ndarray:
     """PIL ImageEnhance.Color.enhance(factor), bit-exact, one pass."""
     lib = _load()
+    _check_jitter_img(np.asarray(img), 'jitter_saturation')
     img = np.ascontiguousarray(img, np.uint8)
     if lib is None:
         from mgproto_tpu.data import transforms as _t
@@ -286,6 +298,7 @@ def hue_shift(img: np.ndarray, shift: int) -> np.ndarray:
     NB: the fallback takes a hue FACTOR path upstream; this entry's fallback
     reproduces the same result from the uint8 shift directly."""
     lib = _load()
+    _check_jitter_img(np.asarray(img), 'hue_shift')
     img = np.ascontiguousarray(img, np.uint8)
     if lib is None:
         from mgproto_tpu.data import transforms as _t

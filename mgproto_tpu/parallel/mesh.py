@@ -54,10 +54,21 @@ def initialize_distributed(
         strict or coordinator_address is not None or num_processes is not None
     )
     try:
-        jax.distributed.initialize(
+        # pod bring-up is the classic transient-failure window (workers race
+        # the coordinator coming up; DCN flaps during scheduling) — retry
+        # with backoff through the shared resilience path before giving up
+        from mgproto_tpu.resilience.retry import retry_call
+
+        retry_call(
+            jax.distributed.initialize,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            retries=3,
+            base_delay=1.0,
+            max_delay=10.0,
+            retry_on=(RuntimeError,),  # connection errors, not config errors
+            scope="distributed_init",
         )
         _distributed_initialized = True
     except (ValueError, RuntimeError):

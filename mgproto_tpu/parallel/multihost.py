@@ -70,6 +70,16 @@ def allgather_sum(x: float) -> float:
     return float(np.sum(multihost_utils.process_allgather(np.float64(x))))
 
 
+def any_across_hosts(flag: bool) -> bool:
+    """True when ANY process passes True — the preemption agreement: a
+    SIGTERM lands on ONE host, but every host must stop after the SAME step
+    or the next collective deadlocks. A collective itself (every process
+    must call it at the same cadence); single process: identity."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    return allgather_sum(1.0 if flag else 0.0) > 0.0
+
+
 _REPLICATING_JITS: dict = {}
 
 

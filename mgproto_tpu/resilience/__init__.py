@@ -1,0 +1,53 @@
+"""Fault-tolerant training subsystem.
+
+Modules (import layering matters — loader spawn workers import this package
+and must never pull in jax):
+
+  retry      — generic retry/backoff/jitter (jax-free), used by checkpoint
+               IO, `jax.distributed` bring-up, and the loader.
+  chaos      — deterministic fault injection (jax-free): loader IO errors,
+               NaN losses, checkpoint write failures, simulated preemption.
+  preemption — SIGTERM/SIGINT flag + marker file; `install_handlers()` is
+               the ONE place allowed to install signal handlers.
+  metrics    — resilience counter names + registration (jax-free).
+  guard      — `EpochGuard`/`DivergenceError` (imports jax; loaded lazily
+               through `__getattr__` so the package import stays jax-free).
+
+See README "Fault tolerance" for the operator-facing story.
+"""
+
+from mgproto_tpu.resilience import chaos, metrics, preemption, retry
+from mgproto_tpu.resilience.chaos import ChaosPlan, ChaosState
+from mgproto_tpu.resilience.preemption import (
+    PreemptionHandler,
+    get_handler,
+    install_handlers,
+)
+from mgproto_tpu.resilience.retry import retry_call, retryable
+
+_LAZY = ("EpochGuard", "DivergenceError")
+
+
+def __getattr__(name):
+    if name in _LAZY:  # guard imports jax; keep the package import light
+        from mgproto_tpu.resilience import guard
+
+        return getattr(guard, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "chaos",
+    "metrics",
+    "preemption",
+    "retry",
+    "ChaosPlan",
+    "ChaosState",
+    "PreemptionHandler",
+    "get_handler",
+    "install_handlers",
+    "retry_call",
+    "retryable",
+    "EpochGuard",
+    "DivergenceError",
+]

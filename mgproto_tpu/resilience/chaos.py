@@ -1,0 +1,200 @@
+"""Deterministic chaos-injection harness (jax-free).
+
+Injects the faults a preemptible-fleet run actually sees — loader IO
+errors, a NaN loss, checkpoint write failures, a preemption signal — under
+seed control, so tier-1 tests can prove the recovery machinery restores the
+EXACT state a clean run reaches (tests/test_chaos_train.py).
+
+Everything is host-side: NaN injection corrupts a batch BEFORE device
+placement and preemption raises the same flag a real SIGTERM sets, so the
+jitted step program is identical with chaos on or off (no AOT cost, no
+purity loss — the guard inside the step is always compiled in).
+
+Injection points consult the process-active `ChaosState`:
+
+  * `data/loader._load_sample`    — `loader_should_fail` (per-sample IOError,
+    transient: fails the first `loader_io_fail_attempts` attempts, then
+    succeeds, exercising the retry path without changing the final batch);
+  * `utils/checkpoint.save_checkpoint` — `checkpoint_should_fail` (IOError
+    after the tmp write, before the publishing rename: a simulated
+    kill-mid-save);
+  * `resilience.guard.EpochGuard`  — `corrupt_batch` (one-shot NaN images at
+    a global step) and `preempt_due` (one-shot simulated SIGTERM).
+
+Deterministic by construction: per-sample failures hash (seed, epoch,
+index), one-shot events key on the global step counter; one-shot state
+lives in the ChaosState object so a rollback replay does not re-inject.
+
+CLI runs configure chaos through env knobs (documented in
+`mgproto-train --help`): MGPROTO_CHAOS_SEED, MGPROTO_CHAOS_LOADER_IO_RATE,
+MGPROTO_CHAOS_LOADER_IO_FAILS, MGPROTO_CHAOS_NAN_AT_STEP,
+MGPROTO_CHAOS_PREEMPT_AT_STEP, MGPROTO_CHAOS_CKPT_FAILS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+class ChaosError(IOError):
+    """The injected fault type (an IOError so real-IO retry paths fire)."""
+
+
+@dataclasses.dataclass
+class ChaosPlan:
+    """What to inject. All fields off by default."""
+
+    seed: int = 0
+    # loader: fraction of (epoch, index) sample loads that fail, and how many
+    # attempts each chosen sample fails before succeeding (transient faults;
+    # >= the loader's retry budget makes them permanent -> sentinel rows)
+    loader_io_rate: float = 0.0
+    loader_io_fail_attempts: int = 1
+    # one-shot: NaN-corrupt the batch whose train step has this global index
+    nan_at_step: Optional[int] = None
+    # one-shot: simulated SIGTERM just before this global step's batch
+    preempt_at_step: Optional[int] = None
+    # first N checkpoint writes fail after the tmp write, before the rename
+    checkpoint_write_failures: int = 0
+
+    def any_active(self) -> bool:
+        return (
+            self.loader_io_rate > 0.0
+            or self.nan_at_step is not None
+            or self.preempt_at_step is not None
+            or self.checkpoint_write_failures > 0
+        )
+
+
+class ChaosState:
+    """A plan plus its mutable one-shot bookkeeping (thread-safe).
+
+    One-shot flags live HERE, not in per-run objects: after a divergence
+    rollback replays the same steps, an already-fired injection must not
+    fire again (that is what lets a chaos run converge to the clean run's
+    exact state)."""
+
+    def __init__(self, plan: ChaosPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._nan_fired = False
+        self._preempt_fired = False
+        self._ckpt_failures_left = int(plan.checkpoint_write_failures)
+
+    def _count(self, kind: str) -> None:
+        from mgproto_tpu.resilience import metrics as _m
+
+        _m.counter(_m.CHAOS_INJECTIONS).inc(kind=kind)
+
+    # ------------------------------------------------------------- loader IO
+    def loader_should_fail(
+        self, seed: int, epoch: int, index: int, attempt: int
+    ) -> bool:
+        """Deterministic per (epoch, index): the SAME samples fail on every
+        run of the same plan, and fail only for the first
+        `loader_io_fail_attempts` attempts."""
+        p = self.plan
+        if p.loader_io_rate <= 0.0 or index < 0:
+            return False
+        if attempt >= p.loader_io_fail_attempts:
+            return False
+        rng = np.random.default_rng([p.seed, 0x10AD, int(epoch), int(index)])
+        hit = bool(rng.random() < p.loader_io_rate)
+        if hit:
+            self._count("loader_io")
+        return hit
+
+    # ------------------------------------------------------------- NaN batch
+    def corrupt_batch(self, global_step: int, images: np.ndarray):
+        """NaN-poison the batch for `nan_at_step` (once)."""
+        with self._lock:
+            due = (
+                self.plan.nan_at_step is not None
+                and not self._nan_fired
+                and int(global_step) == int(self.plan.nan_at_step)
+            )
+            if due:
+                self._nan_fired = True
+        if not due:
+            return images
+        self._count("nan_loss")
+        return np.full_like(np.asarray(images, np.float32), np.nan)
+
+    # ------------------------------------------------------------ preemption
+    def preempt_due(self, global_step: int) -> bool:
+        """True exactly once, when the batch for `preempt_at_step` is drawn."""
+        with self._lock:
+            due = (
+                self.plan.preempt_at_step is not None
+                and not self._preempt_fired
+                and int(global_step) >= int(self.plan.preempt_at_step)
+            )
+            if due:
+                self._preempt_fired = True
+        if due:
+            self._count("preempt_signal")
+        return due
+
+    # ---------------------------------------------------------- checkpoint IO
+    def checkpoint_should_fail(self) -> bool:
+        with self._lock:
+            if self._ckpt_failures_left <= 0:
+                return False
+            self._ckpt_failures_left -= 1
+        self._count("checkpoint_write")
+        return True
+
+
+_ACTIVE: Optional[ChaosState] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def get_active() -> Optional[ChaosState]:
+    """The process-active chaos state (None = no chaos, the normal case)."""
+    return _ACTIVE
+
+
+def set_active(state: Optional[ChaosState]) -> Optional[ChaosState]:
+    """Install `state` as process-active; returns the previous one."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        prev = _ACTIVE
+        _ACTIVE = state
+    return prev
+
+
+def install(plan: ChaosPlan) -> ChaosState:
+    """Wrap `plan` in a ChaosState and make it process-active."""
+    state = ChaosState(plan)
+    set_active(state)
+    return state
+
+
+def plan_from_env(environ=None) -> Optional[ChaosPlan]:
+    """Build a plan from MGPROTO_CHAOS_* env knobs; None when none are set
+    (so production runs pay zero chaos overhead)."""
+    env = os.environ if environ is None else environ
+
+    def _get(name, cast, default):
+        raw = env.get(name)
+        if raw is None or raw == "":
+            return default
+        try:
+            return cast(raw)
+        except ValueError:
+            raise ValueError(f"{name}={raw!r} is not a valid {cast.__name__}")
+
+    plan = ChaosPlan(
+        seed=_get("MGPROTO_CHAOS_SEED", int, 0),
+        loader_io_rate=_get("MGPROTO_CHAOS_LOADER_IO_RATE", float, 0.0),
+        loader_io_fail_attempts=_get("MGPROTO_CHAOS_LOADER_IO_FAILS", int, 1),
+        nan_at_step=_get("MGPROTO_CHAOS_NAN_AT_STEP", int, None),
+        preempt_at_step=_get("MGPROTO_CHAOS_PREEMPT_AT_STEP", int, None),
+        checkpoint_write_failures=_get("MGPROTO_CHAOS_CKPT_FAILS", int, 0),
+    )
+    return plan if plan.any_active() else None

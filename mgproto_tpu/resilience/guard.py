@@ -1,0 +1,191 @@
+"""EpochGuard: the per-epoch recovery policy around the jitted train step.
+
+Glues together the three host-side halves of fault tolerance:
+
+  * divergence policy — the jitted step already SKIPS non-finite updates
+    (engine/train.py `_step` gates every state mutation on a finiteness
+    check under lax.cond) and reports a `nonfinite` flag in TrainMetrics.
+    The guard accumulates a consecutive-bad-step streak ON DEVICE (lazy
+    jnp ops, same pattern as train_epoch's em_active max — no per-step host
+    sync) and polls it every `check_every` steps; a streak of
+    `max_bad_steps` raises `DivergenceError`, which the training driver
+    answers by restoring the last good checkpoint and replaying.
+  * preemption — checks the process preemption flag after each completed
+    step (multi-host: agreement via `requested_any_host`, same cadence on
+    every process) and stops the epoch so the driver can checkpoint.
+  * chaos — applies the active ChaosState's batch corruption / simulated
+    preemption, keyed by global step, before batches reach the device.
+
+The guard is cheap enough to leave on by default: per step it dispatches
+two tiny jnp ops and one python branch; device syncs happen only at the
+`check_every` cadence and epoch boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from mgproto_tpu.resilience import metrics as _metrics
+from mgproto_tpu.resilience.chaos import ChaosState
+from mgproto_tpu.resilience.preemption import PreemptionHandler
+
+
+class DivergenceError(RuntimeError):
+    """K consecutive non-finite steps: the run should roll back."""
+
+    def __init__(self, streak: int, step: int, epoch: int):
+        super().__init__(
+            f"{streak} consecutive non-finite train steps at step {step} "
+            f"(epoch {epoch}); rolling back to the last good checkpoint"
+        )
+        self.streak = streak
+        self.step = step
+        self.epoch = epoch
+
+
+class EpochGuard:
+    """One epoch's worth of recovery policy (construct fresh per epoch).
+
+    Args:
+      max_bad_steps: consecutive non-finite steps before DivergenceError
+        (0 disables the divergence policy; skipped-step counting remains).
+      check_every: host-sync cadence (steps) for the streak poll and the
+        multi-host preemption agreement.
+      chaos: active ChaosState or None.
+      preemption: PreemptionHandler (None disables preemption checks).
+      already_done: batches of this epoch completed by a PREVIOUS
+        invocation (mid-epoch resume) — `batches_done` counts from here so
+        preemption metadata stays an absolute position within the epoch.
+      multihost: synchronize the preemption stop across processes.
+    """
+
+    def __init__(
+        self,
+        max_bad_steps: int = 3,
+        check_every: int = 8,
+        chaos: Optional[ChaosState] = None,
+        preemption: Optional[PreemptionHandler] = None,
+        already_done: int = 0,
+        multihost: bool = False,
+    ):
+        self.max_bad_steps = int(max_bad_steps)
+        self.check_every = max(int(check_every), 1)
+        self.chaos = chaos
+        self.preemption = preemption
+        self.already_done = int(already_done)
+        self.multihost = multihost
+        self.epoch = -1
+        self.preempted = False
+        self._base_step = 0
+        self._steps = 0
+        self._streak = None
+        self._bad_total = None
+        self._flushed_bad = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def begin_epoch(self, epoch: int, state) -> None:
+        self.epoch = int(epoch)
+        # one host sync per epoch: the global step this epoch starts from
+        # (chaos events key on absolute step indices)
+        self._base_step = int(jax.device_get(state.step))
+        self._steps = 0
+        self._streak = jnp.zeros((), jnp.int32)
+        self._bad_total = jnp.zeros((), jnp.int32)
+        self._flushed_bad = 0
+        self.preempted = False
+
+    @property
+    def batches_done(self) -> int:
+        """Absolute batch position within the epoch (resume metadata)."""
+        return self.already_done + self._steps
+
+    # --------------------------------------------------------------- batches
+    def wrap_batches(self, batches):
+        """Chaos hook on the host batch stream (before device placement).
+        Note batches are drawn AHEAD of their step by the prefetch depth, so
+        chaos keyed on a batch's step index may raise the preemption flag a
+        couple of steps early — harmless: preemption is asynchronous by
+        nature and the checkpoint is taken after whichever step last
+        finished."""
+        if self.chaos is None:
+            return batches
+
+        def _gen():
+            for i, (images, labels) in enumerate(batches):
+                global_step = self._base_step + i
+                if self.chaos.preempt_due(global_step) and (
+                    self.preemption is not None
+                ):
+                    self.preemption.request("chaos preempt_at_step")
+                images = self.chaos.corrupt_batch(global_step, images)
+                yield images, labels
+
+        return _gen()
+
+    # ----------------------------------------------------------------- steps
+    def after_step(self, state, train_metrics) -> bool:
+        """Observe one completed step; True => stop the epoch (preemption).
+        Raises DivergenceError when the bad-step streak crosses the limit."""
+        self._steps += 1
+        nf = train_metrics.nonfinite.astype(jnp.int32)  # device, lazy
+        self._streak = jnp.where(nf > 0, self._streak + 1, 0)
+        self._bad_total = self._bad_total + nf
+
+        if self._steps % self.check_every == 0:
+            self._poll_streak()
+            if self._check_preempt():
+                self.preempted = True
+                return True
+        elif self.preemption is not None and not self.multihost:
+            # single-host preemption costs nothing to check every step
+            if self.preemption.requested():
+                self.preempted = True
+                return True
+        return False
+
+    def end_epoch(self) -> int:
+        """Flush the skipped-step count to telemetry; final streak check;
+        final preemption check (under multihost the per-step checks only run
+        at the check_every cadence, so an epoch shorter than check_every —
+        or a signal landing in its tail — would otherwise slip through the
+        whole next epoch; every process reaches this point after the same
+        number of steps, so the agreement collective stays aligned).
+        Returns the number of skipped (non-finite) steps this epoch."""
+        if self._bad_total is None:
+            return 0
+        if not self.preempted:
+            self._poll_streak()
+            if self._check_preempt():
+                self.preempted = True
+        return self._flush_bad()
+
+    # ------------------------------------------------------------- internals
+    def _flush_bad(self) -> int:
+        total = int(jax.device_get(self._bad_total))
+        delta = total - self._flushed_bad
+        if delta > 0:
+            _metrics.counter(_metrics.SKIPPED_STEPS).inc(delta)
+            self._flushed_bad = total
+        return total
+
+    def _poll_streak(self) -> None:
+        if self.max_bad_steps <= 0:
+            return
+        streak = int(jax.device_get(self._streak))
+        if streak >= self.max_bad_steps:
+            self._flush_bad()
+            raise DivergenceError(
+                streak=streak,
+                step=self._base_step + self.batches_done - self.already_done,
+                epoch=self.epoch,
+            )
+
+    def _check_preempt(self) -> bool:
+        if self.preemption is None:
+            return False
+        if self.multihost:
+            return self.preemption.requested_any_host()
+        return self.preemption.requested()

@@ -1,0 +1,52 @@
+"""Resilience metric names + registration (jax-free).
+
+Every recovery event the resilience subsystem performs — sample-load
+retries, sentinel substitutions, worker-pool restarts, skipped non-finite
+steps, checkpoint rollbacks, preemption saves, checkpoint write failures,
+chaos injections — lands in the telemetry registry as a labeled counter, so
+`mgproto-telemetry summarize` reports them next to throughput and health.
+
+Counters are created on first use through `default_registry()` (so they
+follow whatever registry the live TelemetrySession installed), and
+`register_resilience_metrics` pre-registers the whole family in a session's
+registry so a clean run reports explicit zeros instead of absent series.
+"""
+
+from __future__ import annotations
+
+from mgproto_tpu.telemetry.registry import Counter, default_registry
+
+RETRIES = "resilience_retries_total"
+SENTINEL_ROWS = "loader_sentinel_rows_total"
+WORKER_RESTARTS = "loader_worker_restarts_total"
+SKIPPED_STEPS = "train_skipped_steps_total"
+ROLLBACKS = "train_rollbacks_total"
+PREEMPTION_SAVES = "preemption_saves_total"
+CKPT_WRITE_FAILURES = "checkpoint_write_failures_total"
+CHAOS_INJECTIONS = "chaos_injections_total"
+
+HELP = {
+    RETRIES: "retry attempts by scope (loader/checkpoint/distributed_init)",
+    SENTINEL_ROWS: "samples replaced by sentinel rows after exhausted retries",
+    WORKER_RESTARTS: "loader process-pool restarts after a worker hang/death",
+    SKIPPED_STEPS: "train steps whose update was skipped (non-finite loss/grads)",
+    ROLLBACKS: "restores to the last good checkpoint by the divergence policy",
+    PREEMPTION_SAVES: "preemption-triggered checkpoint saves",
+    CKPT_WRITE_FAILURES: "failed checkpoint write attempts (retried)",
+    CHAOS_INJECTIONS: "faults injected by the chaos harness, by kind",
+}
+
+ALL_COUNTERS = tuple(HELP)
+
+
+def counter(name: str) -> Counter:
+    """The named resilience counter in the process-current registry."""
+    return default_registry().counter(name, HELP.get(name, ""))
+
+
+def register_resilience_metrics(registry) -> None:
+    """Pre-create the whole counter family with an explicit zero-valued
+    unlabeled series, so a clean run's snapshots (and summarize) report 0
+    recovery events rather than absent metrics."""
+    for name in ALL_COUNTERS:
+        registry.counter(name, HELP[name]).inc(0.0)

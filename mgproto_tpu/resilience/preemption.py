@@ -1,0 +1,142 @@
+"""Preemption handling: signal flag, marker file, graceful-stop plumbing.
+
+Preemptible TPU fleets deliver SIGTERM with a short grace window. The
+handler here only SETS A FLAG — the training loop (engine/train.train_epoch
+via `resilience.guard.EpochGuard`) checks it between steps, finishes the
+in-flight step, checkpoints the full TrainState, writes a marker file, and
+exits cleanly; the next invocation with `--resume auto` continues bit-exactly
+(mid-epoch position included — checkpoint metadata records `batch_in_epoch`).
+
+Signal handlers are installed ONLY by `install_handlers()`, called by CLI
+drivers after argument parsing — never at import time (enforced by
+scripts/check_no_signal_handlers.py in tier-1): a library import that
+hijacks SIGINT would break every embedding application's Ctrl-C.
+
+The chaos harness raises the same flag (`PreemptionHandler.request`), so
+simulated preemption exercises the identical save/resume path a real
+SIGTERM takes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+MARKER_FILE = "PREEMPTED.json"
+
+
+class PreemptionHandler:
+    """Process-wide preemption flag (thread- and signal-safe)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: Optional[str] = None
+
+    def request(self, reason: str = "requested") -> None:
+        # assignment before set(): a checker that sees the flag must see why
+        self.reason = reason
+        self._event.set()
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def requested_any_host(self) -> bool:
+        """Multi-host agreement: True when ANY process has the flag, so every
+        host stops after the SAME step and collectives stay aligned. Every
+        process must call this at the same cadence (it is a collective);
+        degenerates to the local flag on a single process."""
+        local = self.requested()
+        from mgproto_tpu.parallel.multihost import any_across_hosts
+
+        return any_across_hosts(local)
+
+    def reset(self) -> None:
+        """Clear the flag (each run_training invocation starts clean)."""
+        self._event.clear()
+        self.reason = None
+
+
+_HANDLER = PreemptionHandler()
+
+
+def get_handler() -> PreemptionHandler:
+    return _HANDLER
+
+
+def install_handlers(signums=(signal.SIGTERM, signal.SIGINT), handler=None):
+    """Install graceful-preemption signal handlers (the ONLY place in the
+    codebase allowed to call `signal.signal` — see module docstring).
+
+    First signal: set the flag, let training checkpoint and exit cleanly.
+    Second signal of the same kind: restore the previous disposition and
+    re-raise it, so a stuck run can still be killed interactively.
+
+    Returns an `uninstall()` callable restoring the previous handlers
+    (tests use it; long-lived drivers never need to)."""
+    h = handler if handler is not None else _HANDLER
+    previous = {}
+
+    def _on_signal(signum, frame):
+        if h.requested():  # second signal: give the process back to the user
+            prev = previous.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev if callable(prev) or prev in (
+                signal.SIG_DFL, signal.SIG_IGN) else signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        h.request(f"signal {signal.Signals(signum).name}")
+
+    for signum in signums:
+        previous[signum] = signal.signal(signum, _on_signal)
+
+    def uninstall():
+        for signum, prev in previous.items():
+            signal.signal(signum, prev)
+
+    return uninstall
+
+
+# ----------------------------------------------------------------- marker IO
+def marker_path(model_dir: str) -> str:
+    return os.path.join(model_dir, MARKER_FILE)
+
+
+def write_marker(model_dir: str, checkpoint_path: str, reason: str = "",
+                 extra: Optional[dict] = None) -> str:
+    """Record that this run exited via preemption and where to resume from.
+    The next invocation surfaces it (and `--resume auto` picks the
+    checkpoint up); a completed resume clears it."""
+    path = marker_path(model_dir)
+    payload = {
+        "checkpoint": os.path.abspath(checkpoint_path),
+        "reason": reason,
+        "time": time.time(),
+    }
+    if extra:
+        payload.update(extra)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_marker(model_dir: str) -> Optional[dict]:
+    path = marker_path(model_dir)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def clear_marker(model_dir: str) -> None:
+    try:
+        os.unlink(marker_path(model_dir))
+    except OSError:
+        pass

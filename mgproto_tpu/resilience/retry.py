@@ -1,0 +1,113 @@
+"""Generic retry with exponential backoff + jitter (jax-free).
+
+One retry implementation for every transient-failure site — checkpoint IO
+(`utils/checkpoint.py`), `jax.distributed` bring-up (`parallel/mesh.py`),
+sample loading (`data/loader.py` uses the same delay schedule) — so backoff
+behavior and telemetry accounting cannot drift between them. Each performed
+retry increments `resilience_retries_total{scope=...}`.
+
+Usable as a callable (`retry_call`) or a decorator (`retryable`). Jitter can
+be made deterministic by passing a seeded `numpy` Generator — the chaos
+tests rely on this to keep fault-injected runs reproducible.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from functools import wraps
+from typing import Callable, Optional, Tuple, Type
+
+
+def backoff_delays(
+    retries: int,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    jitter: float = 0.5,
+    rng=None,
+):
+    """The delay schedule retry_call sleeps through: base * 2^k, capped at
+    max_delay, each scaled by a uniform jitter in [1, 1 + jitter)."""
+    for attempt in range(retries):
+        delay = min(max_delay, base_delay * (2.0 ** attempt))
+        u = rng.random() if rng is not None else random.random()
+        yield delay * (1.0 + jitter * u)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    jitter: float = 0.5,
+    deadline_s: Optional[float] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    scope: str = "generic",
+    on_retry: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng=None,
+    **kwargs,
+):
+    """Call `fn(*args, **kwargs)`, retrying up to `retries` times on
+    `retry_on` with exponential backoff (base_delay * 2^k, capped at
+    max_delay, jittered). `deadline_s` bounds TOTAL wall time: a retry whose
+    backoff would land past the deadline re-raises instead of sleeping.
+    `on_retry(attempt, exc, delay)` observes each performed retry."""
+    start = time.monotonic()
+    delays = backoff_delays(retries, base_delay, max_delay, jitter, rng=rng)
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = next(delays)
+            if deadline_s is not None and (
+                time.monotonic() - start + delay > deadline_s
+            ):
+                raise
+            from mgproto_tpu.resilience import metrics as _m
+
+            _m.counter(_m.RETRIES).inc(scope=scope)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+
+
+def retryable(
+    retries: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    jitter: float = 0.5,
+    deadline_s: Optional[float] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    scope: str = "generic",
+    on_retry: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Decorator form of `retry_call` (same parameters)."""
+
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(
+                fn,
+                *args,
+                retries=retries,
+                base_delay=base_delay,
+                max_delay=max_delay,
+                jitter=jitter,
+                deadline_s=deadline_s,
+                retry_on=retry_on,
+                scope=scope,
+                on_retry=on_retry,
+                sleep=sleep,
+                **kwargs,
+            )
+
+        return wrapper
+
+    return deco

@@ -76,6 +76,12 @@ class TelemetrySession:
         self._metrics_writer = metrics_writer
         self.monitor = StepMonitor(registry=self.registry)
         self.health = ModelHealth(registry=self.registry, writer=health_writer)
+        # pre-register the resilience counter family so a clean run's
+        # snapshots carry explicit zeros (summarize then always shows the
+        # recovery story, even when it is "nothing happened")
+        from mgproto_tpu.resilience.metrics import register_resilience_metrics
+
+        register_resilience_metrics(self.registry)
         self._g_epoch_ips = self.registry.gauge(
             "epoch_images_per_sec_global",
             "whole-epoch throughput summed across hosts",

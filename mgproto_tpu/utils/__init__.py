@@ -2,8 +2,12 @@
 checkpointing."""
 
 from mgproto_tpu.utils.checkpoint import (
+    CheckpointIntegrityError,
+    apply_retention,
+    find_latest_checkpoint,
     latest_checkpoint,
     list_checkpoints,
+    pytree_digest,
     restore_checkpoint,
     save_checkpoint,
     save_state_w_condition,
@@ -25,8 +29,12 @@ from mgproto_tpu.utils.vis import (
 )
 
 __all__ = [
+    "CheckpointIntegrityError",
+    "apply_retention",
+    "find_latest_checkpoint",
     "latest_checkpoint",
     "list_checkpoints",
+    "pytree_digest",
     "restore_checkpoint",
     "save_checkpoint",
     "save_state_w_condition",

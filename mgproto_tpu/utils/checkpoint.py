@@ -10,6 +10,17 @@ training resumes bit-exactly, via orbax.
 Filename convention keeps the reference's readable encoding
 (`{epoch}{stage}{accuracy}` e.g. `104nopush0.8224`, reference utils/save.py:9)
 as a directory name per checkpoint.
+
+Preemption-safety (ISSUE 2 tentpole): every save is ATOMIC — the pytree is
+written to `<name>.tmp`, an integrity manifest (leaf paths/shapes/dtypes +
+step) is added, and only then is the directory renamed into place, so a
+SIGKILL mid-save can never leave a half-written checkpoint where
+`find_latest_checkpoint` would pick it up. Restores verify the manifest
+against the restore target BEFORE orbax runs (a structure mismatch fails
+with a readable diff, not an orbax stack trace) and against the restored
+step AFTER. Writes retry through `resilience.retry` (transient FS errors on
+preemptible fleets), and `apply_retention` keeps the last N + best-accuracy
+checkpoints so long runs don't fill the disk.
 """
 
 from __future__ import annotations
@@ -17,11 +28,16 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, Optional, Tuple
+import shutil
+from typing import Any, List, Optional, Tuple
 
 import jax
 
 _NAME_RE = re.compile(r"^(\d+)([a-z_]+)(\d+\.\d+)$")
+
+MANIFEST_FILE = "mgproto_manifest.json"
+MANIFEST_FORMAT = 1
+TMP_SUFFIX = ".tmp"
 
 
 def _checkpointer():
@@ -44,18 +60,129 @@ def parse_checkpoint_name(name: str) -> Optional[Tuple[int, str, float]]:
     return int(m.group(1)), m.group(2), float(m.group(3))
 
 
+def _tree_manifest(host_state: Any) -> dict:
+    """Integrity manifest for a HOST pytree: every leaf's keypath, shape and
+    dtype, plus the scalar step when the tree carries one. Cheap to build
+    (metadata only) and cheap to verify — corruption of the pytree
+    STRUCTURE (wrong aux_loss, truncated write, version skew) is caught
+    before orbax ever runs."""
+    import numpy as np
+
+    leaves = []
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(host_state)[0]:
+        arr = np.asarray(leaf)
+        leaves.append({
+            "path": jax.tree_util.keystr(keypath),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        })
+    step = getattr(host_state, "step", None)
+    return {
+        "format": MANIFEST_FORMAT,
+        "num_leaves": len(leaves),
+        "step": None if step is None else int(np.asarray(step)),
+        "leaves": leaves,
+    }
+
+
+def load_manifest(path: str) -> Optional[dict]:
+    """The checkpoint's manifest, or None when absent (pre-manifest save).
+    Raises CheckpointIntegrityError on an unreadable/wrong-format manifest
+    (a torn write — the checkpoint must not be trusted)."""
+    mpath = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(f"unreadable manifest in {path}: {e}")
+    if manifest.get("format") != MANIFEST_FORMAT or "leaves" not in manifest:
+        raise CheckpointIntegrityError(
+            f"manifest in {path} has unknown format {manifest.get('format')!r}"
+        )
+    return manifest
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """Manifest missing/corrupt or mismatching the restore target."""
+
+
+def _verify_manifest(manifest: dict, target: Any, path: str) -> None:
+    import numpy as np
+
+    want = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(target)[0]:
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        want[jax.tree_util.keystr(keypath)] = (shape, dtype)
+    got = {e["path"]: (tuple(e["shape"]), e["dtype"])
+           for e in manifest["leaves"]}
+    if got == want:
+        return
+    missing = sorted(set(want) - set(got))[:3]
+    extra = sorted(set(got) - set(want))[:3]
+    diff = sorted(
+        k for k in set(got) & set(want) if got[k] != want[k]
+    )[:3]
+    detail = []
+    if missing:
+        detail.append(f"missing from checkpoint: {missing}")
+    if extra:
+        detail.append(f"unexpected in checkpoint: {extra}")
+    for k in diff:
+        detail.append(f"{k}: checkpoint {got[k]} vs target {want[k]}")
+    raise CheckpointIntegrityError(
+        f"checkpoint {path} does not match the restore target "
+        f"({len(got)} vs {len(want)} leaves); " + "; ".join(detail)
+    )
+
+
 def save_checkpoint(
     ckpt_dir: str,
     state: Any,
     name: str,
     metadata: Optional[dict] = None,
+    retries: int = 2,
 ) -> str:
-    """Write `state` (any pytree of arrays) to `ckpt_dir/name`."""
+    """Write `state` (any pytree of arrays) to `ckpt_dir/name`, atomically.
+
+    The pytree, its integrity manifest, and any metadata all land in
+    `<name>.tmp` first; the final rename is the publish point, so a kill at
+    ANY earlier moment leaves only a `.tmp` directory that every listing
+    here skips. Failed attempts (counted in
+    `checkpoint_write_failures_total`) are retried with backoff."""
+    from mgproto_tpu.resilience import metrics as _m
+    from mgproto_tpu.resilience.chaos import get_active
+    from mgproto_tpu.resilience.retry import retry_call
+
     path = os.path.abspath(os.path.join(ckpt_dir, name))
-    _checkpointer().save(path, jax.device_get(state), force=True)
-    if metadata is not None:
-        with open(os.path.join(path, "mgproto_meta.json"), "w") as f:
-            json.dump(metadata, f)
+    tmp = path + TMP_SUFFIX
+
+    def _write() -> None:
+        try:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            host_state = jax.device_get(state)
+            _checkpointer().save(tmp, host_state, force=True)
+            with open(os.path.join(tmp, MANIFEST_FILE), "w") as f:
+                json.dump(_tree_manifest(host_state), f)
+            if metadata is not None:
+                with open(os.path.join(tmp, "mgproto_meta.json"), "w") as f:
+                    json.dump(metadata, f)
+            chaos = get_active()
+            if chaos is not None and chaos.checkpoint_should_fail():
+                # simulated kill between tmp write and publish rename
+                raise IOError(f"chaos: injected checkpoint write failure ({name})")
+            if os.path.isdir(path):
+                shutil.rmtree(path)  # force=True overwrite semantics
+            os.rename(tmp, path)
+        except Exception:
+            _m.counter(_m.CKPT_WRITE_FAILURES).inc()
+            raise
+
+    retry_call(_write, retries=retries, base_delay=0.1, max_delay=2.0,
+               scope="checkpoint")
     return path
 
 
@@ -65,8 +192,44 @@ def restore_checkpoint(path: str, target: Any) -> Any:
     `target` is a concrete state (e.g. a fresh `Trainer.init_state(...)`);
     restored arrays adopt its dtypes and shardings, so a restore into a
     sharded state lands directly on the mesh.
-    """
-    return _checkpointer().restore(os.path.abspath(path), item=target)
+
+    When the checkpoint carries a manifest it is verified against `target`
+    BEFORE orbax runs (structure mismatches fail readably) and against the
+    restored step AFTER (a truncated array payload cannot masquerade as a
+    clean resume point)."""
+    path = os.path.abspath(path)
+    manifest = load_manifest(path)
+    if manifest is not None:
+        _verify_manifest(manifest, target, path)
+    restored = _checkpointer().restore(path, item=target)
+    if manifest is not None and manifest.get("step") is not None:
+        restored_step = getattr(restored, "step", None)
+        if restored_step is not None:
+            got = int(jax.device_get(restored_step))
+            if got != int(manifest["step"]):
+                raise CheckpointIntegrityError(
+                    f"checkpoint {path}: restored step {got} != manifest "
+                    f"step {manifest['step']}"
+                )
+    return restored
+
+
+def pytree_digest(tree: Any) -> str:
+    """sha256 over a pytree's structure + exact leaf bytes. Two states with
+    the same digest stepped identically stay identical — the equality the
+    chaos tests assert between a fault-ridden run and a clean one."""
+    import hashlib
+
+    import numpy as np
+
+    host = jax.device_get(tree)
+    leaves, treedef = jax.tree_util.tree_flatten(host)
+    h = hashlib.sha256(str(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(f"{arr.shape}{arr.dtype}".encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
 
 
 def load_metadata(path: str) -> Optional[dict]:
@@ -99,21 +262,41 @@ def save_state_w_condition(
 
 # Within one epoch the reference saves nopush, then push, then prune
 # (reference main.py:255/281/287) — resume must pick the latest STAGE, not the
-# highest accuracy (push/prune typically dip).
-_STAGE_ORDER = {"nopush": 0, "push": 1, "prune": 2}
+# highest accuracy (push/prune typically dip). "preempt" checkpoints are
+# taken MID-epoch, before that epoch's nopush save, so they order first.
+_STAGE_ORDER = {"preempt": -1, "nopush": 0, "push": 1, "prune": 2}
 
 
-def list_checkpoints(ckpt_dir: str):
+def _manifest_state(path: str) -> str:
+    """'ok' (valid manifest), 'missing' (pre-manifest legacy save), or
+    'bad' (torn/corrupt manifest — never trust the checkpoint)."""
+    try:
+        manifest = load_manifest(path)
+    except CheckpointIntegrityError:
+        return "bad"
+    return "ok" if manifest is not None else "missing"
+
+
+def list_checkpoints(ckpt_dir: str, require_manifest: bool = False):
     """All parseable checkpoints in `ckpt_dir` as (epoch, stage, acc, path),
-    ordered by (epoch, stage progression)."""
+    ordered by (epoch, stage progression). In-flight `.tmp` saves and
+    checkpoints with a CORRUPT manifest are always skipped;
+    `require_manifest=True` additionally skips legacy manifest-less saves
+    (the strict listing `find_latest_checkpoint` resumes from)."""
     if not os.path.isdir(ckpt_dir):
         return []
     out = []
     for name in os.listdir(ckpt_dir):
+        if name.endswith(TMP_SUFFIX):
+            continue  # unpublished (interrupted) save
         parsed = parse_checkpoint_name(name)
-        if parsed and os.path.isdir(os.path.join(ckpt_dir, name)):
-            out.append((*parsed, os.path.join(ckpt_dir, name)))
-    out.sort(key=lambda t: (t[0], _STAGE_ORDER.get(t[1], -1), t[2]))
+        if not parsed or not os.path.isdir(os.path.join(ckpt_dir, name)):
+            continue
+        mstate = _manifest_state(os.path.join(ckpt_dir, name))
+        if mstate == "bad" or (require_manifest and mstate != "ok"):
+            continue
+        out.append((*parsed, os.path.join(ckpt_dir, name)))
+    out.sort(key=lambda t: (t[0], _STAGE_ORDER.get(t[1], -2), t[2]))
     return out
 
 
@@ -121,6 +304,37 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     """Highest-epoch checkpoint path (the resume point the reference lacks)."""
     ckpts = list_checkpoints(ckpt_dir)
     return ckpts[-1][3] if ckpts else None
+
+
+def find_latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """The newest checkpoint SAFE to resume from: latest by (epoch, stage)
+    among checkpoints with a verified-parseable manifest; `.tmp` leftovers
+    and torn saves never qualify. The `--resume auto` and rollback entry
+    point."""
+    ckpts = list_checkpoints(ckpt_dir, require_manifest=True)
+    return ckpts[-1][3] if ckpts else None
+
+
+def apply_retention(
+    ckpt_dir: str, keep_last: int, keep_best: int = 1
+) -> List[str]:
+    """Delete old checkpoints, keeping the newest `keep_last` by (epoch,
+    stage) order plus the `keep_best` highest-accuracy ones (the eval
+    artifacts the reference's threshold saves were for). `keep_last <= 0`
+    disables retention. Returns the deleted paths."""
+    if keep_last <= 0:
+        return []
+    ckpts = list_checkpoints(ckpt_dir)
+    keep = {c[3] for c in ckpts[-keep_last:]}
+    if keep_best > 0:
+        by_acc = sorted(ckpts, key=lambda c: c[2], reverse=True)
+        keep.update(c[3] for c in by_acc[:keep_best])
+    removed = []
+    for c in ckpts:
+        if c[3] not in keep:
+            shutil.rmtree(c[3], ignore_errors=True)
+            removed.append(c[3])
+    return removed
 
 
 def select_checkpoint(ckpt_dir: str, stage: str = "nopush",

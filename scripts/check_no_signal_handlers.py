@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Lint: no module in mgproto_tpu/ installs signal handlers at import time.
+
+A library import that calls `signal.signal(...)` hijacks the embedding
+process's SIGTERM/SIGINT disposition — preemption handling must be an
+explicit driver decision, not an import side effect. The ONLY permitted
+install site is `mgproto_tpu/resilience/preemption.py`, and even there only
+inside a function body (`install_handlers()` / its uninstall closure),
+called by CLI drivers after argument parsing.
+
+AST-based (companion to scripts/check_no_print.py): flags any call to
+`signal.signal` / `signal.sigaction` (module attribute or `from signal
+import signal` name) that is
+
+  * at module level (executes at import time) — anywhere, OR
+  * anywhere at all outside resilience/preemption.py.
+
+Run from anywhere:
+
+    python scripts/check_no_signal_handlers.py [repo_root]
+
+Exit 0 when clean, 1 with one `path:line` per offender otherwise. Wired
+into tier-1 via tests/test_resilience.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+ALLOWED_FILE = os.path.join("resilience", "preemption.py")
+_INSTALL_ATTRS = ("signal", "sigaction")
+
+
+def _is_signal_install(node: ast.Call, signal_aliases: set,
+                       bare_signal_names: set) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _INSTALL_ATTRS:
+        return isinstance(f.value, ast.Name) and f.value.id in signal_aliases
+    if isinstance(f, ast.Name):
+        return f.id in bare_signal_names
+    return False
+
+
+def _imports(tree: ast.AST) -> Tuple[set, set]:
+    """(aliases of the signal module, names bound to signal.signal)."""
+    aliases, bare = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "signal":
+                    aliases.add(a.asname or "signal")
+        elif isinstance(node, ast.ImportFrom) and node.module == "signal":
+            for a in node.names:
+                if a.name in _INSTALL_ATTRS:
+                    bare.add(a.asname or a.name)
+    return aliases, bare
+
+
+def _install_calls(tree: ast.AST) -> Iterator[Tuple[int, bool]]:
+    """(lineno, at_import_time) for every signal-install call site."""
+    aliases, bare = _imports(tree)
+    if not aliases and not bare:
+        return
+
+    def walk(node: ast.AST, in_function: bool):
+        for child in ast.iter_child_nodes(node):
+            child_in_fn = in_function or isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            )
+            if isinstance(child, ast.Call) and _is_signal_install(
+                child, aliases, bare
+            ):
+                yield child.lineno, not in_function
+            yield from walk(child, child_in_fn)
+
+    yield from walk(tree, in_function=False)
+
+
+def offenders(repo_root: str) -> List[Tuple[str, int, str]]:
+    pkg = os.path.join(repo_root, "mgproto_tpu")
+    found = []
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    found.append((
+                        os.path.relpath(path, repo_root), e.lineno or 0,
+                        "unparseable module",
+                    ))
+                    continue
+            for lineno, at_import in _install_calls(tree):
+                if at_import:
+                    found.append((
+                        os.path.relpath(path, repo_root), lineno,
+                        "signal handler installed at import time",
+                    ))
+                elif rel != ALLOWED_FILE:
+                    found.append((
+                        os.path.relpath(path, repo_root), lineno,
+                        "signal handler installed outside "
+                        "resilience.install_handlers()",
+                    ))
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = offenders(root)
+    for path, lineno, why in found:
+        print(f"{path}:{lineno}: {why} "
+              f"(only resilience.install_handlers() may, from a driver)")
+    if found:
+        return 1
+    print("check_no_signal_handlers: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,174 @@
+"""Chaos acceptance test (ISSUE 2): a seeded fault-ridden training run —
+injected loader IO errors, one NaN step (divergence rollback), one simulated
+SIGTERM (preemption save + mid-epoch resume) — must reach the SAME final
+TrainState digest as a clean run of the same seed, with every recovery event
+visible in the telemetry metrics.jsonl and `mgproto-telemetry summarize`.
+
+Fast, CPU, fully seeded: runs in tier-1 under the `chaos` marker.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mgproto_tpu.cli.train import run_training
+from mgproto_tpu.config import DataConfig, tiny_test_config
+from mgproto_tpu.resilience import preemption
+from mgproto_tpu.resilience.chaos import ChaosPlan, ChaosState
+from mgproto_tpu.utils.checkpoint import (
+    find_latest_checkpoint,
+    list_checkpoints,
+    load_metadata,
+    pytree_digest,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _make_folder(root, num_classes=4, per_class=6, size=40, seed=0):
+    rng = np.random.RandomState(seed)
+    for c in range(num_classes):
+        d = os.path.join(root, f"{c:03d}.class_{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.randint(0, 255, size=(size, size, 3), dtype=np.uint8)
+            arr = np.clip(arr * 0.3 + c * 50, 0, 255)
+            Image.fromarray(arr.astype(np.uint8)).save(
+                os.path.join(d, f"img_{i}.jpg")
+            )
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("chaos_data"))
+    _make_folder(os.path.join(root, "train"))  # 24 imgs -> 3 steps @ batch 8
+    _make_folder(os.path.join(root, "test"), per_class=3, seed=1)
+    return root
+
+
+def _cfg(data_root, model_dir):
+    import dataclasses
+
+    cfg = tiny_test_config()
+    return cfg.replace(
+        data=DataConfig(
+            train_dir=os.path.join(data_root, "train"),
+            test_dir=os.path.join(data_root, "test"),
+            train_push_dir=os.path.join(data_root, "train"),
+            train_batch_size=8,
+            test_batch_size=8,
+            train_push_batch_size=8,
+            num_workers=2,
+        ),
+        # no push (orthogonal machinery; keeps the chaos run tight), prune
+        # tail still runs — 2 epochs x 3 steps, global steps 0..5
+        schedule=dataclasses.replace(cfg.schedule, push_start=99),
+        model_dir=model_dir,
+    )
+
+
+def test_chaos_run_converges_to_clean_state(data_root, tmp_path):
+    # -------------------------------------------------------------- clean run
+    clean_state, clean_accu = run_training(
+        _cfg(data_root, str(tmp_path / "clean")), telemetry=False
+    )
+    clean_digest = pytree_digest(clean_state)
+
+    # -------------------------------------------------------------- chaos run
+    # one ChaosState across BOTH invocations: its one-shot bookkeeping is the
+    # fault schedule's memory (a rollback replay / resume must not re-inject)
+    chaos = ChaosState(ChaosPlan(
+        seed=0,
+        loader_io_rate=0.3,          # transient: heals on first retry
+        loader_io_fail_attempts=1,
+        nan_at_step=3,               # epoch 1, batch 0 -> divergence rollback
+        preempt_at_step=4,           # epoch 1 -> preemption save + marker
+    ))
+    cfg = _cfg(data_root, str(tmp_path / "chaos"))
+    telem1 = str(tmp_path / "telem1")
+    state1, _ = run_training(
+        cfg,
+        target_accu=-1.0,            # save every epoch (rollback anchors)
+        telemetry_dir=telem1,
+        max_bad_steps=1,             # roll back on the first bad step
+        divergence_check_every=1,
+        chaos=chaos,
+    )
+    handler = preemption.get_handler()
+    assert handler.requested(), "chaos preemption never fired"
+
+    # the preempted invocation left a marker + a mid-epoch preempt checkpoint
+    marker = preemption.read_marker(cfg.model_dir)
+    assert marker is not None and marker["epoch"] == 1
+    latest = find_latest_checkpoint(cfg.model_dir)
+    meta = load_metadata(latest)
+    assert meta["stage"] == "preempt" and meta["epoch"] == 1
+    assert 0 < meta["batch_in_epoch"] < 3  # genuinely mid-epoch
+
+    # recovery events visible in the telemetry snapshots (acceptance)
+    snapshots = [
+        json.loads(l)
+        for l in open(os.path.join(telem1, "metrics.jsonl"))
+    ]
+    last = snapshots[-1]["metrics"]
+
+    def total(name):
+        return sum(
+            s["value"] for s in last.get(name, {}).get("series", [])
+        )
+
+    assert total("train_skipped_steps_total") >= 1   # the NaN step
+    assert total("train_rollbacks_total") == 1
+    assert total("preemption_saves_total") == 1
+    assert total("resilience_retries_total") >= 1    # loader IO healing
+    assert total("loader_sentinel_rows_total") == 0  # transient, not dropped
+    assert total("chaos_injections_total") >= 3
+
+    # ... and in the summarize subcommand's output (text + json)
+    from mgproto_tpu.cli.telemetry import render_table, summarize
+
+    summary = summarize(telem1)
+    res = summary["resilience"]
+    assert res["train_rollbacks_total"] == 1
+    assert res["preemption_saves_total"] == 1
+    assert res["train_skipped_steps_total"] >= 1
+    table = render_table(summary)
+    assert "resilience (recovery events)" in table
+    assert "preemption_saves_total" in table
+
+    # ------------------------------------------------------------ resumed run
+    state2, accu2 = run_training(
+        cfg,
+        resume="auto",
+        target_accu=-1.0,
+        telemetry_dir=str(tmp_path / "telem2"),
+        max_bad_steps=1,
+        divergence_check_every=1,
+        chaos=chaos,
+    )
+    assert not preemption.get_handler().requested()
+    assert preemption.read_marker(cfg.model_dir) is None  # resume cleared it
+
+    # the headline acceptance: bit-exact convergence with the clean run
+    assert pytree_digest(state2) == clean_digest
+    assert accu2 == pytest.approx(clean_accu)
+    assert int(state2.step) == int(clean_state.step) == 6
+
+    # the chaos model_dir ends with a complete stage trajectory
+    stages = {c[1] for c in list_checkpoints(cfg.model_dir)}
+    assert {"nopush", "preempt", "prune"} <= stages
+
+
+def test_clean_run_resume_auto_reports_complete(data_root, tmp_path):
+    """A finished run resumed with --resume auto short-circuits on the prune
+    checkpoint (guard rails around the new mid-epoch resume logic)."""
+    cfg = _cfg(data_root, str(tmp_path / "run"))
+    state, accu = run_training(cfg, target_accu=-1.0, telemetry=False)
+    state2, accu2 = run_training(
+        cfg, resume="auto", target_accu=-1.0, telemetry=False
+    )
+    assert accu2 == pytest.approx(accu)
+    assert pytree_digest(state2) == pytree_digest(state)

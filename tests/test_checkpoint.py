@@ -13,11 +13,16 @@ import pytest
 from mgproto_tpu.config import tiny_test_config
 from mgproto_tpu.engine.train import Trainer
 from mgproto_tpu.utils.checkpoint import (
+    MANIFEST_FILE,
+    CheckpointIntegrityError,
+    apply_retention,
     checkpoint_name,
+    find_latest_checkpoint,
     latest_checkpoint,
     list_checkpoints,
     load_metadata,
     parse_checkpoint_name,
+    pytree_digest,
     restore_checkpoint,
     save_checkpoint,
     save_state_w_condition,
@@ -140,6 +145,126 @@ def test_conditional_save_and_latest(tmp_path):
     assert latest_checkpoint(str(tmp_path)) == p3
     meta = load_metadata(p2)
     assert meta["stage"] == "push" and meta["accuracy"] == pytest.approx(0.72)
+
+
+# --------------------------------------------- atomicity + integrity (ISSUE 2)
+def test_atomic_save_failure_leaves_no_visible_checkpoint(tmp_path):
+    """A save killed between the tmp write and the publishing rename (chaos
+    injects exactly that) must leave NOTHING a resume would pick up."""
+    from mgproto_tpu.resilience import chaos as chaos_mod
+    from mgproto_tpu.resilience.chaos import ChaosPlan, ChaosState
+
+    cfg, trainer, state = _tiny_trainer()
+    # more injected failures than save attempts (1 + retries=1): all fail
+    prev = chaos_mod.set_active(
+        ChaosState(ChaosPlan(checkpoint_write_failures=5))
+    )
+    try:
+        with pytest.raises(IOError, match="chaos"):
+            save_checkpoint(str(tmp_path), state, "3nopush0.7000",
+                            {"epoch": 3}, retries=1)
+    finally:
+        chaos_mod.set_active(prev)
+    assert not os.path.isdir(tmp_path / "3nopush0.7000")
+    assert os.path.isdir(tmp_path / "3nopush0.7000.tmp")  # debris, unpublished
+    assert list_checkpoints(str(tmp_path)) == []
+    assert find_latest_checkpoint(str(tmp_path)) is None
+    # and a TRANSIENT failure (fewer injections than attempts) self-heals:
+    # the retried save publishes and the write-failure counter recorded it
+    from mgproto_tpu.resilience import metrics as res_metrics
+    from mgproto_tpu.telemetry.registry import (
+        MetricRegistry,
+        set_current_registry,
+    )
+
+    reg = MetricRegistry()
+    prev_reg = set_current_registry(reg)
+    prev = chaos_mod.set_active(
+        ChaosState(ChaosPlan(checkpoint_write_failures=1))
+    )
+    try:
+        path = save_checkpoint(str(tmp_path), state, "4nopush0.7100",
+                               {"epoch": 4}, retries=2)
+    finally:
+        chaos_mod.set_active(prev)
+        set_current_registry(prev_reg)
+    assert os.path.isdir(path)
+    assert find_latest_checkpoint(str(tmp_path)) == path
+    assert reg.counter(res_metrics.CKPT_WRITE_FAILURES).value() == 1
+    assert reg.counter(res_metrics.RETRIES).value(scope="checkpoint") == 1
+
+
+def test_find_latest_skips_tmp_and_bad_manifest(tmp_path):
+    cfg, trainer, state = _tiny_trainer()
+    good = save_checkpoint(str(tmp_path), state, "2nopush0.6000", {"epoch": 2})
+    # an in-flight (or abandoned) tmp save with a HIGHER epoch
+    os.makedirs(tmp_path / "9nopush0.9999.tmp")
+    # a published-looking dir whose manifest is torn mid-write
+    torn = tmp_path / "8nopush0.9000"
+    os.makedirs(torn)
+    (torn / MANIFEST_FILE).write_text('{"format": 1, "leav')
+    # a legacy manifest-less checkpoint with a higher epoch: the lenient
+    # listing keeps it, the strict resume entry point does not
+    legacy = tmp_path / "7nopush0.8000"
+    os.makedirs(legacy)
+    assert find_latest_checkpoint(str(tmp_path)) == good
+    paths = [c[3] for c in list_checkpoints(str(tmp_path))]
+    assert str(torn) not in paths and good in paths and str(legacy) in paths
+    assert latest_checkpoint(str(tmp_path)) == str(legacy)
+
+
+def test_restore_verifies_manifest_against_target(tmp_path):
+    """A checkpoint restored into a structurally different target must fail
+    with a readable CheckpointIntegrityError BEFORE orbax runs."""
+    cfg, trainer, state = _tiny_trainer()
+    path = save_checkpoint(str(tmp_path), state, "1nopush0.5000")
+    other_cfg = tiny_test_config(num_classes=6, proto_dim=16)
+    other = Trainer(other_cfg, steps_per_epoch=2)
+    wrong_target = other.init_state(jax.random.PRNGKey(0))
+    with pytest.raises(CheckpointIntegrityError, match="does not match"):
+        restore_checkpoint(path, wrong_target)
+    # the happy path still verifies (manifest present and matching)
+    ok = restore_checkpoint(path, trainer.init_state(jax.random.PRNGKey(3)))
+    assert pytree_digest(ok) == pytree_digest(state)
+
+
+def test_restore_detects_step_mismatch(tmp_path):
+    cfg, trainer, state = _tiny_trainer()
+    path = save_checkpoint(str(tmp_path), state, "1nopush0.5000")
+    manifest = json.load(open(os.path.join(path, MANIFEST_FILE)))
+    manifest["step"] = int(manifest["step"]) + 5  # simulate payload skew
+    json.dump(manifest, open(os.path.join(path, MANIFEST_FILE), "w"))
+    with pytest.raises(CheckpointIntegrityError, match="manifest step"):
+        restore_checkpoint(path, trainer.init_state(jax.random.PRNGKey(3)))
+
+
+def test_retention_keeps_last_n_plus_best(tmp_path):
+    cfg, trainer, state = _tiny_trainer()
+    for epoch, acc in [(1, 0.50), (2, 0.90), (3, 0.60), (4, 0.70), (5, 0.65)]:
+        save_checkpoint(str(tmp_path), state,
+                        checkpoint_name(epoch, "nopush", acc))
+    removed = apply_retention(str(tmp_path), keep_last=2, keep_best=1)
+    kept = {os.path.basename(c[3]) for c in list_checkpoints(str(tmp_path))}
+    # newest two by order (epochs 4, 5) plus the best accuracy (epoch 2)
+    assert kept == {"2nopush0.9000", "4nopush0.7000", "5nopush0.6500"}
+    assert len(removed) == 2
+    # keep_last=0 disables retention entirely
+    assert apply_retention(str(tmp_path), keep_last=0) == []
+
+
+def test_save_restore_is_bitexact_roundtrip(tmp_path):
+    """Digest-level equality: restore reproduces every leaf bit-for-bit
+    (the property the chaos convergence test builds on)."""
+    cfg, trainer, state = _tiny_trainer()
+    images, labels = _batch(cfg)
+    state, _ = trainer.train_step(
+        state, images, labels, use_mine=True, update_gmm=True
+    )
+    path = save_checkpoint(str(tmp_path), state, "1nopush0.5000")
+    restored = restore_checkpoint(
+        path, trainer.init_state(jax.random.PRNGKey(9))
+    )
+    assert pytree_digest(restored) == pytree_digest(state)
 
 
 def test_logger_and_metrics(tmp_path):

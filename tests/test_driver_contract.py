@@ -222,6 +222,10 @@ def test_bench_probe_failure_falls_back_to_cached_measurement(tmp_path):
         JAX_PLATFORMS="nonexistent_backend",  # live probe fails fast
         BENCH_PROBE_TIMEOUT_S="60", BENCH_PROBE_ATTEMPTS="2",
         BENCH_CACHED_SOURCES=str(cache),
+        # the capture stamp above is fixed: pin the age cap wide so THIS
+        # test keeps exercising the fresh path as wall time advances
+        # (test_bench_cached_fallback_stale_beyond_age_cap covers stale)
+        BENCH_CACHED_MAX_AGE_S="315360000",
     )
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, "bench.py")],
@@ -236,6 +240,42 @@ def test_bench_probe_failure_falls_back_to_cached_measurement(tmp_path):
     assert last["measured_at"] == "2026-07-31T03:46:00+0000"
     assert last["source"] == str(cache)
     assert "backend unreachable" in last["live_error"]
+    assert "stale" not in last and last["cached_age_s"] is not None
+
+
+def test_bench_cached_fallback_stale_beyond_age_cap(tmp_path):
+    """ADVICE r5: a cached result older than BENCH_CACHED_MAX_AGE_S is still
+    emitted (a number beats no number) but flagged stale with exit 1, so a
+    relay that has been dead for weeks cannot keep presenting a months-old
+    capture as a healthy run."""
+    cache = tmp_path / "window_capture.json"
+    cache.write_text(
+        json.dumps({
+            "error": "bench started but was killed",
+            "event": "start", "ts": "2026-01-01T00:00:00+0000",
+        }) + "\n" + json.dumps({
+            "metric": "mgproto_r34_cub_train_step_throughput",
+            "value": 900.0, "unit": "images/sec/chip", "vs_baseline": 2.5,
+            "winner": "fused", "device_kind": "TPU v5 lite", "attempts": 2,
+        }) + "\n"
+    )
+    env = _driver_env()
+    env.update(
+        JAX_PLATFORMS="nonexistent_backend",
+        BENCH_PROBE_TIMEOUT_S="60", BENCH_PROBE_ATTEMPTS="1",
+        BENCH_CACHED_SOURCES=str(cache),
+        BENCH_CACHED_MAX_AGE_S="60",  # anything past a minute is stale
+    )
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, (proc.stderr or proc.stdout)[-3000:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines() if ln.strip()]
+    last = lines[-1]
+    assert last["cached"] is True and last["stale"] is True
+    assert last["value"] == 900.0  # the number is still there for reference
+    assert last["cached_age_s"] > 60
 
 
 def test_perf_model_smoke_contract():

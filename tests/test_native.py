@@ -70,3 +70,22 @@ def test_transforms_use_native_and_match_reference_semantics(img):
     ref = T.normalize(T.to_array(T.center_crop(T.resize(pil, 64), 32)))
     np.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
     assert out.shape == (32, 32, 3)
+
+
+def test_jitter_wrappers_reject_empty_arrays():
+    """ADVICE r5: a zero-pixel image reaching mg_jitter_contrast divides by
+    n_px == 0 (NaN + an undefined float->int cast). The Python wrappers must
+    reject empty input explicitly — for every jitter entry point, native or
+    fallback alike."""
+    empty = np.zeros((0, 8, 3), np.uint8)
+    for fn, args in [
+        (native.jitter_brightness, (empty, 1.2)),
+        (native.jitter_contrast, (empty, 1.2)),
+        (native.jitter_saturation, (empty, 1.2)),
+        (native.hue_shift, (empty, 17)),
+    ]:
+        with pytest.raises(ValueError, match="empty image"):
+            fn(*args)
+    # non-empty inputs still work (guard must not over-reject)
+    img = np.random.default_rng(0).integers(0, 256, (4, 4, 3), dtype=np.uint8)
+    assert native.jitter_contrast(img, 1.2).shape == img.shape

@@ -1,0 +1,461 @@
+"""Unit tests for the resilience subsystem: retry, chaos determinism,
+loader self-healing, preemption plumbing, checkpoint retention, and the
+no-import-time-signal-handlers lint."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mgproto_tpu.resilience import chaos as chaos_mod
+from mgproto_tpu.resilience import metrics as res_metrics
+from mgproto_tpu.resilience import preemption
+from mgproto_tpu.resilience.chaos import ChaosPlan, ChaosState
+from mgproto_tpu.resilience.retry import backoff_delays, retry_call, retryable
+from mgproto_tpu.telemetry.registry import MetricRegistry, set_current_registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def registry():
+    """A fresh process-current registry per test (counter assertions must
+    not see other tests' events)."""
+    reg = MetricRegistry()
+    prev = set_current_registry(reg)
+    yield reg
+    set_current_registry(prev)
+
+
+# ---------------------------------------------------------------------- retry
+def test_retry_succeeds_after_transient_failures(registry):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError("transient")
+        return "ok"
+
+    slept = []
+    assert retry_call(flaky, retries=3, base_delay=0.01, scope="unit",
+                      sleep=slept.append) == "ok"
+    assert calls["n"] == 3 and len(slept) == 2
+    assert registry.counter(res_metrics.RETRIES).value(scope="unit") == 2
+    # exponential: second delay ~2x the first (both jittered upward only)
+    assert slept[1] > slept[0]
+
+
+def test_retry_exhaustion_reraises():
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError, match="permanent"):
+        retry_call(always, retries=2, base_delay=0.001, sleep=lambda s: None)
+
+
+def test_retry_respects_retry_on():
+    def typed():
+        raise KeyError("not retryable")
+
+    calls = {"n": 0}
+
+    def count():
+        calls["n"] += 1
+        raise KeyError("boom")
+
+    with pytest.raises(KeyError):
+        retry_call(count, retries=5, retry_on=(IOError,),
+                   sleep=lambda s: None)
+    assert calls["n"] == 1  # no retries for a non-matching exception
+
+
+def test_retry_deadline_stops_early():
+    def always():
+        raise IOError("x")
+
+    t0 = time.monotonic()
+    with pytest.raises(IOError):
+        retry_call(always, retries=50, base_delay=10.0, deadline_s=0.01)
+    assert time.monotonic() - t0 < 5.0  # never slept the 10s backoff
+
+
+def test_retryable_decorator(registry):
+    calls = {"n": 0}
+
+    @retryable(retries=2, base_delay=0.001, scope="deco",
+               sleep=lambda s: None)
+    def f(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise IOError("once")
+        return x * 2
+
+    assert f(21) == 42
+    assert registry.counter(res_metrics.RETRIES).value(scope="deco") == 1
+
+
+def test_backoff_delays_deterministic_with_seeded_rng():
+    a = list(backoff_delays(4, rng=np.random.default_rng(7)))
+    b = list(backoff_delays(4, rng=np.random.default_rng(7)))
+    assert a == b
+
+
+# ---------------------------------------------------------------------- chaos
+def test_chaos_loader_failures_deterministic(registry):
+    plan = ChaosPlan(seed=5, loader_io_rate=0.5, loader_io_fail_attempts=2)
+    a = ChaosState(plan)
+    b = ChaosState(plan)
+    decisions_a = [a.loader_should_fail(0, 1, i, 0) for i in range(64)]
+    decisions_b = [b.loader_should_fail(0, 1, i, 0) for i in range(64)]
+    assert decisions_a == decisions_b
+    assert any(decisions_a) and not all(decisions_a)
+    # transient: attempts past the budget succeed
+    hit = decisions_a.index(True)
+    assert a.loader_should_fail(0, 1, hit, 1) is True  # attempt 1 < 2
+    assert a.loader_should_fail(0, 1, hit, 2) is False  # budget exhausted
+
+
+def test_chaos_one_shot_nan_and_preempt(registry):
+    st = ChaosState(ChaosPlan(nan_at_step=3, preempt_at_step=5))
+    imgs = np.ones((2, 4, 4, 3), np.float32)
+    assert not np.isnan(st.corrupt_batch(2, imgs)).any()
+    assert np.isnan(st.corrupt_batch(3, imgs)).all()
+    assert not np.isnan(st.corrupt_batch(3, imgs)).any()  # fired once
+    assert st.preempt_due(4) is False
+    assert st.preempt_due(6) is True  # >= semantics (step may be skipped)
+    assert st.preempt_due(7) is False  # one-shot
+    inj = registry.counter(res_metrics.CHAOS_INJECTIONS)
+    assert inj.value(kind="nan_loss") == 1
+    assert inj.value(kind="preempt_signal") == 1
+
+
+def test_chaos_checkpoint_failures_bounded(registry):
+    st = ChaosState(ChaosPlan(checkpoint_write_failures=2))
+    assert st.checkpoint_should_fail() and st.checkpoint_should_fail()
+    assert not st.checkpoint_should_fail()
+
+
+def test_chaos_plan_from_env():
+    assert chaos_mod.plan_from_env({}) is None
+    plan = chaos_mod.plan_from_env({
+        "MGPROTO_CHAOS_SEED": "9",
+        "MGPROTO_CHAOS_LOADER_IO_RATE": "0.25",
+        "MGPROTO_CHAOS_NAN_AT_STEP": "12",
+    })
+    assert plan.seed == 9 and plan.loader_io_rate == 0.25
+    assert plan.nan_at_step == 12 and plan.preempt_at_step is None
+    with pytest.raises(ValueError, match="MGPROTO_CHAOS_NAN_AT_STEP"):
+        chaos_mod.plan_from_env({"MGPROTO_CHAOS_NAN_AT_STEP": "soon"})
+
+
+# ------------------------------------------------------- loader self-healing
+class _FlakyDataset:
+    """In-memory dataset with scriptable per-index failures.
+
+    fail_attempts[index] = number of load() calls for that index that raise
+    before succeeding (a huge number = permanently broken sample)."""
+
+    def __init__(self, n=16, shape=(8, 8, 3), fail_attempts=None):
+        self.n = n
+        self.shape = shape
+        self.fail_attempts = dict(fail_attempts or {})
+        self.calls = {}
+
+    def __len__(self):
+        return self.n
+
+    def load(self, index, rng=None):
+        self.calls[index] = self.calls.get(index, 0) + 1
+        if self.calls[index] <= self.fail_attempts.get(index, 0):
+            raise IOError(f"flaky sample {index}")
+        img = np.full(self.shape, float(index), np.float32)
+        return img, index % 4, index
+
+
+def _patch_fast_retries(monkeypatch):
+    import mgproto_tpu.data.loader as L
+
+    monkeypatch.setattr(L, "_RETRY_BASE_DELAY_S", 0.001)
+    monkeypatch.setattr(L, "_RETRY_MAX_DELAY_S", 0.002)
+
+
+def test_loader_transient_failure_heals_invisibly(registry, monkeypatch):
+    """A sample that fails fewer times than the retry budget produces the
+    IDENTICAL batch a healthy run would, plus retry counters."""
+    from mgproto_tpu.data.loader import DataLoader
+
+    _patch_fast_retries(monkeypatch)
+    clean = DataLoader(_FlakyDataset(), 8, num_workers=2, seed=3)
+    flaky = DataLoader(
+        _FlakyDataset(fail_attempts={2: 2, 5: 1}), 8, num_workers=2, seed=3
+    )
+    for (ia, la, xa), (ib, lb, xb) in zip(clean, flaky):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(la, lb)
+        np.testing.assert_array_equal(xa, xb)
+    assert registry.counter(res_metrics.RETRIES).value(scope="loader") == 3
+    assert registry.counter(res_metrics.SENTINEL_ROWS).value() == 0
+
+
+def test_loader_permanent_failure_substitutes_sentinel(registry, monkeypatch):
+    """A permanently broken sample becomes a sentinel row (zero image,
+    label -1, id -1) — counted, never fatal."""
+    from mgproto_tpu.data.loader import DataLoader
+
+    _patch_fast_retries(monkeypatch)
+    ds = _FlakyDataset(fail_attempts={3: 10_000})
+    dl = DataLoader(ds, 8, num_workers=2, seed=3)
+    batches = list(dl)
+    imgs, labels, ids = batches[0]
+    assert labels[3] == -1 and ids[3] == -1
+    np.testing.assert_array_equal(imgs[3], np.zeros_like(imgs[3]))
+    # every other row is untouched
+    assert labels[2] == 2 and labels[4] == 0
+    assert registry.counter(res_metrics.SENTINEL_ROWS).value() == 1
+    # budget respected: 1 initial + _SAMPLE_RETRIES attempts
+    from mgproto_tpu.data.loader import _SAMPLE_RETRIES
+
+    assert ds.calls[3] == _SAMPLE_RETRIES + 1
+
+
+def test_loader_sync_path_also_heals(registry, monkeypatch):
+    from mgproto_tpu.data.loader import DataLoader
+
+    _patch_fast_retries(monkeypatch)
+    dl = DataLoader(_FlakyDataset(fail_attempts={0: 1}), 8, num_workers=0,
+                    seed=3)
+    imgs, labels, ids = next(iter(dl))
+    assert labels[0] == 0 and ids[0] == 0  # healed, not sentinel
+    assert registry.counter(res_metrics.RETRIES).value(scope="loader") == 1
+
+
+class _HangOutsideParent:
+    """Hangs forever when loaded in any process but the constructing one —
+    simulates a wedged/dead pool worker while the in-parent recovery path
+    still succeeds."""
+
+    def __init__(self, n=8, shape=(4, 4, 3), hang_index=2):
+        self.n = n
+        self.shape = shape
+        self.hang_index = hang_index
+        self.parent_pid = os.getpid()
+
+    def __len__(self):
+        return self.n
+
+    def load(self, index, rng=None):
+        if index == self.hang_index and os.getpid() != self.parent_pid:
+            time.sleep(3600)
+        img = np.full(self.shape, float(index), np.float32)
+        return img, index % 2, index
+
+
+def test_loader_pool_restart_recovers_hung_worker(registry, monkeypatch):
+    """A process worker that never returns no longer raises RuntimeError:
+    the pool restarts (counted) and the lost sample is recovered in-parent,
+    so the batch is identical to a healthy run's."""
+    import mgproto_tpu.data.loader as L
+
+    monkeypatch.setattr(L, "_RESULT_TIMEOUT_S", 3.0)
+    dl = L.DataLoader(
+        _HangOutsideParent(), 4, num_workers=2, worker_backend="process",
+        prefetch_batches=1, seed=0,
+    )
+    try:
+        batches = list(dl)
+    finally:
+        dl.close()
+    assert len(batches) == 2
+    imgs, labels, ids = batches[0]
+    np.testing.assert_array_equal(ids, [0, 1, 2, 3])  # 2 recovered in-parent
+    np.testing.assert_array_equal(
+        imgs[2], np.full((4, 4, 3), 2.0, np.float32)
+    )
+    assert registry.counter(res_metrics.WORKER_RESTARTS).value() == 1
+
+
+# ------------------------------------------------------------------ preemption
+def test_preemption_handler_flag_and_reset():
+    h = preemption.PreemptionHandler()
+    assert not h.requested()
+    h.request("test")
+    assert h.requested() and h.reason == "test"
+    assert h.requested_any_host() is True  # single process: identity
+    h.reset()
+    assert not h.requested() and h.reason is None
+
+
+def test_install_handlers_sigterm_sets_flag_then_uninstall():
+    h = preemption.PreemptionHandler()
+    before = signal.getsignal(signal.SIGTERM)
+    uninstall = preemption.install_handlers(
+        signums=(signal.SIGTERM,), handler=h
+    )
+    try:
+        assert signal.getsignal(signal.SIGTERM) is not before
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not h.requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert h.requested() and "SIGTERM" in h.reason
+    finally:
+        uninstall()
+    # previous disposition restored exactly
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_preemption_marker_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert preemption.read_marker(d) is None
+    preemption.write_marker(d, "/ckpt/5preempt0.1000", reason="signal SIGTERM",
+                            extra={"epoch": 5, "batch_in_epoch": 7})
+    m = preemption.read_marker(d)
+    assert m["checkpoint"].endswith("5preempt0.1000")
+    assert m["epoch"] == 5 and m["batch_in_epoch"] == 7
+    preemption.clear_marker(d)
+    assert preemption.read_marker(d) is None
+    preemption.clear_marker(d)  # idempotent
+
+
+# ------------------------------------------------------------------ lint gate
+def test_no_import_time_signal_handlers_in_library():
+    """Tier-1 wiring of scripts/check_no_signal_handlers.py: the repo as-is
+    must be clean (only resilience.install_handlers may install)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_no_signal_handlers.py"), REPO],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_signal_lint_catches_planted_offenders(tmp_path):
+    pkg = tmp_path / "mgproto_tpu"
+    (pkg / "engine").mkdir(parents=True)
+    (pkg / "resilience").mkdir()
+    # offender 1: import-time install (even inside the allowed file)
+    (pkg / "resilience" / "preemption.py").write_text(
+        "import signal\n"
+        "signal.signal(signal.SIGTERM, lambda *a: None)\n"
+        "def install_handlers():\n"
+        "    signal.signal(signal.SIGINT, lambda *a: None)\n"  # allowed
+    )
+    # offender 2: install inside a function but OUTSIDE the allowed file
+    (pkg / "engine" / "sneaky.py").write_text(
+        "from signal import signal as s\n"
+        "def hook():\n"
+        "    s(15, lambda *a: None)\n"
+    )
+    # not an offender: the word signal in a string / unrelated attr
+    (pkg / "engine" / "ok.py").write_text(
+        "SRC = 'signal.signal(signal.SIGTERM, h)'\n"
+        "class T:\n"
+        "    def signal(self):\n"
+        "        return self.signal\n"
+    )
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_no_signal_handlers.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    out = proc.stdout.replace(os.sep, "/")
+    assert proc.returncode == 1
+    assert "resilience/preemption.py:2" in out  # import-time
+    assert "engine/sneaky.py:3" in out  # wrong module
+    assert "preemption.py:4" not in out  # in-function in allowed file
+    assert "ok.py" not in out
+
+
+# ------------------------------------------------------ guard (device-backed)
+def test_epoch_guard_divergence_and_preemption(registry):
+    """EpochGuard policy against synthetic metrics: streak accounting,
+    skipped-step counter flush, preemption stop after the in-flight step."""
+    import jax.numpy as jnp
+
+    from mgproto_tpu.resilience.guard import DivergenceError, EpochGuard
+
+    class _State:
+        step = jnp.asarray(10)
+
+    def m(nonfinite):
+        class _M:
+            pass
+
+        _M.nonfinite = jnp.asarray(bool(nonfinite))
+        return _M
+
+    g = EpochGuard(max_bad_steps=2, check_every=1)
+    g.begin_epoch(0, _State())
+    assert g.after_step(_State(), m(False)) is False
+    assert g.after_step(_State(), m(True)) is False  # streak 1 < 2
+    with pytest.raises(DivergenceError) as ei:
+        g.after_step(_State(), m(True))  # streak 2
+    assert ei.value.streak == 2 and ei.value.epoch == 0
+    assert registry.counter(res_metrics.SKIPPED_STEPS).value() == 2
+
+    # a finite step resets the streak
+    g2 = EpochGuard(max_bad_steps=2, check_every=1)
+    g2.begin_epoch(1, _State())
+    for nf in (True, False, True, False):
+        assert g2.after_step(_State(), m(nf)) is False
+    assert g2.end_epoch() == 2  # bad total, not streak
+
+    # preemption: stop requested AFTER the completed step
+    h = preemption.PreemptionHandler()
+    g3 = EpochGuard(max_bad_steps=0, check_every=4, preemption=h)
+    g3.begin_epoch(2, _State(), )
+    assert g3.after_step(_State(), m(False)) is False
+    h.request("test")
+    assert g3.after_step(_State(), m(False)) is True
+    assert g3.preempted and g3.batches_done == 2
+
+
+def test_chaos_loader_injection_reaches_spawn_workers(registry, monkeypatch):
+    """With worker_backend='process', the pool initializer re-arms the
+    active chaos plan inside the spawn workers: transient injected IO
+    errors heal by retry IN the worker and the batch content matches a
+    chaos-free run (the parent's ChaosState itself is not inherited)."""
+    from mgproto_tpu.data.loader import DataLoader
+
+    _patch_fast_retries(monkeypatch)
+    plan = ChaosPlan(seed=1, loader_io_rate=0.4, loader_io_fail_attempts=1)
+    prev = chaos_mod.set_active(ChaosState(plan))
+    dl = DataLoader(_FlakyDataset(), 8, num_workers=2,
+                    worker_backend="process", prefetch_batches=1, seed=3)
+    try:
+        chaotic = [b for b in dl]
+    finally:
+        dl.close()
+        chaos_mod.set_active(prev)
+    clean = list(DataLoader(_FlakyDataset(), 8, num_workers=0, seed=3))
+    assert len(chaotic) == len(clean)
+    for (ia, la, xa), (ib, lb, xb) in zip(clean, chaotic):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(la, lb)
+    # nothing was dropped: injections were transient and healed in-worker
+    assert registry.counter(res_metrics.SENTINEL_ROWS).value() == 0
+
+    # proof the injection actually fires inside workers: PERMANENT injected
+    # failures surface as parent-counted sentinel rows
+    monkeypatch.setattr(
+        "mgproto_tpu.data.loader._SAMPLE_RETRIES", 1
+    )
+    prev = chaos_mod.set_active(ChaosState(ChaosPlan(
+        seed=1, loader_io_rate=0.4, loader_io_fail_attempts=100,
+    )))
+    dl2 = DataLoader(_FlakyDataset(), 8, num_workers=2,
+                     worker_backend="process", prefetch_batches=1, seed=3)
+    try:
+        batches = [b for b in dl2]
+    finally:
+        dl2.close()
+        chaos_mod.set_active(prev)
+    assert registry.counter(res_metrics.SENTINEL_ROWS).value() > 0
+    assert any((labels == -1).any() for _, labels, _ in batches)
