@@ -41,12 +41,14 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument(
         "--ood_score",
         default="sum",
-        choices=["sum", "max"],
+        choices=["sum", "max", "paper"],
         help="OoD operating-point rule: 'sum' = the reference's inherited "
              "sum_c p(x|c) threshold (with its C-fold asymmetry, kept for "
              "parity); 'max' = max_c p(x|c), which rescues broad-response "
-             "near-OoD (evidence/README.md). AUROC for every rule is "
-             "reported either way.",
+             "near-OoD (evidence/README.md); 'paper' = log p(x) on BOTH "
+             "sides (the paper's stated rule, and what the serving "
+             "calibration gates with). AUROC for every rule is reported "
+             "either way.",
     )
     args = p.parse_args(argv)
     maybe_init_distributed(args)

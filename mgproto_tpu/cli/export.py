@@ -26,6 +26,10 @@ from mgproto_tpu.engine.export import (
     save_artifact,
 )
 from mgproto_tpu.engine.train import Trainer
+from mgproto_tpu.serving.calibration import (
+    calibrate_from_config,
+    gmm_fingerprint,
+)
 from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
 from mgproto_tpu.utils.checkpoint import adopt_checkpoint_train_config
 
@@ -43,6 +47,17 @@ def main(argv: Optional[list] = None) -> None:
                    help="pin the batch dimension to this size instead of "
                         "exporting a symbolic batch (some non-XLA StableHLO "
                         "consumers need static shapes); 0 = symbolic")
+    p.add_argument("--calibrate", action="store_true",
+                   help="derive the serving calibration (log p(x) "
+                        "percentile thresholds, quantile sketch, per-class "
+                        "temperatures; serving/calibration.py) from the "
+                        "held-out ID loader at --test_dir and embed it as "
+                        "calibration.json — mgproto-serve refuses "
+                        "uncalibrated artifacts unless --allow-uncalibrated")
+    p.add_argument("--calib_percentile", type=float, default=5.0,
+                   help="ID percentile for the default abstention "
+                        "operating point (matches evaluate_with_ood's "
+                        "threshold convention)")
     args = p.parse_args(argv)
     cfg = config_from_args(args)
 
@@ -70,13 +85,24 @@ def main(argv: Optional[list] = None) -> None:
         trainer, state, dynamic_batch=dynamic,
         static_batch=max(args.static_batch, 1),
     )
-    meta = artifact_meta(cfg, path, dynamic)
-    save_artifact(args.out, exported, meta)
+    meta = artifact_meta(
+        cfg, path, dynamic,
+        gmm_fingerprint=gmm_fingerprint(state.gmm),
+        static_batch=max(args.static_batch, 1),
+    )
+    calib = None
+    if args.calibrate:
+        calib = calibrate_from_config(
+            cfg, trainer, state, percentile=args.calib_percentile
+        )
+    save_artifact(args.out, exported, meta, calibration=calib)
     print(json.dumps({
         "artifact": args.out,
         "bytes": os.path.getsize(args.out),
+        "calibrated": calib is not None,
         **{k: meta[k] for k in ("arch", "num_classes", "img_size",
-                                "dynamic_batch", "checkpoint")},
+                                "dynamic_batch", "checkpoint",
+                                "gmm_fingerprint")},
     }))
 
 

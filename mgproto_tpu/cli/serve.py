@@ -1,0 +1,224 @@
+"""Serving driver: trust-gated inference over an artifact or checkpoint.
+
+`mgproto-serve` is the batch/stdin face of `serving.ServingEngine` — the
+same engine a network frontend would embed, with zero network dependency
+(tier-1 testable). One JSON line per request response, plus one final
+summary line (counts by outcome, abstain rate, breaker/health state).
+
+    # exported artifact (calibration embedded by `mgproto-export --calibrate`)
+    mgproto-serve --artifact model.mgproto --images batch.npy
+
+    # live checkpoint (same flags as mgproto-eval); calibrates on the fly
+    mgproto-serve --checkpoint auto --model_dir runs/r1 --calibrate ...
+
+    # stdin JSONL: {"id": "...", "image": [[[...]]]} per line
+    mgproto-serve --artifact model.mgproto --stdin < requests.jsonl
+
+An artifact without calibration.json refuses to serve unless
+`--allow-uncalibrated`, which drops to DEGRADED mode: classification
+without OoD abstention, flagged on every response.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+import numpy as np
+
+from mgproto_tpu.cli.common import add_train_args, config_from_args
+from mgproto_tpu.serving.metrics import register_serving_metrics
+from mgproto_tpu.telemetry import make_session
+from mgproto_tpu.telemetry.monitor import StepMonitor
+
+
+def _parse_buckets(raw: str):
+    return tuple(int(b) for b in raw.split(",") if b.strip())
+
+
+def _load_payloads(args):
+    """(payloads, ids) from --images npy/npz files and/or --stdin JSONL."""
+    payloads, ids = [], []
+    for path in args.images:
+        arr = np.load(path, allow_pickle=False)
+        if isinstance(arr, np.lib.npyio.NpzFile):
+            arr = arr[arr.files[0]]
+        if arr.ndim == 3:
+            arr = arr[None]
+        for i, row in enumerate(arr):
+            payloads.append(row)
+            ids.append(f"{os.path.basename(path)}[{i}]")
+    if args.stdin:
+        for lineno, line in enumerate(sys.stdin):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                payloads.append(rec["image"])
+                ids.append(str(rec.get("id", f"stdin[{lineno}]")))
+            except (ValueError, KeyError, TypeError):
+                payloads.append(None)  # typed reject, not a crash
+                ids.append(f"stdin[{lineno}]")
+    return payloads, ids
+
+
+def build_engine(args, monitor: Optional[StepMonitor] = None):
+    """Engine from --artifact, or from a checkpoint via the train flags."""
+    from mgproto_tpu.serving.engine import ServingEngine
+
+    kw = dict(
+        buckets=_parse_buckets(args.buckets),
+        percentile=args.percentile,
+        queue_capacity=args.queue_capacity,
+        default_deadline_s=(
+            args.deadline_ms / 1000.0 if args.deadline_ms > 0 else None
+        ),
+        monitor=monitor,
+    )
+    if args.artifact:
+        return ServingEngine.from_artifact(
+            args.artifact, allow_uncalibrated=args.allow_uncalibrated, **kw
+        )
+
+    import jax
+
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
+    from mgproto_tpu.utils.checkpoint import adopt_checkpoint_train_config
+
+    cfg = config_from_args(args)
+    path = (
+        latest_checkpoint(cfg.model_dir)
+        if args.checkpoint == "auto"
+        else args.checkpoint
+    )
+    if not path:
+        raise FileNotFoundError(f"no checkpoint found in {cfg.model_dir}")
+    cfg = adopt_checkpoint_train_config(cfg, path, log=print)
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(cfg.seed), for_restore=True)
+    state = restore_checkpoint(path, state)
+    calib = None
+    if args.calibrate:
+        from mgproto_tpu.serving.calibration import calibrate_from_config
+
+        calib = calibrate_from_config(
+            cfg, trainer, state,
+            # explicit `is None`: --percentile 0 is a legitimate (gate
+            # nothing out) operating point, not a request for the default
+            percentile=5.0 if args.percentile is None else args.percentile,
+        )
+    elif not args.allow_uncalibrated:
+        raise SystemExit(
+            "live serving without calibration: pass --calibrate (derives "
+            "thresholds from --test_dir) or --allow-uncalibrated "
+            "(degraded mode, no OoD abstention)"
+        )
+    return ServingEngine.from_live(trainer, state, calibration=calib, **kw)
+
+
+CHAOS_SERVE_ENV_HELP = """\
+serving chaos-injection env knobs (fault drills; all off by default):
+  MGPROTO_CHAOS_SEED                  seed for the deterministic schedule
+  MGPROTO_CHAOS_SERVE_MALFORMED_RATE  fraction of requests made malformed
+                                      (wrong shape -> typed reject)
+  MGPROTO_CHAOS_SERVE_NAN_RATE        fraction NaN-poisoned (typed reject)
+  MGPROTO_CHAOS_SERVE_DEVICE_ERRORS   comma-separated dispatch indices that
+                                      raise a simulated device failure
+                                      (feeds the circuit breaker)
+  MGPROTO_CHAOS_SERVE_STORM_AT        first request index of a deadline
+                                      storm (arrives already expired)
+  MGPROTO_CHAOS_SERVE_STORM_LEN       number of storm requests
+"""
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(
+        description="Serve an MGProto model with calibrated trust gating",
+        epilog=CHAOS_SERVE_ENV_HELP,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    add_train_args(p)
+    p.add_argument("--artifact", default="",
+                   help=".mgproto artifact to serve (else --checkpoint + "
+                        "model flags)")
+    p.add_argument("--checkpoint", default="auto",
+                   help="checkpoint path ('auto' = latest in --model_dir); "
+                        "ignored when --artifact is given")
+    p.add_argument("--images", action="append", default=[],
+                   help="npy/npz of [N,H,W,3] (or [H,W,3]) normalized "
+                        "float images (repeatable)")
+    p.add_argument("--stdin", action="store_true",
+                   help="also read JSONL requests from stdin: "
+                        '{"id": ..., "image": nested lists}')
+    p.add_argument("--buckets", default="1,2,4,8",
+                   help="batch-size buckets compiled at warmup (requests "
+                        "are padded up; no recompiles after warmup)")
+    p.add_argument("--percentile", type=float, default=None,
+                   help="abstention operating point (ID log p(x) "
+                        "percentile); default: the calibration's own")
+    p.add_argument("--deadline_ms", type=float, default=0.0,
+                   help="per-request deadline; expired requests are shed "
+                        "typed (0 = none)")
+    p.add_argument("--queue_capacity", type=int, default=64,
+                   help="admission queue bound (overflow sheds typed)")
+    p.add_argument("--allow-uncalibrated", "--allow_uncalibrated",
+                   dest="allow_uncalibrated", action="store_true",
+                   help="serve WITHOUT calibration in degraded mode "
+                        "(classification only, flagged per response)")
+    p.add_argument("--calibrate", action="store_true",
+                   help="live mode: derive calibration from the --test_dir "
+                        "loader before serving")
+    args = p.parse_args(argv)
+
+    from mgproto_tpu.resilience import chaos as chaos_mod
+
+    chaos_plan = chaos_mod.plan_from_env()
+    if chaos_plan is not None:
+        chaos_mod.install(chaos_plan)
+
+    # unlike mgproto-train there is no default telemetry dir (a serve run
+    # has no model_dir of its own): telemetry is on when --telemetry-dir is
+    telem = make_session(args.telemetry_dir or "", not args.no_telemetry)
+    monitor = None
+    if telem:
+        register_serving_metrics(telem.registry)
+        monitor = StepMonitor(registry=telem.registry, phase="serve")
+
+    engine = build_engine(args, monitor=monitor)
+    try:
+        compiled = engine.warmup()
+        payloads, ids = _load_payloads(args)
+        responses = engine.serve_all(payloads, request_ids=ids)
+        for r in responses:
+            print(json.dumps(r.to_dict()))
+        from mgproto_tpu.serving.health import HealthProbe
+
+        counts = {}
+        for r in responses:
+            counts[r.outcome] = counts.get(r.outcome, 0) + 1
+        print(json.dumps({
+            "summary": True,
+            "requests": len(responses),
+            "outcomes": counts,
+            "abstain_rate": engine.gate.abstain_rate,
+            "degraded": engine.gate.degraded,
+            "fingerprint_mismatch": engine.gate.fingerprint_mismatch,
+            "warmup_compiles": compiled,
+            "steady_state_recompiles": engine.monitor.recompile_count
+            - compiled,
+            "readiness": HealthProbe(engine).readiness(),
+        }))
+        if telem:
+            telem.flush()
+    finally:
+        if telem:
+            telem.close()
+
+
+if __name__ == "__main__":
+    main()

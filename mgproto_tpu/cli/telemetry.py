@@ -131,6 +131,58 @@ def _hist_series(snapshot: Dict, name: str) -> Optional[Dict]:
     return merged
 
 
+def _series_by_label(
+    snapshot: Dict, name: str, label_key: str
+) -> Dict[str, float]:
+    """Counter value per label (e.g. requests by outcome)."""
+    m = snapshot.get(name)
+    out: Dict[str, float] = {}
+    if not m:
+        return out
+    for s in m.get("series", []):
+        v = s.get("value")
+        if v is None:
+            continue
+        key = s.get("labels", {}).get(label_key)
+        if key is not None:
+            out[key] = out.get(key, 0.0) + v
+    return out
+
+
+def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
+    """Serving story: outcomes, latency percentiles, trust + breaker state
+    (None when this run never served — training-only telemetry)."""
+    from mgproto_tpu.serving import metrics as sm  # jax-free
+
+    if not any(name in last for name in sm.ALL_COUNTERS):
+        return None
+    section: Dict[str, Any] = {
+        "requests_by_outcome": _series_by_label(
+            last, sm.REQUESTS, "outcome"
+        ),
+        "shed_by_reason": _series_by_label(last, sm.SHED, "reason"),
+        "abstain_rate": _series_value(last, sm.ABSTAIN_RATE),
+        "degraded_requests": _series_value(last, sm.DEGRADED_REQUESTS),
+        "fingerprint_mismatches": _series_value(
+            last, sm.FINGERPRINT_MISMATCHES
+        ),
+        "device_errors": _series_value(last, sm.DEVICE_ERRORS),
+        "breaker_state": _series_value(last, sm.BREAKER_STATE),
+        "breaker_transitions": _series_by_label(
+            last, sm.BREAKER_TRANSITIONS, "edge"
+        ),
+    }
+    hist = _hist_series(last, sm.REQUEST_SECONDS)
+    if hist and hist["count"]:
+        section["request_mean_seconds"] = hist["sum"] / hist["count"]
+        for p in STEP_PERCENTILES:
+            section[f"request_p{p:g}_seconds"] = percentile_from_buckets(
+                hist, p
+            )
+        section["request_max_seconds"] = hist["max"]
+    return section
+
+
 def summarize(telemetry_dir: str) -> Dict[str, Any]:
     """The whole summary as one JSON-able dict."""
     d = resolve_dir(telemetry_dir)
@@ -186,6 +238,10 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     }
     if any(v is not None for v in resilience.values()):
         summary["resilience"] = resilience
+
+    serving = _serving_section(last)
+    if serving is not None:
+        summary["serving"] = serving
 
     if health:
         traj = {}
@@ -267,6 +323,12 @@ def render_table(summary: Dict[str, Any]) -> str:
     if "resilience" in summary:
         section("resilience (recovery events)")
         for k, v in summary["resilience"].items():
+            rows.append((k, v))
+    if "serving" in summary:
+        section("serving")
+        for k, v in summary["serving"].items():
+            if isinstance(v, dict):
+                v = " ".join(f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())) or "-"
             rows.append((k, v))
     if "health" in summary:
         h = summary["health"]

@@ -476,6 +476,7 @@ chaos-injection env knobs (fault drills; all off by default):
   MGPROTO_CHAOS_NAN_AT_STEP     NaN-poison the batch of this global step
   MGPROTO_CHAOS_PREEMPT_AT_STEP simulate SIGTERM at this global step
   MGPROTO_CHAOS_CKPT_FAILS      fail the first N checkpoint writes
+serving-side knobs (MGPROTO_CHAOS_SERVE_*): see `mgproto-serve --help`
 """
 
 

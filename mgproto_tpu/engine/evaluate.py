@@ -152,11 +152,20 @@ def evaluate_with_ood(
     parity); "max" thresholds max_c log p(x|c) symmetrically (no C-fold
     asymmetry) in LOG space (monotone-equivalent, immune to exp
     underflow) — the rule the scoring study showed rescues broad-response
-    near-OoD (evidence/README.md "ood/"). `ood_thresh` is therefore an
-    exp-space density for "sum" and a log-density for "max".
+    near-OoD (evidence/README.md "ood/"). "paper" (opt-in) scores BOTH
+    sides with log p(x) — the quantity the paper's equations actually
+    name — removing the reference implementation's C-fold sum-vs-mean
+    asymmetry while keeping its scoring function; it is also the rule the
+    serving calibration gates with (serving/calibration.py), so
+    `evaluate --ood_score paper` reproduces serve-time abstention
+    decisions exactly. `ood_thresh` is an exp-space density for "sum" and
+    a log-density for "max"/"paper". The default stays "sum" (reference
+    parity).
     """
-    if score_rule not in ("sum", "max"):
-        raise ValueError(f"score_rule must be 'sum' or 'max', got {score_rule!r}")
+    if score_rule not in ("sum", "max", "paper"):
+        raise ValueError(
+            f"score_rule must be 'sum', 'max' or 'paper', got {score_rule!r}"
+        )
     id_log_px, correct, _, _, id_logits = _run_eval(trainer, state, id_batches)
     acc = float(correct.mean()) if correct.size else 0.0
     log(f"\tTest Acc: \t{acc * 100}")
@@ -169,6 +178,8 @@ def evaluate_with_ood(
     # threshold to 0.0 and faking a perfect FPR
     if score_rule == "sum":
         id_score = np.exp(id_log_px.astype(np.float64))
+    elif score_rule == "paper":
+        id_score = id_log_px.astype(np.float64)  # log p(x), both sides
     else:
         id_score = id_logits.max(-1)
     ood_thresh = float(np.percentile(id_score, percentile))
@@ -182,6 +193,9 @@ def evaluate_with_ood(
             # inherited asymmetry: threshold from SUM, OoD tested on MEAN
             # (reference train_and_test.py:196-213) — kept for parity
             ood_score = np.exp(ood_log_px.astype(np.float64)) / num_classes
+        elif score_rule == "paper":
+            # symmetric: the SAME log p(x) statistic as the threshold
+            ood_score = ood_log_px.astype(np.float64)
         else:
             ood_score = ood_logits.max(-1)  # log space, like the threshold
         fpr = float((ood_score > ood_thresh).mean()) if ood_score.size else 0.0

@@ -16,14 +16,21 @@ fused kernel is a training-time optimization, and the two paths are
 numerically identical (tests/test_fused_scoring.py).
 
 Artifact layout: a single zip (conventionally `*.mgproto`) holding
-  model.stablehlo — jax.export serialization (weights inlined)
-  meta.json      — model/provenance metadata (arch, classes, shapes, dtype)
+  model.stablehlo  — jax.export serialization (weights inlined)
+  meta.json        — model/provenance metadata (arch, classes, shapes,
+                     dtype, gmm_fingerprint)
+  calibration.json — optional ID-score calibration (serving/calibration.py):
+                     log p(x) percentile thresholds + quantile sketch +
+                     per-class temperatures, stamped with the fingerprint
+                     of the GMM they were measured under. The serving
+                     engine refuses to trust-gate without it.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import zipfile
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -35,6 +42,7 @@ from mgproto_tpu.engine.train import Trainer
 
 _BLOB_NAME = "model.stablehlo"
 _META_NAME = "meta.json"
+_CALIB_NAME = "calibration.json"
 
 
 def export_eval(trainer, state, dynamic_batch: bool = True,
@@ -74,11 +82,61 @@ def export_eval(trainer, state, dynamic_batch: bool = True,
     return jax_export.export(jax.jit(infer), platforms=list(platforms))(spec)
 
 
-def save_artifact(path: str, exported, meta: Dict[str, Any]) -> None:
-    """One-file artifact: the serialized program + a meta.json."""
+def save_artifact(path: str, exported, meta: Dict[str, Any],
+                  calibration=None) -> None:
+    """One-file artifact: the serialized program + meta.json (+ the
+    serving calibration when given — a `serving.calibration.Calibration`
+    or an already-serialized dict)."""
     with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_DEFLATED) as z:
         z.writestr(_BLOB_NAME, bytes(exported.serialize()))
         z.writestr(_META_NAME, json.dumps(meta, indent=2, sort_keys=True))
+        if calibration is not None:
+            z.writestr(_CALIB_NAME, _calib_json(calibration))
+
+
+def _calib_json(calibration) -> str:
+    if isinstance(calibration, dict):
+        return json.dumps(calibration, indent=2, sort_keys=True)
+    return calibration.to_json()
+
+
+def embed_calibration(path: str, calibration) -> None:
+    """Add (or replace) the calibration inside an existing artifact —
+    recalibration after a prune/EM touch-up must not require re-staging
+    the StableHLO program. Rewrites the zip atomically."""
+    tmp = path + ".tmp"
+    with zipfile.ZipFile(path) as src:
+        entries = [n for n in src.namelist() if n != _CALIB_NAME]
+        with zipfile.ZipFile(
+            tmp, "w", compression=zipfile.ZIP_DEFLATED
+        ) as dst:
+            for name in entries:
+                dst.writestr(name, src.read(name))
+            dst.writestr(_CALIB_NAME, _calib_json(calibration))
+    os.replace(tmp, path)
+
+
+def load_calibration(path: str):
+    """The artifact's embedded `serving.calibration.Calibration`, or None
+    when it carries no calibration. (Unlike `load_artifact`, this pulls in
+    `mgproto_tpu.serving.calibration` — numpy + stdlib only, still safe on
+    a bare serving host.)"""
+    from mgproto_tpu.serving.calibration import Calibration
+
+    with zipfile.ZipFile(path) as z:
+        if _CALIB_NAME not in z.namelist():
+            return None
+        return Calibration.from_json(z.read(_CALIB_NAME).decode())
+
+
+def load_exported(path: str) -> Tuple[Any, Dict[str, Any]]:
+    """(jax.export.Exported, meta) — the full deserialized program object,
+    for callers that need its input avals (e.g. recovering the pinned
+    batch size of a static export whose meta predates `static_batch`)."""
+    with zipfile.ZipFile(path) as z:
+        exported = jax_export.deserialize(z.read(_BLOB_NAME))
+        meta = json.loads(z.read(_META_NAME))
+    return exported, meta
 
 
 def load_artifact(path: str) -> Tuple[Callable, Dict[str, Any]]:
@@ -87,16 +145,21 @@ def load_artifact(path: str) -> Tuple[Callable, Dict[str, Any]]:
     Needs only jax — deliberately no mgproto_tpu imports in the load path
     (the artifact must stay loadable from a bare serving environment; this
     helper is a convenience over `jax.export.deserialize`)."""
-    with zipfile.ZipFile(path) as z:
-        exported = jax_export.deserialize(z.read(_BLOB_NAME))
-        meta = json.loads(z.read(_META_NAME))
+    exported, meta = load_exported(path)
     return exported.call, meta
 
 
 def artifact_meta(cfg, checkpoint_path: Optional[str],
-                  dynamic_batch: bool) -> Dict[str, Any]:
-    """Provenance block written next to the program."""
+                  dynamic_batch: bool,
+                  gmm_fingerprint: Optional[str] = None,
+                  static_batch: Optional[int] = None) -> Dict[str, Any]:
+    """Provenance block written next to the program. `gmm_fingerprint`
+    identifies the mixture the weights carry (serving/calibration.py) —
+    the serving gate matches it against the embedded calibration's stamp
+    and fails closed on disagreement."""
     return {
+        "gmm_fingerprint": gmm_fingerprint,
+        "static_batch": None if dynamic_batch else static_batch,
         "format": "mgproto-stablehlo-v1",
         "arch": cfg.model.arch,
         "num_classes": cfg.model.num_classes,

@@ -25,10 +25,24 @@ Deterministic by construction: per-sample failures hash (seed, epoch,
 index), one-shot events key on the global step counter; one-shot state
 lives in the ChaosState object so a rollback replay does not re-inject.
 
+Serving-side injections (ISSUE 3) follow the same discipline — the
+ServingEngine consults the active state per request/dispatch:
+
+  * `serve_corrupt_request` — deterministic per request index: replace the
+    payload with a MALFORMED object (wrong shape) or NaN-poison it, at the
+    configured rates (exercises serving/validate.py's typed rejects);
+  * `serve_storm_due`     — requests in the storm window arrive already
+    past their deadline (exercises admission-control shedding);
+  * `serve_device_error_due` — listed dispatch indices raise a simulated
+    device failure (exercises the circuit breaker), each at most once.
+
 CLI runs configure chaos through env knobs (documented in
 `mgproto-train --help`): MGPROTO_CHAOS_SEED, MGPROTO_CHAOS_LOADER_IO_RATE,
 MGPROTO_CHAOS_LOADER_IO_FAILS, MGPROTO_CHAOS_NAN_AT_STEP,
-MGPROTO_CHAOS_PREEMPT_AT_STEP, MGPROTO_CHAOS_CKPT_FAILS.
+MGPROTO_CHAOS_PREEMPT_AT_STEP, MGPROTO_CHAOS_CKPT_FAILS, and for serving
+MGPROTO_CHAOS_SERVE_MALFORMED_RATE, MGPROTO_CHAOS_SERVE_NAN_RATE,
+MGPROTO_CHAOS_SERVE_DEVICE_ERRORS (comma-separated dispatch indices),
+MGPROTO_CHAOS_SERVE_STORM_AT, MGPROTO_CHAOS_SERVE_STORM_LEN.
 """
 
 from __future__ import annotations
@@ -36,7 +50,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -61,6 +75,17 @@ class ChaosPlan:
     preempt_at_step: Optional[int] = None
     # first N checkpoint writes fail after the tmp write, before the rename
     checkpoint_write_failures: int = 0
+    # serving: fraction of requests whose payload is replaced by a
+    # malformed object / NaN-poisoned (deterministic per request index)
+    serve_malformed_rate: float = 0.0
+    serve_nan_rate: float = 0.0
+    # serving: dispatch indices that raise a simulated device error (each
+    # fires at most once, so a breaker-gated retry of the same work heals)
+    serve_device_errors: Tuple[int, ...] = ()
+    # serving: requests [storm_at, storm_at + storm_len) arrive with their
+    # deadline already expired (a deadline storm for admission control)
+    serve_storm_at: Optional[int] = None
+    serve_storm_len: int = 0
 
     def any_active(self) -> bool:
         return (
@@ -68,6 +93,10 @@ class ChaosPlan:
             or self.nan_at_step is not None
             or self.preempt_at_step is not None
             or self.checkpoint_write_failures > 0
+            or self.serve_malformed_rate > 0.0
+            or self.serve_nan_rate > 0.0
+            or bool(self.serve_device_errors)
+            or (self.serve_storm_at is not None and self.serve_storm_len > 0)
         )
 
 
@@ -85,6 +114,9 @@ class ChaosState:
         self._nan_fired = False
         self._preempt_fired = False
         self._ckpt_failures_left = int(plan.checkpoint_write_failures)
+        self._serve_errors_left = set(
+            int(i) for i in plan.serve_device_errors
+        )
 
     def _count(self, kind: str) -> None:
         from mgproto_tpu.resilience import metrics as _m
@@ -140,6 +172,56 @@ class ChaosState:
             self._count("preempt_signal")
         return due
 
+    # ----------------------------------------------------------- serving path
+    def serve_corrupt_request(self, index: int, payload):
+        """Deterministically mangle request `index`'s payload: malformed
+        (wrong shape — must become a typed validation reject) or NaN-
+        poisoned (must become a typed `nonfinite` reject, never reach the
+        device). Precedence: malformed wins when both rates hit."""
+        p = self.plan
+        if p.serve_malformed_rate <= 0.0 and p.serve_nan_rate <= 0.0:
+            return payload
+        rng = np.random.default_rng([p.seed, 0x5E12, int(index)])
+        roll = rng.random()
+        if p.serve_malformed_rate > 0.0 and roll < p.serve_malformed_rate:
+            self._count("serve_malformed")
+            return np.zeros((3, 3), np.float32)  # wrong rank: bad_shape
+        if p.serve_nan_rate > 0.0 and roll < (
+            p.serve_malformed_rate + p.serve_nan_rate
+        ):
+            try:
+                shape = np.asarray(payload, np.float32).shape
+            except (ValueError, TypeError):
+                # payload is ALREADY malformed (ragged/non-numeric): pass
+                # it through untouched for the validator's typed reject —
+                # the injector must never crash the submit path it drills
+                return payload
+            self._count("serve_nan")
+            return np.full(shape, np.nan, np.float32)
+        return payload
+
+    def serve_storm_due(self, index: int) -> bool:
+        """True for requests inside the deadline-storm window."""
+        p = self.plan
+        if p.serve_storm_at is None or p.serve_storm_len <= 0:
+            return False
+        due = p.serve_storm_at <= int(index) < p.serve_storm_at + p.serve_storm_len
+        if due:
+            self._count("serve_deadline_storm")
+        return due
+
+    def serve_device_error_due(self, dispatch_index: int) -> bool:
+        """True exactly once per listed dispatch index (a breaker-paced
+        retry of later work must be able to heal)."""
+        if int(dispatch_index) not in self._serve_errors_left:
+            return False
+        with self._lock:
+            if int(dispatch_index) not in self._serve_errors_left:
+                return False
+            self._serve_errors_left.discard(int(dispatch_index))
+        self._count("serve_device_error")
+        return True
+
     # ---------------------------------------------------------- checkpoint IO
     def checkpoint_should_fail(self) -> bool:
         with self._lock:
@@ -189,6 +271,9 @@ def plan_from_env(environ=None) -> Optional[ChaosPlan]:
         except ValueError:
             raise ValueError(f"{name}={raw!r} is not a valid {cast.__name__}")
 
+    def _int_list(raw: str) -> Tuple[int, ...]:
+        return tuple(int(v) for v in raw.split(",") if v.strip() != "")
+
     plan = ChaosPlan(
         seed=_get("MGPROTO_CHAOS_SEED", int, 0),
         loader_io_rate=_get("MGPROTO_CHAOS_LOADER_IO_RATE", float, 0.0),
@@ -196,5 +281,14 @@ def plan_from_env(environ=None) -> Optional[ChaosPlan]:
         nan_at_step=_get("MGPROTO_CHAOS_NAN_AT_STEP", int, None),
         preempt_at_step=_get("MGPROTO_CHAOS_PREEMPT_AT_STEP", int, None),
         checkpoint_write_failures=_get("MGPROTO_CHAOS_CKPT_FAILS", int, 0),
+        serve_malformed_rate=_get(
+            "MGPROTO_CHAOS_SERVE_MALFORMED_RATE", float, 0.0
+        ),
+        serve_nan_rate=_get("MGPROTO_CHAOS_SERVE_NAN_RATE", float, 0.0),
+        serve_device_errors=_get(
+            "MGPROTO_CHAOS_SERVE_DEVICE_ERRORS", _int_list, ()
+        ),
+        serve_storm_at=_get("MGPROTO_CHAOS_SERVE_STORM_AT", int, None),
+        serve_storm_len=_get("MGPROTO_CHAOS_SERVE_STORM_LEN", int, 0),
     )
     return plan if plan.any_active() else None

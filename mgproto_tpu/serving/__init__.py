@@ -1,0 +1,73 @@
+"""Trustworthy serving subsystem (ISSUE 3).
+
+The inference boundary of the MGProto system: calibrated OoD abstention,
+per-request validation, bucketed static-shape dispatch, admission control
+with deadline shedding, a circuit breaker over device failures, and a
+degraded mode that keeps classification up when trust gating cannot run.
+
+Modules (import layering: everything except `engine` is importable without
+jax — calibration files must be readable on a bare operator host):
+
+  metrics     — serving counter/gauge/histogram names (jax-free).
+  validate    — payload -> typed reject or clean float32 array (jax-free).
+  calibration — ID-score calibration artifact + GMM fingerprint (numpy).
+  gate        — TrustGate: in_dist / abstain / ungated decisions (numpy).
+  admission   — AdmissionQueue + CircuitBreaker (jax-free).
+  health      — liveness/readiness probes over an engine (jax-free).
+  engine      — ServingEngine (imports jax; loaded lazily through
+                `__getattr__` so the package import stays jax-free).
+
+See README "Serving & trust gating" for the operator-facing story.
+"""
+
+from mgproto_tpu.serving import metrics
+from mgproto_tpu.serving.admission import (
+    AdmissionQueue,
+    CircuitBreaker,
+    ServeRequest,
+)
+from mgproto_tpu.serving.calibration import (
+    Calibration,
+    CalibrationError,
+    calibrate,
+    gmm_fingerprint,
+)
+from mgproto_tpu.serving.gate import TrustGate
+from mgproto_tpu.serving.health import HealthProbe
+from mgproto_tpu.serving.validate import (
+    ValidationFailure,
+    ValidationSpec,
+    validate_batch,
+    validate_image,
+)
+
+_LAZY = ("ServingEngine", "ServeResponse", "UncalibratedArtifactError")
+
+
+def __getattr__(name):
+    if name in _LAZY:  # engine imports jax; keep the package import light
+        from mgproto_tpu.serving import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "metrics",
+    "AdmissionQueue",
+    "CircuitBreaker",
+    "ServeRequest",
+    "Calibration",
+    "CalibrationError",
+    "calibrate",
+    "gmm_fingerprint",
+    "TrustGate",
+    "HealthProbe",
+    "ValidationFailure",
+    "ValidationSpec",
+    "validate_batch",
+    "validate_image",
+    "ServingEngine",
+    "ServeResponse",
+    "UncalibratedArtifactError",
+]

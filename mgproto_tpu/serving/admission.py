@@ -1,0 +1,218 @@
+"""Admission control: bounded queue, deadline shedding, circuit breaker.
+
+Under overload a serving process has exactly three honest moves: queue the
+request (bounded — an unbounded queue converts overload into latency for
+EVERYONE), shed it with a typed response, or stop accepting work while the
+backend is failing. All three live here, host-side and jax-free.
+
+  * `AdmissionQueue` — FIFO with a hard capacity and per-request deadlines.
+    Shedding is deadline-aware: a full queue first sheds entries that are
+    ALREADY past their deadline (oldest first — they can no longer be
+    answered in time, so they are the cheapest work to drop), and only
+    rejects the newcomer when everything queued is still viable. Batch
+    draining re-checks deadlines at pop time: a request that expired while
+    queued is shed, not served late.
+
+  * `CircuitBreaker` — closed -> open after `failure_threshold` consecutive
+    device failures; the open cooldown follows `resilience.retry`'s
+    exponential backoff schedule (the SAME policy module training IO uses,
+    so recovery pacing cannot drift between subsystems); after the cooldown
+    a half-open probe admits one batch — success closes the breaker and
+    resets the schedule, failure re-opens it at the next longer delay.
+
+Clocks are injectable (`clock=`) so chaos tests drive deadline storms and
+breaker recovery deterministically, without sleeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Any, Callable, Deque, List, Optional, Tuple
+
+from mgproto_tpu.resilience.retry import backoff_delays
+from mgproto_tpu.serving import metrics as _m
+
+SHED_QUEUE_FULL = "queue_full"
+SHED_DEADLINE = "deadline"
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+_STATE_GAUGE = {BREAKER_CLOSED: 0.0, BREAKER_HALF_OPEN: 0.5, BREAKER_OPEN: 1.0}
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One unit of admission: an opaque payload plus its latency contract.
+    `deadline` is an absolute clock() time (None = no deadline)."""
+
+    payload: Any
+    request_id: str
+    deadline: Optional[float] = None
+    enqueued_at: float = 0.0
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+class AdmissionQueue:
+    """Bounded FIFO with deadline-aware shedding (see module docstring)."""
+
+    def __init__(
+        self,
+        capacity: int = 64,
+        default_deadline_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.default_deadline_s = default_deadline_s
+        self.clock = clock
+        self._q: Deque[ServeRequest] = deque()
+        self._ids = itertools.count()
+        self.shed: List[ServeRequest] = []  # drained by the engine
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def _shed(self, req: ServeRequest, reason: str) -> None:
+        _m.counter(_m.SHED).inc(reason=reason)
+        self.shed.append(req)
+
+    def submit(
+        self,
+        payload: Any,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Tuple[Optional[ServeRequest], Optional[str]]:
+        """Admit a request; returns (request, None) on admission or
+        (request, shed_reason) when it was shed instead. The shed request is
+        ALSO recorded in `self.shed` so the engine answers it typed."""
+        now = self.clock()
+        rel = deadline_s if deadline_s is not None else self.default_deadline_s
+        req = ServeRequest(
+            payload=payload,
+            request_id=request_id or f"r{next(self._ids)}",
+            deadline=None if rel is None else now + rel,
+            enqueued_at=now,
+        )
+        if req.expired(now):  # born dead (deadline storm): never queue it
+            self._shed(req, SHED_DEADLINE)
+            return req, SHED_DEADLINE
+        if len(self._q) >= self.capacity:
+            # shed already-expired entries first (oldest first, anywhere in
+            # the queue — an expired entry behind a viable head is just as
+            # unserveable); they free room without breaking anyone's
+            # still-live latency contract
+            keep: Deque[ServeRequest] = deque()
+            for queued in self._q:
+                if queued.expired(now):
+                    self._shed(queued, SHED_DEADLINE)
+                else:
+                    keep.append(queued)
+            self._q = keep
+            if len(self._q) >= self.capacity:
+                self._shed(req, SHED_QUEUE_FULL)
+                return req, SHED_QUEUE_FULL
+        self._q.append(req)
+        return req, None
+
+    def pop_batch(self, max_size: int) -> List[ServeRequest]:
+        """Up to `max_size` still-viable requests, FIFO; entries whose
+        deadline passed while queued are shed here, not served late."""
+        now = self.clock()
+        out: List[ServeRequest] = []
+        while self._q and len(out) < max_size:
+            req = self._q.popleft()
+            if req.expired(now):
+                self._shed(req, SHED_DEADLINE)
+                continue
+            out.append(req)
+        return out
+
+    def drain_shed(self) -> List[ServeRequest]:
+        """Hand the accumulated shed requests to the caller (clears them)."""
+        out, self.shed = self.shed, []
+        return out
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with retry-policy-paced recovery."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        base_delay: float = 0.5,
+        max_delay: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}"
+            )
+        self.failure_threshold = int(failure_threshold)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.clock = clock
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self._open_until = 0.0
+        self._reopen_count = 0
+        _m.gauge(_m.BREAKER_STATE).set(_STATE_GAUGE[self.state])
+
+    def _transition(self, new_state: str) -> None:
+        if new_state == self.state:
+            return
+        _m.counter(_m.BREAKER_TRANSITIONS).inc(
+            edge=f"{self.state}->{new_state}"
+        )
+        self.state = new_state
+        _m.gauge(_m.BREAKER_STATE).set(_STATE_GAUGE[new_state])
+
+    def _cooldown(self) -> float:
+        """The k-th open period's length: the retry module's backoff
+        schedule, jitter-free (deterministic recovery pacing)."""
+        delays = list(
+            backoff_delays(
+                self._reopen_count + 1,
+                base_delay=self.base_delay,
+                max_delay=self.max_delay,
+                jitter=0.0,
+            )
+        )
+        return delays[-1]
+
+    def allow(self) -> bool:
+        """May a batch be dispatched now? An elapsed cooldown moves the
+        breaker to half-open and admits ONE probe batch."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN and self.clock() >= self._open_until:
+            self._transition(BREAKER_HALF_OPEN)
+            return True
+        return self.state == BREAKER_HALF_OPEN
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state != BREAKER_CLOSED:
+            self._transition(BREAKER_CLOSED)
+            self._reopen_count = 0
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == BREAKER_HALF_OPEN:
+            # failed probe: back to open, next-longer cooldown
+            self._reopen_count += 1
+            self._open_until = self.clock() + self._cooldown()
+            self._transition(BREAKER_OPEN)
+        elif (
+            self.state == BREAKER_CLOSED
+            and self.consecutive_failures >= self.failure_threshold
+        ):
+            self._open_until = self.clock() + self._cooldown()
+            self._transition(BREAKER_OPEN)

@@ -1,0 +1,205 @@
+"""ID-score calibration: the trust data a served model cannot run without.
+
+MGProto's abstention signal is the generative score log p(x) (PAPER.md;
+`core/mgproto.py:log_px`). Its absolute scale is a property of the TRAINED
+mixture — it moves with every EM step, push projection, and especially
+`prune_top_m` (which removes mixture mass; core/mgproto.py:334-338 warns
+"recompute OoD thresholds afterwards"). A threshold is therefore only valid
+for the exact GMM it was measured against, so a calibration carries:
+
+  * percentile thresholds of the held-out ID set's log p(x) (the operating
+    points; the serving default is the same 5th percentile the evaluation
+    driver uses, engine/evaluate.py),
+  * a quantile sketch (101 evenly spaced quantiles) of the ID log p(x)
+    distribution — any other operating point can be interpolated at serve
+    time without rescoring the ID set,
+  * a per-class logit temperature (dispersion equalizer for confidence
+    reporting),
+  * `gmm_fingerprint`: sha256 over the GMM pytree the scores were measured
+    under. The trust gate FAILS CLOSED on mismatch (serving/gate.py):
+    prune-then-serve without recalibration is detected, not silently wrong.
+
+Persisted as `calibration.json` inside the `.mgproto` export artifact
+(engine/export.py) — the artifact either carries its trust data or the
+engine refuses to gate with it.
+
+Load path is numpy+stdlib only: a bare serving host must be able to read a
+calibration without the model stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+CALIBRATION_FORMAT = "mgproto-calibration-v1"
+DEFAULT_PERCENTILES: Tuple[float, ...] = (1.0, 5.0, 10.0)
+DEFAULT_PERCENTILE = 5.0
+_SKETCH_POINTS = 101  # quantiles at 0, 1, ..., 100
+
+
+class CalibrationError(ValueError):
+    """Malformed/missing/incompatible calibration payload."""
+
+
+def gmm_fingerprint(gmm) -> str:
+    """sha256 over the GMM pytree (means/sigmas/priors/keep — structure and
+    exact leaf bytes). Any transform that moves the p(x) scale — EM, push,
+    prune — changes it, which is exactly the invalidation we want."""
+    from mgproto_tpu.utils.checkpoint import pytree_digest
+
+    return pytree_digest(gmm)
+
+
+@dataclasses.dataclass(frozen=True)
+class Calibration:
+    """Serve-time trust data (see module docstring for the fields' story)."""
+
+    percentile: float  # the default operating point
+    threshold_log_px: float  # ID log p(x) at `percentile`
+    thresholds: Dict[str, float]  # percentile (as str) -> log p(x)
+    quantile_log_px: Tuple[float, ...]  # sketch at 0..100, len 101
+    per_class_temperature: Tuple[float, ...]
+    gmm_fingerprint: str
+    num_id_samples: int
+    source: str = ""  # provenance: where the ID scores came from
+
+    # ---------------------------------------------------------------- derive
+    @staticmethod
+    def from_scores(
+        id_log_px: np.ndarray,
+        id_logits: np.ndarray,
+        fingerprint: str,
+        percentile: float = DEFAULT_PERCENTILE,
+        percentiles: Sequence[float] = DEFAULT_PERCENTILES,
+        source: str = "",
+    ) -> "Calibration":
+        """Build from per-sample held-out ID scores (log p(x) [N] and class
+        log-likelihoods [N, C]), host-side float64 like the eval driver."""
+        scores = np.asarray(id_log_px, np.float64).ravel()
+        if scores.size == 0:
+            raise CalibrationError("cannot calibrate from zero ID samples")
+        if not np.isfinite(scores).all():
+            raise CalibrationError("non-finite ID log p(x) scores")
+        pcts = sorted(set(float(p) for p in percentiles) | {float(percentile)})
+        thresholds = {
+            f"{p:g}": float(np.percentile(scores, p)) for p in pcts
+        }
+        sketch = tuple(
+            float(v)
+            for v in np.percentile(scores, np.linspace(0.0, 100.0, _SKETCH_POINTS))
+        )
+        logits = np.asarray(id_logits, np.float64)
+        # dispersion equalizer: per-class std of log p(x|c), scaled so the
+        # mean temperature is 1.0 (a pure reshape of confidence, never of
+        # the abstention decision, which gates on log p(x) alone)
+        stds = np.maximum(logits.std(axis=0), 1e-6)
+        temps = stds / float(stds.mean())
+        return Calibration(
+            percentile=float(percentile),
+            threshold_log_px=thresholds[f"{float(percentile):g}"],
+            thresholds=thresholds,
+            quantile_log_px=sketch,
+            per_class_temperature=tuple(float(t) for t in temps),
+            gmm_fingerprint=str(fingerprint),
+            num_id_samples=int(scores.size),
+            source=source,
+        )
+
+    # ---------------------------------------------------------------- lookup
+    def threshold_for(self, percentile: float) -> float:
+        """log p(x) threshold at any operating point, interpolated from the
+        quantile sketch (exact at the persisted percentiles)."""
+        key = f"{float(percentile):g}"
+        if key in self.thresholds:
+            return self.thresholds[key]
+        if not 0.0 <= percentile <= 100.0:
+            raise CalibrationError(
+                f"percentile must be in [0, 100], got {percentile}"
+            )
+        q = np.linspace(0.0, 100.0, len(self.quantile_log_px))
+        return float(np.interp(percentile, q, self.quantile_log_px))
+
+    def id_quantile_of(self, log_px: float) -> float:
+        """Where a score sits in the ID distribution (0..1) — the serving
+        response's calibrated trust score."""
+        q = np.linspace(0.0, 1.0, len(self.quantile_log_px))
+        return float(np.interp(log_px, self.quantile_log_px, q))
+
+    # ------------------------------------------------------------- serialize
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["format"] = CALIBRATION_FORMAT
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "Calibration":
+        fmt = d.get("format")
+        if fmt != CALIBRATION_FORMAT:
+            raise CalibrationError(f"unknown calibration format {fmt!r}")
+        try:
+            return Calibration(
+                percentile=float(d["percentile"]),
+                threshold_log_px=float(d["threshold_log_px"]),
+                thresholds={k: float(v) for k, v in d["thresholds"].items()},
+                quantile_log_px=tuple(float(v) for v in d["quantile_log_px"]),
+                per_class_temperature=tuple(
+                    float(t) for t in d["per_class_temperature"]
+                ),
+                gmm_fingerprint=str(d["gmm_fingerprint"]),
+                num_id_samples=int(d["num_id_samples"]),
+                source=str(d.get("source", "")),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise CalibrationError(f"malformed calibration payload: {e}")
+
+    @staticmethod
+    def from_json(text: str) -> "Calibration":
+        try:
+            d = json.loads(text)
+        except ValueError as e:
+            raise CalibrationError(f"calibration is not valid JSON: {e}")
+        return Calibration.from_dict(d)
+
+
+def calibrate(
+    trainer, state, id_batches: Iterable, percentile: float = DEFAULT_PERCENTILE,
+    percentiles: Sequence[float] = DEFAULT_PERCENTILES, source: str = "",
+) -> Calibration:
+    """Derive a Calibration from a held-out ID loader through the SAME eval
+    step the engine serves with (`Trainer.eval_step` -> engine/evaluate.py's
+    shared loop), so thresholds and served scores share one code path."""
+    from mgproto_tpu.engine.evaluate import _run_eval
+
+    id_log_px, _, _, _, id_logits = _run_eval(trainer, state, id_batches)
+    return Calibration.from_scores(
+        id_log_px,
+        id_logits,
+        fingerprint=gmm_fingerprint(state.gmm),
+        percentile=percentile,
+        percentiles=percentiles,
+        source=source,
+    )
+
+
+def calibrate_from_config(
+    cfg, trainer, state, percentile: float = DEFAULT_PERCENTILE
+) -> Calibration:
+    """CLI-facing wrapper: derive the calibration from the config's held-
+    out ID loader (`cfg.data.test_dir`), with its provenance recorded. The
+    ONE implementation behind both `mgproto-export --calibrate` and
+    `mgproto-serve --calibrate`, so export-time and serve-time
+    calibrations cannot drift."""
+    from mgproto_tpu.data import build_pipelines
+
+    _, _, test_loader, _ = build_pipelines(cfg)
+    return calibrate(
+        trainer, state, test_loader, percentile=percentile,
+        source=f"test_dir={cfg.data.test_dir}",
+    )

@@ -1,0 +1,443 @@
+"""ServingEngine: bucketed, trust-gated inference over a frozen model.
+
+The production serving loop the ROADMAP's "heavy traffic" north star needs,
+applied to the inference boundary:
+
+  * STATIC SHAPES ONLY. XLA recompiles per input shape ("Memory Safe
+    Computations with XLA Compiler", PAPERS.md), so naive per-request
+    shapes stall the fleet. The engine serves a fixed set of batch-size
+    BUCKETS: requests are padded to the smallest fitting bucket, every
+    bucket is compiled at warmup, and steady state performs ZERO further
+    compiles — asserted in tier-1 via the telemetry StepMonitor's
+    recompile detector watching the engine's jit handle.
+  * TYPED RESPONSES, NEVER EXCEPTIONS. Payloads are validated host-side
+    (serving/validate.py) into typed rejects; device failures are caught
+    and answered as rejects while feeding the circuit breaker; overload is
+    shed by the admission queue. `process_pending` cannot raise from a
+    request's content.
+  * TRUST GATING. Every served prediction carries log p(x) and a trust
+    label from the calibrated gate (serving/gate.py); without a valid
+    calibration the engine serves in DEGRADED mode — classification only,
+    flagged per response — rather than inventing thresholds.
+
+Two sources of truth for the model:
+
+  * `from_live(trainer, state)` — a live TrainState; serves through the
+    same jitted eval step training evaluates with.
+  * `from_artifact(path)` — an exported `.mgproto` zip (engine/export.py):
+    the StableHLO program plus its embedded calibration. Refuses an
+    uncalibrated artifact unless `allow_uncalibrated=True` (which serves
+    degraded), because a trust-gating engine without trust data is exactly
+    the silent failure this subsystem exists to prevent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mgproto_tpu.resilience import chaos as _chaos
+from mgproto_tpu.serving import metrics as _m
+from mgproto_tpu.serving.admission import (
+    AdmissionQueue,
+    CircuitBreaker,
+    ServeRequest,
+)
+from mgproto_tpu.serving.calibration import Calibration
+from mgproto_tpu.serving.gate import (
+    TRUST_ABSTAIN,
+    TRUST_UNGATED,
+    TrustGate,
+)
+from mgproto_tpu.serving.validate import (
+    ValidationFailure,
+    ValidationSpec,
+    validate_image,
+)
+from mgproto_tpu.telemetry.monitor import StepMonitor
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8)
+
+OUTCOME_PREDICT = "predict"
+OUTCOME_ABSTAIN = "abstain"
+OUTCOME_REJECT = "reject"
+OUTCOME_SHED = "shed"
+
+REASON_CIRCUIT_OPEN = "circuit_open"
+REASON_DEVICE_ERROR = "device_error"
+
+
+class UncalibratedArtifactError(RuntimeError):
+    """Artifact has no embedded calibration and --allow-uncalibrated is off."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResponse:
+    """The one shape every request is answered with — no other exit path."""
+
+    request_id: str
+    outcome: str  # predict | abstain | reject | shed
+    prediction: Optional[int] = None
+    log_px: Optional[float] = None
+    trust: Optional[str] = None  # in_dist | abstain | ungated
+    trust_score: Optional[float] = None  # calibrated ID-quantile of log_px
+    confidence: Optional[float] = None  # temperature-calibrated max softmax
+    degraded: bool = False
+    reason: Optional[str] = None  # reject/shed cause
+    latency_s: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class ServingEngine:
+    def __init__(
+        self,
+        infer_fn: Callable,
+        img_size: int,
+        num_classes: int,
+        calibration: Optional[Calibration] = None,
+        expected_fingerprint: Optional[str] = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        percentile: Optional[float] = None,
+        queue_capacity: int = 64,
+        default_deadline_s: Optional[float] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        clock: Callable[[], float] = time.monotonic,
+        monitor: Optional[StepMonitor] = None,
+    ):
+        """`infer_fn` maps float32 images [b, H, W, 3] to
+        {"logits": [b, C], "log_px": [b]} and is jit-wrapped here so the
+        recompile detector can watch its cache."""
+        import jax
+
+        if not buckets:
+            raise ValueError("need at least one batch-size bucket")
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if self.buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {self.buckets}")
+        self.img_size = int(img_size)
+        self.num_classes = int(num_classes)
+        self.spec = ValidationSpec(img_size=self.img_size)
+        self.clock = clock
+        self._jit = jax.jit(infer_fn)
+        self.gate = TrustGate(
+            calibration,
+            expected_fingerprint=expected_fingerprint,
+            percentile=percentile,
+        )
+        self.queue = AdmissionQueue(
+            capacity=queue_capacity,
+            default_deadline_s=default_deadline_s,
+            clock=clock,
+        )
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.monitor = monitor if monitor is not None else StepMonitor(
+            phase="serve"
+        )
+        self.monitor.watch(self._jit)
+        self.warmed_up = False
+        self._request_seq = 0  # chaos injection index over admitted order
+        self._dispatch_seq = 0  # chaos injection index over device dispatches
+
+    # ------------------------------------------------------------ constructors
+    @classmethod
+    def from_live(
+        cls, trainer, state, calibration: Optional[Calibration] = None, **kw
+    ) -> "ServingEngine":
+        """Serve a live TrainState through the trainer's eval math. The
+        expected fingerprint comes from the state's ACTUAL mixture, so a
+        calibration measured before a prune/EM/push is refused (fail-closed
+        into degraded mode) rather than silently misgating."""
+        from mgproto_tpu.serving.calibration import gmm_fingerprint
+
+        def infer(images):
+            out = trainer._eval(state, images, None)
+            return {"logits": out.logits, "log_px": out.log_px}
+
+        return cls(
+            infer,
+            img_size=trainer.cfg.model.img_size,
+            num_classes=trainer.cfg.model.num_classes,
+            calibration=calibration,
+            expected_fingerprint=gmm_fingerprint(state.gmm),
+            **kw,
+        )
+
+    @classmethod
+    def from_artifact(
+        cls, path: str, allow_uncalibrated: bool = False, **kw
+    ) -> "ServingEngine":
+        """Serve an exported `.mgproto` artifact (StableHLO + calibration).
+
+        A static-batch artifact constrains the buckets to its pinned batch
+        size; a dynamic-batch artifact serves every configured bucket (each
+        bucket still compiles exactly once, at warmup)."""
+        from mgproto_tpu.engine.export import load_calibration, load_exported
+
+        exported, meta = load_exported(path)
+        calibration = load_calibration(path)
+        if calibration is None and not allow_uncalibrated:
+            raise UncalibratedArtifactError(
+                f"{path} carries no calibration.json; re-export with "
+                "--calibrate, or pass --allow-uncalibrated to serve "
+                "classification WITHOUT OoD abstention (degraded mode)"
+            )
+        if not meta.get("dynamic_batch", True):
+            # a static-batch program serves exactly one shape: any caller-
+            # supplied bucket list would dispatch-fail on every batch.
+            # Pre-`static_batch` metas recover the pin from the program's
+            # own input aval instead of crashing at warmup.
+            static = meta.get("static_batch") or int(
+                exported.in_avals[0].shape[0]
+            )
+            kw["buckets"] = (int(static),)
+        return cls(
+            exported.call,
+            img_size=int(meta["img_size"]),
+            num_classes=int(meta["num_classes"]),
+            calibration=calibration,
+            expected_fingerprint=meta.get("gmm_fingerprint"),
+            **kw,
+        )
+
+    # ----------------------------------------------------------------- warmup
+    def warmup(self) -> int:
+        """Compile every bucket shape ahead of traffic; returns the number
+        of compiled variants. After this, any recompile the monitor sees in
+        steady state is a bug (the tier-1 chaos test asserts zero)."""
+        for b in self.buckets:
+            zeros = np.zeros(
+                (b, self.img_size, self.img_size, 3), np.float32
+            )
+            out = self._jit(zeros)
+            np.asarray(out["log_px"])  # block until compiled + executed
+        self.warmed_up = True
+        return self.monitor.check_recompiles()
+
+    # ------------------------------------------------------------- admission
+    def submit(
+        self,
+        payload: Any,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> List[ServeResponse]:
+        """Validate + admit one request. Returns the immediate typed
+        responses this submission produced: a validation reject, a shed
+        response for THIS request, and/or shed responses for queued
+        requests evicted past their deadline to make room. Empty list =
+        queued; the response comes from `process_pending`."""
+        t0 = self.clock()
+        seq = self._request_seq
+        self._request_seq += 1
+        chaos = _chaos.get_active()
+        if chaos is not None:
+            payload = chaos.serve_corrupt_request(seq, payload)
+            if chaos.serve_storm_due(seq):
+                deadline_s = -1.0  # arrives already past its deadline
+        if deadline_s is not None and deadline_s <= 0:
+            # born dead: shedding is cheaper than validating, so a deadline
+            # storm never spends host CPU on payloads nobody can wait for
+            _m.counter(_m.SHED).inc(reason="deadline")
+            return [
+                self._respond(
+                    ServeResponse(
+                        request_id=request_id or f"v{seq}",
+                        outcome=OUTCOME_SHED,
+                        reason="deadline",
+                        degraded=self.gate.degraded,
+                        latency_s=0.0,
+                    )
+                )
+            ]
+        try:
+            clean = validate_image(payload, self.spec)
+        except ValidationFailure as e:
+            return [
+                self._respond(
+                    ServeResponse(
+                        request_id=request_id or f"v{seq}",
+                        outcome=OUTCOME_REJECT,
+                        reason=e.reason,
+                        degraded=self.gate.degraded,
+                        latency_s=self.clock() - t0,
+                    )
+                )
+            ]
+        req, shed_reason = self.queue.submit(
+            clean, request_id=request_id, deadline_s=deadline_s
+        )
+        out = []
+        for shed in self.queue.drain_shed():
+            reason = shed_reason if shed is req else "deadline"
+            out.append(self._respond(self._shed_response(shed, reason)))
+        return out
+
+    def _shed_response(self, req: ServeRequest, reason: str) -> ServeResponse:
+        return ServeResponse(
+            request_id=req.request_id,
+            outcome=OUTCOME_SHED,
+            reason=reason,
+            degraded=self.gate.degraded,
+            latency_s=self.clock() - req.enqueued_at,
+        )
+
+    # ------------------------------------------------------------- processing
+    def process_pending(self) -> List[ServeResponse]:
+        """Serve one bucket's worth of queued requests (plus any typed
+        responses for requests shed while queued). Never raises from
+        request content or device failure."""
+        responses: List[ServeResponse] = []
+        batch = self.queue.pop_batch(self.buckets[-1])
+        # requests shed at pop time (expired while queued) answer typed
+        for req in self.queue.drain_shed():
+            responses.append(
+                self._respond(self._shed_response(req, "deadline"))
+            )
+        if not batch:
+            return responses
+        if not self.breaker.allow():
+            # typed unavailability beats silent queue growth: the caller
+            # sees REJECT/circuit_open and can retry against a replica
+            for req in batch:
+                responses.append(
+                    self._respond(
+                        ServeResponse(
+                            request_id=req.request_id,
+                            outcome=OUTCOME_REJECT,
+                            reason=REASON_CIRCUIT_OPEN,
+                            degraded=self.gate.degraded,
+                            latency_s=self.clock() - req.enqueued_at,
+                        )
+                    )
+                )
+            return responses
+        try:
+            logits, log_px = self._dispatch(
+                np.stack([r.payload for r in batch])
+            )
+        except Exception:
+            self.breaker.record_failure()
+            _m.counter(_m.DEVICE_ERRORS).inc()
+            for req in batch:
+                responses.append(
+                    self._respond(
+                        ServeResponse(
+                            request_id=req.request_id,
+                            outcome=OUTCOME_REJECT,
+                            reason=REASON_DEVICE_ERROR,
+                            degraded=self.gate.degraded,
+                            latency_s=self.clock() - req.enqueued_at,
+                        )
+                    )
+                )
+            return responses
+        self.breaker.record_success()
+        responses.extend(self._gated_responses(batch, logits, log_px))
+        return responses
+
+    def serve_all(self, payloads: Sequence[Any],
+                  deadline_s: Optional[float] = None,
+                  request_ids: Optional[Sequence[str]] = None
+                  ) -> List[ServeResponse]:
+        """Batch driver (CLI / tests): submit everything, drain to
+        completion, return responses in submission order."""
+        order: Dict[str, int] = {}
+        responses: List[ServeResponse] = []
+        for i, payload in enumerate(payloads):
+            rid = request_ids[i] if request_ids is not None else f"req{i}"
+            order[rid] = i
+            responses.extend(
+                self.submit(payload, request_id=rid, deadline_s=deadline_s)
+            )
+        # every pop either answers or sheds-with-answer, so this terminates
+        # with zero requests left unanswered
+        while len(self.queue):
+            responses.extend(self.process_pending())
+        return sorted(
+            responses, key=lambda r: order.get(r.request_id, len(order))
+        )
+
+    # -------------------------------------------------------------- internals
+    def _dispatch(
+        self, images: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Pad to bucket, run the compiled program, slice the padding off.
+        Raises on (real or chaos-injected) device failure."""
+        from mgproto_tpu.telemetry.tracing import trace_span
+
+        n = images.shape[0]
+        bucket = self._bucket_for(n)
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+        chaos = _chaos.get_active()
+        padded = images
+        if bucket != n:
+            padded = np.zeros(
+                (bucket, self.img_size, self.img_size, 3), np.float32
+            )
+            padded[:n] = images
+        _m.gauge(_m.BATCH_FILL).set(n / bucket)
+        t0 = time.perf_counter()
+        with trace_span("serve_dispatch", bucket=bucket, fill=n):
+            if chaos is not None and chaos.serve_device_error_due(seq):
+                raise _chaos.ChaosError(
+                    f"chaos: simulated device failure at dispatch {seq}"
+                )
+            out = self._jit(padded)
+            logits = np.asarray(out["logits"], np.float64)[:n]
+            log_px = np.asarray(out["log_px"], np.float64)[:n]
+        self.monitor.observe_step(n, time.perf_counter() - t0,
+                                  transfer_bytes=int(padded.nbytes))
+        return logits, log_px
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _gated_responses(
+        self, batch: List[ServeRequest], logits: np.ndarray, log_px: np.ndarray
+    ) -> List[ServeResponse]:
+        preds = np.argmax(logits, axis=-1)
+        try:
+            labels = self.gate.decide(log_px)
+            degraded = self.gate.degraded
+        except Exception:
+            # the gate itself erring must not take serving down: degrade
+            # THIS batch to ungated classification, flagged per response
+            labels = [TRUST_UNGATED] * len(batch)
+            degraded = True
+        out = []
+        for req, pred, row, score, label in zip(
+            batch, preds, logits, log_px, labels
+        ):
+            outcome = (
+                OUTCOME_ABSTAIN if label == TRUST_ABSTAIN else OUTCOME_PREDICT
+            )
+            resp = ServeResponse(
+                request_id=req.request_id,
+                outcome=outcome,
+                prediction=int(pred),
+                log_px=float(score),
+                trust=label,
+                trust_score=self.gate.trust_score(float(score)),
+                confidence=self.gate.confidence(row),
+                degraded=degraded or label == TRUST_UNGATED,
+                latency_s=self.clock() - req.enqueued_at,
+            )
+            out.append(self._respond(resp))
+        return out
+
+    def _respond(self, resp: ServeResponse) -> ServeResponse:
+        _m.counter(_m.REQUESTS).inc(outcome=resp.outcome)
+        _m.histogram(_m.REQUEST_SECONDS).observe(
+            max(resp.latency_s, 0.0), outcome=resp.outcome
+        )
+        if resp.degraded and resp.outcome == OUTCOME_PREDICT:
+            _m.counter(_m.DEGRADED_REQUESTS).inc()
+        return resp

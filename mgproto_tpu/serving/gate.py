@@ -1,0 +1,137 @@
+"""TrustGate: calibrated OoD abstention over served log p(x) scores.
+
+The gate turns a raw generative score into a trust decision:
+
+  * `in_dist`  — log p(x) strictly above the calibrated ID-percentile
+                 threshold (the same `score > thresh` comparison
+                 `evaluate_with_ood` uses, so serve-time decisions and the
+                 eval driver agree even ON the boundary).
+  * `abstain`  — at or below threshold: the model still reports its argmax (a
+                 downstream fallback may want it) but flags the input as
+                 out-of-distribution at the calibrated operating point.
+  * `ungated`  — degraded mode: no valid calibration, so classification is
+                 served WITHOUT an OoD decision, explicitly flagged.
+
+Fail-closed fingerprint discipline (ISSUE 3 satellite): a calibration is
+only honored when its `gmm_fingerprint` matches the mixture actually being
+served. `prune_top_m` (or any EM/push) shifts the absolute p(x) scale —
+core/mgproto.py:334-338 — so a stale calibration silently misgates; on
+mismatch the gate drops to degraded mode and counts
+`serving_fingerprint_mismatch_total`, rather than gating with wrong
+thresholds.
+
+The trailing abstain rate is exported as the `serving_abstain_rate` gauge —
+the first dashboard signal that live traffic has drifted away from the
+calibration set.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional, Sequence
+
+import numpy as np
+
+from mgproto_tpu.serving import metrics as _m
+from mgproto_tpu.serving.calibration import Calibration
+
+TRUST_IN_DIST = "in_dist"
+TRUST_ABSTAIN = "abstain"
+TRUST_UNGATED = "ungated"
+
+
+class TrustGate:
+    """Per-sample trust decisions from a Calibration (or None = degraded).
+
+    `expected_fingerprint` is the GMM actually being served (live state's
+    fingerprint, or the artifact's stamped one); when it disagrees with the
+    calibration's, the gate installs itself in degraded mode.
+    """
+
+    def __init__(
+        self,
+        calibration: Optional[Calibration],
+        expected_fingerprint: Optional[str] = None,
+        percentile: Optional[float] = None,
+        window: int = 256,
+    ):
+        self.fingerprint_mismatch = False
+        if (
+            calibration is not None
+            and expected_fingerprint is not None
+            and calibration.gmm_fingerprint != expected_fingerprint
+        ):
+            _m.counter(_m.FINGERPRINT_MISMATCHES).inc()
+            self.fingerprint_mismatch = True
+            calibration = None  # fail closed: degrade, don't misgate
+        self.calibration = calibration
+        self.threshold: Optional[float] = None
+        if calibration is not None:
+            self.threshold = (
+                calibration.threshold_log_px
+                if percentile is None
+                else calibration.threshold_for(percentile)
+            )
+        self._window: Deque[bool] = deque(maxlen=max(int(window), 1))
+
+    @property
+    def degraded(self) -> bool:
+        """True when decisions are ungated (no/invalid calibration)."""
+        return self.calibration is None
+
+    # -------------------------------------------------------------- decisions
+    def decide(self, log_px: Sequence[float]) -> List[str]:
+        """Trust label per sample; updates the trailing abstain-rate gauge."""
+        scores = np.asarray(log_px, np.float64).ravel()
+        if self.calibration is None:
+            return [TRUST_UNGATED] * scores.size
+        labels = []
+        for s in scores:
+            # a non-finite score coming back from the device is by
+            # definition not in-distribution — abstain, never compare NaN.
+            # <=, not <: evaluate_with_ood flags in-distribution on
+            # `score > thresh`, and the threshold is an ID percentile that
+            # frequently EQUALS a real sample's score — the boundary must
+            # decide the same way on both sides of the export seam
+            abstain = (not np.isfinite(s)) or (s <= self.threshold)
+            labels.append(TRUST_ABSTAIN if abstain else TRUST_IN_DIST)
+            self._window.append(abstain)
+        if self._window:
+            _m.gauge(_m.ABSTAIN_RATE).set(
+                sum(self._window) / len(self._window)
+            )
+        return labels
+
+    def trust_score(self, log_px: float) -> Optional[float]:
+        """Calibrated ID-quantile of a score (None in degraded mode)."""
+        if self.calibration is None or not np.isfinite(log_px):
+            return None
+        return self.calibration.id_quantile_of(float(log_px))
+
+    def confidence(self, logits_row: Sequence[float]) -> Optional[float]:
+        """Calibrated class confidence: softmax over the per-class
+        temperature-scaled log-likelihoods (the dispersion equalizer the
+        calibration measured on held-out ID data), max over classes.
+        None in degraded mode — an uncalibrated softmax would look like a
+        probability without being one."""
+        if self.calibration is None:
+            return None
+        try:
+            z = np.asarray(logits_row, np.float64) / np.asarray(
+                self.calibration.per_class_temperature, np.float64
+            )
+            if not np.isfinite(z).all():
+                return None
+            z = z - z.max()
+            p = np.exp(z)
+            return float(p.max() / p.sum())
+        except (ValueError, TypeError):
+            # e.g. a calibration whose class count disagrees with the
+            # served head: no confidence beats a wrong one
+            return None
+
+    @property
+    def abstain_rate(self) -> Optional[float]:
+        if not self._window:
+            return None
+        return sum(self._window) / len(self._window)

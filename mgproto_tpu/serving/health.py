@@ -1,0 +1,46 @@
+"""Health/readiness probes reflecting warmup + breaker + gate state.
+
+Two distinct questions, per the usual orchestration contract:
+
+  * liveness  — "is the process wedged?" Always true while the engine
+    object is intact; an orchestrator restarts on false/timeout.
+  * readiness — "should traffic be routed here?" False until bucket warmup
+    has compiled every serving shape (first-request compiles would blow the
+    latency SLO) and while the circuit breaker is OPEN (the backend is
+    failing; routing more traffic in makes the outage worse).
+
+Degraded mode is READY (classification still serves) but reported, so a
+fleet can alert on trust-gating coverage without failing over.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from mgproto_tpu.serving.admission import BREAKER_OPEN
+
+
+class HealthProbe:
+    """Probe views over a ServingEngine (no references held to request
+    payloads; safe to poll from any thread)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def liveness(self) -> Dict[str, Any]:
+        return {"alive": True}
+
+    def readiness(self) -> Dict[str, Any]:
+        e = self.engine
+        breaker_open = e.breaker.state == BREAKER_OPEN
+        ready = e.warmed_up and not breaker_open
+        return {
+            "ready": ready,
+            "warmed_up": e.warmed_up,
+            "buckets": list(e.buckets),
+            "breaker_state": e.breaker.state,
+            "degraded": e.gate.degraded,
+            "fingerprint_mismatch": e.gate.fingerprint_mismatch,
+            "queue_depth": len(e.queue),
+            "queue_capacity": e.queue.capacity,
+        }

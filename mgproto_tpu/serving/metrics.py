@@ -1,0 +1,84 @@
+"""Serving metric names + registration (jax-free).
+
+Every serving-path event — request outcomes, abstentions, load shedding,
+breaker transitions, calibration-fingerprint mismatches, degraded-mode
+requests — lands in the telemetry registry as a labeled counter/gauge, so
+`mgproto-telemetry summarize` renders the serving story next to throughput
+and training health (companion to `resilience/metrics.py`).
+
+Counters resolve through `default_registry()` on first use (they follow
+whatever registry the live TelemetrySession installed), and
+`register_serving_metrics` pre-registers the whole family so a clean run
+reports explicit zeros instead of absent series.
+"""
+
+from __future__ import annotations
+
+from mgproto_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    default_registry,
+)
+
+REQUESTS = "serving_requests_total"
+REQUEST_SECONDS = "serving_request_seconds"
+ABSTAIN_RATE = "serving_abstain_rate"
+SHED = "serving_shed_total"
+BREAKER_STATE = "serving_breaker_state"
+BREAKER_TRANSITIONS = "serving_breaker_transitions_total"
+FINGERPRINT_MISMATCHES = "serving_fingerprint_mismatch_total"
+DEGRADED_REQUESTS = "serving_degraded_requests_total"
+DEVICE_ERRORS = "serving_device_errors_total"
+BATCH_FILL = "serving_batch_fill_ratio"
+
+COUNTER_HELP = {
+    REQUESTS: "requests by outcome (predict/abstain/reject/shed)",
+    SHED: "requests shed by admission control (queue_full/deadline)",
+    BREAKER_TRANSITIONS: "circuit breaker state transitions, by edge",
+    FINGERPRINT_MISMATCHES:
+        "calibrations rejected because the served GMM does not match the "
+        "fingerprint the thresholds were derived from",
+    DEGRADED_REQUESTS: "requests answered WITHOUT OoD gating (degraded mode)",
+    DEVICE_ERRORS: "inference dispatches that raised a device error",
+}
+
+GAUGE_HELP = {
+    ABSTAIN_RATE: "abstain fraction over the trailing decision window",
+    BREAKER_STATE: "circuit breaker state (0=closed, 0.5=half-open, 1=open)",
+    BATCH_FILL: "occupied fraction of the last padded serving batch",
+}
+
+HIST_HELP = {
+    REQUEST_SECONDS: "per-request latency (admission to response), by outcome",
+}
+
+ALL_COUNTERS = tuple(COUNTER_HELP)
+ALL_GAUGES = tuple(GAUGE_HELP)
+
+
+def counter(name: str) -> Counter:
+    """The named serving counter in the process-current registry."""
+    return default_registry().counter(name, COUNTER_HELP.get(name, ""))
+
+
+def gauge(name: str) -> Gauge:
+    """The named serving gauge in the process-current registry."""
+    return default_registry().gauge(name, GAUGE_HELP.get(name, ""))
+
+
+def histogram(name: str) -> Histogram:
+    """The named serving histogram in the process-current registry."""
+    return default_registry().histogram(name, HIST_HELP.get(name, ""))
+
+
+def register_serving_metrics(registry) -> None:
+    """Pre-create the serving metric family with explicit zero-valued
+    unlabeled series, so snapshots (and summarize) always carry the serving
+    story, even when it is "nothing happened"."""
+    for name in ALL_COUNTERS:
+        registry.counter(name, COUNTER_HELP[name]).inc(0.0)
+    for name in ALL_GAUGES:
+        registry.gauge(name, GAUGE_HELP[name]).set(0.0)
+    for name in HIST_HELP:
+        registry.histogram(name, HIST_HELP[name])

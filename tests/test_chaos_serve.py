@@ -1,0 +1,239 @@
+"""Serving chaos drill (ISSUE 3 acceptance): under injected malformed
+payloads, NaN images, a simulated device error and a deadline storm, the
+engine returns ONLY typed responses (predict/abstain/reject/shed — no
+uncaught exception), trips and then recovers the circuit breaker, and
+post-warmup steady-state serving performs ZERO jit recompiles (asserted via
+the telemetry StepMonitor recompile counter watching the engine's jit).
+
+Chaos is the deterministic `resilience.chaos` harness — the same
+MGPROTO_CHAOS_* machinery the training drill uses, extended with the
+MGPROTO_CHAOS_SERVE_* knobs.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.engine.train import Trainer
+from mgproto_tpu.resilience import chaos as chaos_mod
+from mgproto_tpu.serving import metrics as sm
+from mgproto_tpu.serving.admission import (
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
+    CircuitBreaker,
+)
+from mgproto_tpu.serving.calibration import calibrate
+from mgproto_tpu.serving.engine import (
+    OUTCOME_ABSTAIN,
+    OUTCOME_PREDICT,
+    OUTCOME_REJECT,
+    OUTCOME_SHED,
+    ServingEngine,
+)
+from mgproto_tpu.telemetry.registry import (
+    MetricRegistry,
+    set_current_registry,
+)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serving]
+
+OUTCOMES = {OUTCOME_PREDICT, OUTCOME_ABSTAIN, OUTCOME_REJECT, OUTCOME_SHED}
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry_and_no_chaos():
+    prev_reg = set_current_registry(MetricRegistry())
+    prev_chaos = chaos_mod.set_active(None)
+    yield
+    chaos_mod.set_active(prev_chaos)
+    set_current_registry(prev_reg)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    id_batches = [
+        (
+            rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3).astype(
+                np.float32
+            ),
+            rng.randint(0, cfg.model.num_classes, (4,)).astype(np.int32),
+        )
+        for _ in range(2)
+    ]
+    return cfg, trainer, state, id_batches
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_chaos_storm_yields_only_typed_responses_and_recovers(setup):
+    """The acceptance drill. Chaos plan, by request/dispatch index:
+
+      * ~25% of requests malformed (wrong shape) -> typed reject
+      * ~15% NaN-poisoned -> typed reject (the NaN never reaches the device)
+      * dispatches 2 and 3 raise a simulated device error -> breaker opens
+        (threshold 2) after the two failures
+      * requests 28..35 are a deadline storm (arrive expired) -> shed
+    """
+    cfg, trainer, state, id_batches = setup
+    calib = calibrate(trainer, state, id_batches)
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=2, base_delay=5.0, clock=clock
+    )
+    eng = ServingEngine.from_live(
+        trainer, state, calibration=calib, buckets=(1, 2, 4),
+        breaker=breaker, clock=clock, queue_capacity=8,
+    )
+    eng.warmup()
+    warm_recompiles = eng.monitor.recompile_count
+
+    chaos_mod.install(chaos_mod.ChaosPlan(
+        seed=7,
+        serve_malformed_rate=0.25,
+        serve_nan_rate=0.15,
+        serve_device_errors=(2, 3),
+        serve_storm_at=28,
+        serve_storm_len=8,
+    ))
+
+    rng = np.random.RandomState(3)
+    n_requests = 48
+    responses = []
+    breaker_opened = False
+    for i in range(n_requests):
+        payload = rng.rand(
+            cfg.model.img_size, cfg.model.img_size, 3
+        ).astype(np.float32)
+        responses.extend(eng.submit(payload, request_id=f"c{i}"))
+        if i % 4 == 3:  # drain in bursts, like a batching frontend
+            responses.extend(eng.process_pending())
+        if breaker.state == BREAKER_OPEN and not breaker_opened:
+            breaker_opened = True
+            # outage window: requests drain typed (reject/shed), then the
+            # cooldown elapses and the half-open probe heals the breaker
+            responses.extend(eng.process_pending())
+            clock.advance(6.0)
+        clock.advance(0.01)
+    while len(eng.queue):
+        responses.extend(eng.process_pending())
+
+    # every request answered exactly once, every answer typed
+    assert len(responses) == n_requests
+    assert sorted(r.request_id for r in responses) == sorted(
+        f"c{i}" for i in range(n_requests)
+    )
+    outcomes = {r.outcome for r in responses}
+    assert outcomes <= OUTCOMES
+    by = {o: sum(r.outcome == o for r in responses) for o in outcomes}
+
+    # the storm shed, the injections rejected, the healthy majority served
+    assert by.get(OUTCOME_SHED, 0) >= 8
+    reject_reasons = {r.reason for r in responses if r.outcome == OUTCOME_REJECT}
+    assert "bad_shape" in reject_reasons  # malformed injections
+    assert "nonfinite" in reject_reasons  # NaN injections
+    assert "device_error" in reject_reasons  # simulated device failure
+    assert by.get(OUTCOME_PREDICT, 0) + by.get(OUTCOME_ABSTAIN, 0) > 0
+
+    # the breaker tripped AND recovered
+    assert breaker_opened
+    assert breaker.state == BREAKER_CLOSED
+    edges = sm.counter(sm.BREAKER_TRANSITIONS)
+    assert edges.value(edge="closed->open") >= 1
+    assert edges.value(edge="open->half_open") >= 1
+    assert edges.value(edge="half_open->closed") >= 1
+    assert sm.counter(sm.DEVICE_ERRORS).value() == 2
+
+    # zero steady-state recompiles: chaos churned through every bucket and
+    # failure path without ever presenting XLA a new shape
+    assert eng.monitor.check_recompiles() == 0
+    assert eng.monitor.recompile_count == warm_recompiles
+
+    # the injections actually happened (deterministic plan accounting)
+    from mgproto_tpu.resilience.metrics import CHAOS_INJECTIONS, counter
+
+    assert counter(CHAOS_INJECTIONS).value(kind="serve_device_error") == 2
+    assert counter(CHAOS_INJECTIONS).value(kind="serve_malformed") > 0
+    assert counter(CHAOS_INJECTIONS).value(kind="serve_nan") > 0
+    assert counter(CHAOS_INJECTIONS).value(kind="serve_deadline_storm") == 8
+
+
+def test_serve_chaos_is_deterministic_per_index():
+    plan = chaos_mod.ChaosPlan(
+        seed=11, serve_malformed_rate=0.3, serve_nan_rate=0.3
+    )
+    a = chaos_mod.ChaosState(plan)
+    b = chaos_mod.ChaosState(plan)
+    img = np.zeros((4, 4, 3), np.float32)
+    for i in range(32):
+        ra = a.serve_corrupt_request(i, img)
+        rb = b.serve_corrupt_request(i, img)
+        assert np.array_equal(ra, rb, equal_nan=True)
+    # different seed -> different schedule somewhere in the window
+    c = chaos_mod.ChaosState(chaos_mod.ChaosPlan(
+        seed=12, serve_malformed_rate=0.3, serve_nan_rate=0.3
+    ))
+    assert any(
+        not np.array_equal(
+            a2.serve_corrupt_request(i, img),
+            c.serve_corrupt_request(i, img),
+            equal_nan=True,
+        )
+        for i in range(32)
+        for a2 in [chaos_mod.ChaosState(plan)]
+    )
+
+
+def test_nan_injection_passes_through_uncoercible_payloads():
+    """A payload that is ALREADY malformed (ragged list) must survive the
+    NaN injector untouched and become a typed validation reject — the
+    chaos harness must never crash the submit path it exists to drill."""
+    plan = chaos_mod.ChaosPlan(seed=0, serve_nan_rate=1.0)
+    st = chaos_mod.ChaosState(plan)
+    ragged = [[1.0, 2.0], [3.0]]
+    assert st.serve_corrupt_request(0, ragged) is ragged
+    # and a clean payload still gets poisoned
+    img = np.zeros((2, 2, 3), np.float32)
+    out = st.serve_corrupt_request(1, img)
+    assert np.isnan(out).all() and out.shape == img.shape
+
+
+def test_device_error_fires_once_per_index():
+    st = chaos_mod.ChaosState(
+        chaos_mod.ChaosPlan(serve_device_errors=(5,))
+    )
+    assert not st.serve_device_error_due(4)
+    assert st.serve_device_error_due(5)
+    assert not st.serve_device_error_due(5)  # one-shot: the retry heals
+
+
+def test_serve_plan_from_env():
+    plan = chaos_mod.plan_from_env({
+        "MGPROTO_CHAOS_SERVE_MALFORMED_RATE": "0.1",
+        "MGPROTO_CHAOS_SERVE_NAN_RATE": "0.05",
+        "MGPROTO_CHAOS_SERVE_DEVICE_ERRORS": "3,9",
+        "MGPROTO_CHAOS_SERVE_STORM_AT": "20",
+        "MGPROTO_CHAOS_SERVE_STORM_LEN": "4",
+    })
+    assert plan is not None and plan.any_active()
+    assert plan.serve_malformed_rate == 0.1
+    assert plan.serve_device_errors == (3, 9)
+    assert plan.serve_storm_at == 20 and plan.serve_storm_len == 4
+    # storm window arithmetic
+    st = chaos_mod.ChaosState(plan)
+    assert not st.serve_storm_due(19)
+    assert st.serve_storm_due(20) and st.serve_storm_due(23)
+    assert not st.serve_storm_due(24)

@@ -133,7 +133,7 @@ def test_packaging_entry_points_resolve():
     scripts = meta["project"]["scripts"]
     assert set(scripts) == {
         "mgproto-train", "mgproto-eval", "mgproto-interpret", "mgproto-prep",
-        "mgproto-export", "mgproto-telemetry",
+        "mgproto-export", "mgproto-telemetry", "mgproto-serve",
     }
     for target in scripts.values():
         mod_name, fn_name = target.split(":")

@@ -113,6 +113,116 @@ def test_ood_max_score_rule_operating_point(setup):
         )
 
 
+class _StubTrainer:
+    """eval_step that treats the 'images' as precomputed class
+    log-likelihood rows [B, C] — pins evaluate_with_ood's operating-point
+    arithmetic on hand-computable fixtures, no model in the loop."""
+
+    def eval_step(self, state, images, labels=None):
+        from mgproto_tpu.engine.train import EvalOutput
+
+        logits = jnp.asarray(images, jnp.float32)
+        return EvalOutput(
+            logits=logits,
+            log_px=jax.nn.logsumexp(logits, -1),
+            correct=jnp.zeros(logits.shape[0], bool),
+        )
+
+
+def _stub_state(num_classes=2):
+    from types import SimpleNamespace
+
+    return SimpleNamespace(gmm=SimpleNamespace(num_classes=num_classes))
+
+
+def test_ood_score_rules_pinned_on_fixture():
+    """Satellite (ISSUE 3): the 'paper' rule vs the inherited 'sum' rule,
+    pinned on a fixture where the reference's C-fold sum-vs-mean asymmetry
+    flips a decision.
+
+    ID set (as p(x|c) pairs): sums [8, 4, 2, 1]; at percentile=50 the sum
+    rule thresholds exp-space at 3.0, the paper rule thresholds log-space
+    at (log 2 + log 4)/2. OoD sample Y with p(x|c) = [2.8, 2.8]: its MEAN
+    2.8 < 3.0, so the sum rule calls it OoD — but its log p(x) = log 5.6
+    clears the paper threshold, so the symmetric rule calls it ID. FPR
+    pins: sum -> 0.5, paper -> 1.0, with identical (rank-based) AUROC."""
+    trainer, state = _StubTrainer(), _stub_state()
+    id_rows = np.log(np.array(
+        [[4.0, 4.0], [2.0, 2.0], [1.0, 1.0], [0.5, 0.5]]
+    ))
+    ood_rows = np.log(np.array([[5.0, 1.4], [2.8, 2.8]]))
+
+    _, res_sum = evaluate_with_ood(
+        trainer, state, [id_rows], [[ood_rows]],
+        percentile=50.0, score_rule="sum", log=lambda *_: None,
+    )
+    assert res_sum["ood_thresh"] == pytest.approx(3.0)  # exp space
+    assert res_sum["FPR95_1"] == pytest.approx(0.5)  # only X passes
+
+    _, res_paper = evaluate_with_ood(
+        trainer, state, [id_rows], [[ood_rows]],
+        percentile=50.0, score_rule="paper", log=lambda *_: None,
+    )
+    assert res_paper["ood_thresh"] == pytest.approx(
+        (np.log(2.0) + np.log(4.0)) / 2.0  # log space, same statistic
+    )
+    assert res_paper["FPR95_1"] == pytest.approx(1.0)  # X and Y both pass
+
+    # AUROC is rank-based on log p(x) either way: identical across rules
+    assert res_sum["AUROC_1"] == res_paper["AUROC_1"] == pytest.approx(0.25)
+
+    # default stays the inherited reference behavior
+    _, res_default = evaluate_with_ood(
+        trainer, state, [id_rows], [[ood_rows]],
+        percentile=50.0, log=lambda *_: None,
+    )
+    assert res_default["score_rule"] == "sum"
+    assert res_default["FPR95_1"] == res_sum["FPR95_1"]
+
+
+def test_ood_paper_rule_on_real_model(setup):
+    """The paper rule through the real eval path: log-domain threshold =
+    the ID percentile of log p(x), decisions symmetric on both sides."""
+    cfg, trainer, state = setup
+    b = _batches(cfg)
+    logs = []
+    _, res = evaluate_with_ood(
+        trainer, state, b, [[x[0] for x in b]], score_rule="paper",
+        log=logs.append,
+    )
+    assert res["score_rule"] == "paper"
+    from mgproto_tpu.engine.evaluate import _run_eval
+
+    id_log_px, _, _, _, _ = _run_eval(trainer, state, b)
+    assert res["ood_thresh"] == pytest.approx(
+        float(np.percentile(id_log_px.astype(np.float64), 5.0))
+    )
+
+
+def test_binary_auroc_duplicate_scores_mid_rank():
+    """Satellite (ISSUE 3): duplicate log p(x) scores must give the
+    mid-rank AUROC — P(pos > neg) + 0.5 P(pos == neg) — independent of
+    input order, not whatever a naive argsort tie-break produces."""
+    from mgproto_tpu.engine.evaluate import binary_auroc
+
+    pos, neg = [1.0, 2.0, 2.0, 3.0], [2.0, 2.0]
+    # pairs: 1v2 x2 -> 0; 2v2 x4 -> 0.5 each; 3v2 x2 -> 1  ==> 4/8
+    assert binary_auroc(pos, neg) == 0.5
+    # order independence under heavy ties
+    assert binary_auroc(pos[::-1], neg[::-1]) == 0.5
+    assert binary_auroc([2.0, 3.0, 1.0, 2.0], [2.0, 2.0]) == 0.5
+    # degenerate: every score identical -> exactly chance
+    assert binary_auroc([7.0] * 5, [7.0] * 3) == 0.5
+    # brute force agreement on a heavily quantized (tie-rich) sample
+    rng = np.random.RandomState(0)
+    p = rng.randint(0, 4, 50).astype(np.float64)
+    n = rng.randint(0, 4, 40).astype(np.float64)
+    want = float(np.mean(
+        (p[:, None] > n[None, :]) + 0.5 * (p[:, None] == n[None, :])
+    ))
+    assert binary_auroc(p, n) == pytest.approx(want)
+
+
 def test_binary_auroc_exact():
     from mgproto_tpu.engine.evaluate import binary_auroc
 

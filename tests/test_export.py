@@ -93,6 +93,89 @@ def test_export_forces_portable_scoring_path(tmp_path):
     )
 
 
+@pytest.mark.parametrize("dynamic", [True, False], ids=["dynamic", "static"])
+def test_export_serve_seam_parity(tmp_path, dynamic):
+    """Satellite (ISSUE 3): the untested export->serve seam, closed.
+
+    The deserialized artifact must be BIT-EXACT with `jax.jit` of the same
+    weights-closed eval closure — i.e. with what `ServingEngine.from_live`
+    actually executes — across several batch sizes, for both dynamic- and
+    static-batch exports. Against `Trainer.eval_step` (weights passed as an
+    ARGUMENT, not baked in) XLA's constant folding may differ by float
+    ULPs, so that comparison is pinned at a few-ULP f32 tolerance instead
+    (and an exact-equality expectation documented as unattainable)."""
+    cfg, trainer, state = _trainer_state()
+    exported = export_eval(trainer, state, dynamic_batch=dynamic,
+                           static_batch=4)
+    path = str(tmp_path / "parity.mgproto")
+    save_artifact(path, exported, artifact_meta(cfg, None, dynamic,
+                                                static_batch=4))
+    infer, _ = load_artifact(path)
+
+    def closure(images):
+        out = trainer._eval(state, images, None)
+        return {"logits": out.logits, "log_px": out.log_px}
+
+    jitted = jax.jit(closure)
+    batch_sizes = (1, 3, 4, 7) if dynamic else (4,)
+    for bs in batch_sizes:
+        imgs = jnp.asarray(
+            np.random.RandomState(bs).rand(
+                bs, cfg.model.img_size, cfg.model.img_size, 3
+            ),
+            jnp.float32,
+        )
+        got = infer(imgs)
+        want = jitted(imgs)
+        # the serve seam: artifact == live serving path, bit for bit
+        np.testing.assert_array_equal(
+            np.asarray(got["log_px"]), np.asarray(want["log_px"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got["logits"]), np.asarray(want["logits"])
+        )
+        # vs the training-side eval step: identical math, weights as an
+        # argument — agreement to f32 ULP scale
+        step = trainer.eval_step(state, imgs)
+        np.testing.assert_allclose(
+            np.asarray(got["log_px"]), np.asarray(step.log_px),
+            rtol=0, atol=5e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got["logits"]), np.asarray(step.logits),
+            rtol=0, atol=5e-6,
+        )
+
+
+def test_artifact_meta_carries_gmm_fingerprint_and_calibration(tmp_path):
+    """The serving provenance chain: fingerprint in meta.json, calibration
+    in calibration.json, both inside the one-file artifact."""
+    from mgproto_tpu.engine.export import load_calibration
+    from mgproto_tpu.serving.calibration import (
+        Calibration,
+        gmm_fingerprint,
+    )
+
+    cfg, trainer, state = _trainer_state()
+    fp = gmm_fingerprint(state.gmm)
+    calib = Calibration.from_scores(
+        np.linspace(-5, 0, 50), np.zeros((50, cfg.model.num_classes)), fp
+    )
+    path = str(tmp_path / "prov.mgproto")
+    save_artifact(
+        path, export_eval(trainer, state),
+        artifact_meta(cfg, "ckpt", True, gmm_fingerprint=fp),
+        calibration=calib,
+    )
+    with zipfile.ZipFile(path) as z:
+        assert set(z.namelist()) == {
+            "model.stablehlo", "meta.json", "calibration.json"
+        }
+        meta = json.loads(z.read("meta.json"))
+    assert meta["gmm_fingerprint"] == fp
+    assert load_calibration(path).gmm_fingerprint == fp
+
+
 def test_static_batch_export_rejects_other_batch_sizes(tmp_path):
     cfg, trainer, state = _trainer_state()
     exported = export_eval(trainer, state, dynamic_batch=False, static_batch=4)

@@ -1,0 +1,697 @@
+"""Serving subsystem tests (ISSUE 3): calibration, trust gate, validation,
+admission control, circuit breaker, engine, artifact round trip, CLI.
+
+The acceptance-shaped checks live here (the chaos storm is in
+tests/test_chaos_serve.py):
+
+  * an exported artifact round-trips WITH calibration embedded and
+    reproduces `evaluate_with_ood`'s ID/OoD split decisions on a fixture,
+  * an uncalibrated artifact is refused (or served degraded, per flag),
+  * prune-then-serve without recalibration is detected (fingerprint
+    fail-closed) — the `prune_top_m` scale-shift regression.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.engine.export import (
+    artifact_meta,
+    embed_calibration,
+    export_eval,
+    load_calibration,
+    save_artifact,
+)
+from mgproto_tpu.engine.train import Trainer
+from mgproto_tpu.serving import metrics as sm
+from mgproto_tpu.serving.admission import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    AdmissionQueue,
+    CircuitBreaker,
+)
+from mgproto_tpu.serving.calibration import (
+    Calibration,
+    CalibrationError,
+    calibrate,
+    gmm_fingerprint,
+)
+from mgproto_tpu.serving.engine import (
+    OUTCOME_ABSTAIN,
+    OUTCOME_PREDICT,
+    OUTCOME_REJECT,
+    OUTCOME_SHED,
+    ServingEngine,
+    UncalibratedArtifactError,
+)
+from mgproto_tpu.serving.gate import (
+    TRUST_ABSTAIN,
+    TRUST_IN_DIST,
+    TRUST_UNGATED,
+    TrustGate,
+)
+from mgproto_tpu.serving.health import HealthProbe
+from mgproto_tpu.serving.validate import (
+    ValidationFailure,
+    ValidationSpec,
+    validate_image,
+)
+from mgproto_tpu.telemetry.registry import (
+    MetricRegistry,
+    set_current_registry,
+)
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Serving metrics go through the process-current registry; isolate each
+    test so counters don't bleed between them."""
+    prev = set_current_registry(MetricRegistry())
+    yield
+    set_current_registry(prev)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    return cfg, trainer, state
+
+
+def _id_batches(cfg, n_batches=2, bs=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            rng.rand(bs, cfg.model.img_size, cfg.model.img_size, 3).astype(
+                np.float32
+            ),
+            rng.randint(0, cfg.model.num_classes, (bs,)).astype(np.int32),
+        )
+        for _ in range(n_batches)
+    ]
+
+
+def _payloads(cfg, n=4, seed=7):
+    rng = np.random.RandomState(seed)
+    return [
+        rng.rand(cfg.model.img_size, cfg.model.img_size, 3).astype(np.float32)
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------- validation
+class TestValidate:
+    SPEC = ValidationSpec(img_size=8)
+
+    def _img(self, v=0.5):
+        return np.full((8, 8, 3), v, np.float32)
+
+    def test_clean_passes_and_casts(self):
+        out = validate_image(self._img().astype(np.float64), self.SPEC)
+        assert out.dtype == np.float32 and out.shape == (8, 8, 3)
+
+    @pytest.mark.parametrize(
+        "payload,reason",
+        [
+            ("garbage", "bad_dtype"),
+            (None, "bad_dtype"),
+            (np.zeros((4, 4, 3), np.float32), "bad_shape"),
+            (np.zeros((8, 8), np.float32), "bad_shape"),
+            (np.full((8, 8, 3), np.nan, np.float32), "nonfinite"),
+            (np.full((8, 8, 3), np.inf, np.float32), "nonfinite"),
+            (np.full((8, 8, 3), 1e6, np.float32), "out_of_range"),
+        ],
+    )
+    def test_typed_rejects(self, payload, reason):
+        with pytest.raises(ValidationFailure) as ei:
+            validate_image(payload, self.SPEC)
+        assert ei.value.reason == reason
+
+    def test_structural_reason_wins_over_nan(self):
+        bad = np.full((4, 4, 3), np.nan, np.float32)  # wrong shape AND NaN
+        with pytest.raises(ValidationFailure) as ei:
+            validate_image(bad, self.SPEC)
+        assert ei.value.reason == "bad_shape"
+
+
+# --------------------------------------------------------------- calibration
+class TestCalibration:
+    def _calib(self, n=200, seed=3):
+        rng = np.random.RandomState(seed)
+        scores = rng.randn(n) * 2.0 - 5.0
+        logits = rng.randn(n, 4) - 6.0
+        return Calibration.from_scores(scores, logits, "fp-abc"), scores
+
+    def test_threshold_is_the_id_percentile(self):
+        calib, scores = self._calib()
+        assert calib.threshold_log_px == pytest.approx(
+            float(np.percentile(scores, 5.0))
+        )
+        assert calib.threshold_for(1.0) == pytest.approx(
+            float(np.percentile(scores, 1.0))
+        )
+
+    def test_quantile_sketch_interpolates_unstored_percentiles(self):
+        calib, scores = self._calib()
+        # 7.5 isn't a stored threshold; the sketch must land close to the
+        # true percentile (sketch resolution: 1 percentile point)
+        assert calib.threshold_for(7.5) == pytest.approx(
+            float(np.percentile(scores, 7.5)), abs=0.15
+        )
+        with pytest.raises(CalibrationError):
+            calib.threshold_for(123.0)
+
+    def test_id_quantile_of_is_monotone(self):
+        calib, scores = self._calib()
+        lo, mid, hi = np.percentile(scores, [2, 50, 98])
+        qs = [calib.id_quantile_of(v) for v in (lo, mid, hi)]
+        assert qs[0] < qs[1] < qs[2]
+        assert 0.0 <= qs[0] and qs[2] <= 1.0
+
+    def test_json_round_trip(self):
+        calib, _ = self._calib()
+        back = Calibration.from_json(calib.to_json())
+        assert back == calib
+
+    def test_malformed_payloads_raise_typed(self):
+        with pytest.raises(CalibrationError):
+            Calibration.from_json("not json")
+        with pytest.raises(CalibrationError):
+            Calibration.from_dict({"format": "something-else"})
+        with pytest.raises(CalibrationError):
+            Calibration.from_scores(np.array([]), np.zeros((0, 4)), "fp")
+        with pytest.raises(CalibrationError):
+            Calibration.from_scores(
+                np.array([np.nan, 1.0]), np.zeros((2, 4)), "fp"
+            )
+
+    def test_per_class_temperature_mean_is_one(self):
+        calib, _ = self._calib()
+        assert np.mean(calib.per_class_temperature) == pytest.approx(1.0)
+
+    def test_calibrate_uses_the_live_eval_path(self, setup):
+        cfg, trainer, state = setup
+        calib = calibrate(trainer, state, _id_batches(cfg))
+        assert calib.num_id_samples == 8
+        assert calib.gmm_fingerprint == gmm_fingerprint(state.gmm)
+        # threshold must equal the percentile of the eval driver's log_px
+        from mgproto_tpu.engine.evaluate import _run_eval
+
+        id_log_px, _, _, _, _ = _run_eval(trainer, state, _id_batches(cfg))
+        assert calib.threshold_log_px == pytest.approx(
+            float(np.percentile(id_log_px.astype(np.float64), 5.0))
+        )
+
+
+# ---------------------------------------------------------------- trust gate
+class TestTrustGate:
+    def _calib(self):
+        scores = np.linspace(-10.0, 0.0, 101)
+        return Calibration.from_scores(scores, np.zeros((101, 2)), "fp")
+
+    def test_decisions_split_at_threshold(self):
+        gate = TrustGate(self._calib())
+        t = gate.threshold
+        # exactly-at-threshold abstains: evaluate_with_ood flags ID on
+        # `score > thresh`, and the threshold is an ID percentile that can
+        # equal a real sample's score — serve and eval must agree there
+        labels = gate.decide([t - 1.0, t + 1.0, t, np.nan])
+        assert labels == [
+            TRUST_ABSTAIN, TRUST_IN_DIST, TRUST_ABSTAIN, TRUST_ABSTAIN
+        ]
+        assert gate.abstain_rate == pytest.approx(3 / 4)
+        assert sm.gauge(sm.ABSTAIN_RATE).value() == pytest.approx(3 / 4)
+
+    def test_confidence_uses_per_class_temperature(self):
+        calib = Calibration.from_scores(
+            np.linspace(-10, 0, 101),
+            np.random.RandomState(0).randn(101, 3),
+            "fp",
+        )
+        gate = TrustGate(calib)
+        c = gate.confidence([2.0, -1.0, -1.0])
+        assert c is not None and 1 / 3 < c <= 1.0
+        # degraded gate: no calibrated temperature -> no confidence
+        assert TrustGate(None).confidence([2.0, -1.0, -1.0]) is None
+        # class-count mismatch between calibration and served head: None,
+        # never a crash or a wrong number
+        assert gate.confidence([2.0, -1.0]) is None
+
+    def test_missing_calibration_degrades(self):
+        gate = TrustGate(None)
+        assert gate.degraded
+        assert gate.decide([0.0, 1.0]) == [TRUST_UNGATED, TRUST_UNGATED]
+        assert gate.trust_score(0.0) is None
+
+    def test_fingerprint_mismatch_fails_closed(self):
+        gate = TrustGate(self._calib(), expected_fingerprint="other-gmm")
+        assert gate.degraded and gate.fingerprint_mismatch
+        assert gate.decide([0.0]) == [TRUST_UNGATED]
+        assert sm.counter(sm.FINGERPRINT_MISMATCHES).value() == 1
+
+    def test_matching_fingerprint_gates(self):
+        gate = TrustGate(self._calib(), expected_fingerprint="fp")
+        assert not gate.degraded and not gate.fingerprint_mismatch
+
+    def test_operating_point_override(self):
+        calib = self._calib()
+        gate = TrustGate(calib, percentile=50.0)
+        assert gate.threshold == pytest.approx(calib.threshold_for(50.0))
+
+
+# ----------------------------------------------------------------- admission
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestAdmissionQueue:
+    def test_fifo_and_capacity_shed(self):
+        clock = FakeClock()
+        q = AdmissionQueue(capacity=2, clock=clock)
+        r1, s1 = q.submit("a")
+        r2, s2 = q.submit("b")
+        r3, s3 = q.submit("c")
+        assert (s1, s2) == (None, None)
+        assert s3 == "queue_full"
+        assert [r.payload for r in q.pop_batch(10)] == ["a", "b"]
+        assert sm.counter(sm.SHED).value(reason="queue_full") == 1
+
+    def test_deadline_storm_sheds_on_arrival(self):
+        clock = FakeClock()
+        q = AdmissionQueue(capacity=8, clock=clock)
+        _, reason = q.submit("dead", deadline_s=-1.0)
+        assert reason == "deadline"
+        assert len(q) == 0
+
+    def test_expired_while_queued_sheds_at_pop(self):
+        clock = FakeClock()
+        q = AdmissionQueue(capacity=8, clock=clock)
+        q.submit("soon", deadline_s=0.5)
+        q.submit("late", deadline_s=10.0)
+        clock.advance(1.0)
+        batch = q.pop_batch(10)
+        assert [r.payload for r in batch] == ["late"]
+        assert [r.payload for r in q.drain_shed()] == ["soon"]
+
+    def test_full_queue_sheds_expired_head_to_admit_fresh(self):
+        clock = FakeClock()
+        q = AdmissionQueue(capacity=2, clock=clock)
+        q.submit("old", deadline_s=0.5)
+        q.submit("ok", deadline_s=10.0)
+        clock.advance(1.0)  # "old" is now past deadline
+        req, reason = q.submit("new", deadline_s=10.0)
+        assert reason is None  # admitted: the expired head was shed instead
+        assert [r.payload for r in q.drain_shed()] == ["old"]
+        assert [r.payload for r in q.pop_batch(10)] == ["ok", "new"]
+
+    def test_full_queue_sheds_expired_entries_behind_a_viable_head(self):
+        """An expired entry is unserveable wherever it sits: a viable head
+        must not shield it from eviction while live traffic is rejected."""
+        clock = FakeClock()
+        q = AdmissionQueue(capacity=2, clock=clock)
+        q.submit("head_ok", deadline_s=10.0)
+        q.submit("mid_dead", deadline_s=0.5)
+        clock.advance(1.0)  # "mid_dead" expired behind the viable head
+        req, reason = q.submit("new", deadline_s=10.0)
+        assert reason is None  # admitted: the mid-queue corpse was shed
+        assert [r.payload for r in q.drain_shed()] == ["mid_dead"]
+        assert [r.payload for r in q.pop_batch(10)] == ["head_ok", "new"]
+
+
+class TestCircuitBreaker:
+    def test_full_cycle(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=2, base_delay=1.0, clock=clock)
+        assert br.state == BREAKER_CLOSED and br.allow()
+        br.record_failure()
+        assert br.state == BREAKER_CLOSED  # below threshold
+        br.record_failure()
+        assert br.state == BREAKER_OPEN and not br.allow()
+        clock.advance(1.1)  # past the first cooldown
+        assert br.allow()  # admits ONE half-open probe
+        assert br.state == BREAKER_HALF_OPEN
+        br.record_success()
+        assert br.state == BREAKER_CLOSED
+        edges = sm.counter(sm.BREAKER_TRANSITIONS)
+        assert edges.value(edge="closed->open") == 1
+        assert edges.value(edge="open->half_open") == 1
+        assert edges.value(edge="half_open->closed") == 1
+
+    def test_failed_probe_reopens_with_longer_cooldown(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, base_delay=1.0, clock=clock)
+        br.record_failure()
+        assert br.state == BREAKER_OPEN
+        clock.advance(1.1)
+        assert br.allow()
+        br.record_failure()  # probe fails
+        assert br.state == BREAKER_OPEN
+        clock.advance(1.1)  # first cooldown elapsed, but schedule doubled
+        assert not br.allow()
+        clock.advance(1.0)  # now past the 2.0s second cooldown
+        assert br.allow()
+        br.record_success()
+        assert br.state == BREAKER_CLOSED
+        assert sm.gauge(sm.BREAKER_STATE).value() == 0.0
+
+
+# -------------------------------------------------------------------- engine
+class TestServingEngine:
+    def test_live_serving_gates_and_pads_without_recompiles(self, setup):
+        cfg, trainer, state = setup
+        calib = calibrate(trainer, state, _id_batches(cfg))
+        eng = ServingEngine.from_live(
+            trainer, state, calibration=calib, buckets=(1, 2, 4)
+        )
+        eng.warmup()
+        base = eng.monitor.recompile_count
+        # 1, 3 and 5 requests exercise exact-fit, padded and split batches
+        for n in (1, 3, 5):
+            resps = eng.serve_all(_payloads(cfg, n=n, seed=n))
+            assert len(resps) == n
+            for r in resps:
+                assert r.outcome in (OUTCOME_PREDICT, OUTCOME_ABSTAIN)
+                assert 0 <= r.prediction < cfg.model.num_classes
+                assert np.isfinite(r.log_px)
+                assert not r.degraded
+        assert eng.monitor.check_recompiles() == 0
+        assert eng.monitor.recompile_count == base
+
+    def test_validation_rejects_are_typed_responses(self, setup):
+        cfg, trainer, state = setup
+        eng = ServingEngine.from_live(trainer, state, buckets=(2,))
+        eng.warmup()
+        resps = eng.serve_all(
+            ["garbage", np.full((8, 8, 3), np.nan), _payloads(cfg, 1)[0]]
+        )
+        assert [r.outcome for r in resps] == [
+            OUTCOME_REJECT, OUTCOME_REJECT, OUTCOME_PREDICT
+        ]
+        assert resps[0].reason == "bad_dtype"
+        assert resps[1].reason == "bad_shape"
+
+    def test_uncalibrated_live_serves_degraded_flagged(self, setup):
+        cfg, trainer, state = setup
+        eng = ServingEngine.from_live(trainer, state, buckets=(2,))
+        eng.warmup()
+        r = eng.serve_all(_payloads(cfg, 1))[0]
+        assert r.outcome == OUTCOME_PREDICT and r.trust == TRUST_UNGATED
+        assert r.degraded
+        assert sm.counter(sm.DEGRADED_REQUESTS).value() == 1
+
+    def test_prune_then_serve_without_recalibration_is_detected(self, setup):
+        """The prune_top_m regression (satellite): pruning changes the
+        absolute p(x) scale, so a calibration measured pre-prune must be
+        refused (degraded mode + counter), not silently misapplied."""
+        from mgproto_tpu.core.mgproto import prune_top_m
+
+        cfg, trainer, state = setup
+        # distinct priors so prune_top_m's tie-keeping `>=` actually drops a
+        # slot (uniform-prior pruning is a no-op by reference semantics)
+        k = state.gmm.k_per_class
+        priors = np.tile(
+            np.arange(1, k + 1, dtype=np.float32) / (k * (k + 1) / 2),
+            (state.gmm.num_classes, 1),
+        )
+        uneven = state.replace(gmm=state.gmm._replace(priors=priors))
+        calib = calibrate(trainer, uneven, _id_batches(cfg))
+        pruned = uneven.replace(gmm=prune_top_m(uneven.gmm, 2))
+        eng = ServingEngine.from_live(
+            trainer, pruned, calibration=calib, buckets=(2,)
+        )
+        assert eng.gate.degraded and eng.gate.fingerprint_mismatch
+        assert sm.counter(sm.FINGERPRINT_MISMATCHES).value() == 1
+        eng.warmup()
+        r = eng.serve_all(_payloads(cfg, 1))[0]
+        assert r.outcome == OUTCOME_PREDICT and r.degraded
+        # recalibrating against the pruned mixture restores gating
+        calib2 = calibrate(trainer, pruned, _id_batches(cfg))
+        eng2 = ServingEngine.from_live(
+            trainer, pruned, calibration=calib2, buckets=(2,)
+        )
+        assert not eng2.gate.degraded
+
+    def test_deadline_and_queue_shedding_end_to_end(self, setup):
+        cfg, trainer, state = setup
+        clock = FakeClock()
+        eng = ServingEngine.from_live(
+            trainer, state, buckets=(2,), queue_capacity=2, clock=clock
+        )
+        eng.warmup()
+        pay = _payloads(cfg, 4)
+        resp = []
+        resp.extend(eng.submit(pay[0], request_id="a"))
+        resp.extend(eng.submit(pay[1], request_id="b"))
+        resp.extend(eng.submit(pay[2], request_id="c"))  # over capacity
+        assert [r.outcome for r in resp] == [OUTCOME_SHED]
+        assert resp[0].reason == "queue_full"
+        resp2 = eng.submit(pay[3], request_id="d", deadline_s=-1.0)
+        assert resp2[0].outcome == OUTCOME_SHED
+        assert resp2[0].reason == "deadline"
+        served = eng.process_pending()
+        assert sorted(r.request_id for r in served) == ["a", "b"]
+
+    def test_health_probe_tracks_warmup_and_breaker(self, setup):
+        cfg, trainer, state = setup
+        clock = FakeClock()
+        eng = ServingEngine.from_live(trainer, state, buckets=(1,), clock=clock)
+        probe = HealthProbe(eng)
+        assert probe.liveness() == {"alive": True}
+        assert not probe.readiness()["ready"]  # not warmed up yet
+        eng.warmup()
+        assert probe.readiness()["ready"]
+        eng.breaker.record_failure()
+        eng.breaker.record_failure()
+        eng.breaker.record_failure()
+        ready = probe.readiness()
+        assert not ready["ready"] and ready["breaker_state"] == BREAKER_OPEN
+        assert ready["degraded"]  # no calibration in this engine
+
+
+# ------------------------------------------------- artifact round trip (zip)
+class TestArtifactServing:
+    def _export(self, setup, tmp_path, with_calib=True, dynamic=True):
+        cfg, trainer, state = setup
+        calib = calibrate(trainer, state, _id_batches(cfg))
+        exported = export_eval(trainer, state, dynamic_batch=dynamic,
+                               static_batch=4)
+        meta = artifact_meta(
+            cfg, None, dynamic,
+            gmm_fingerprint=gmm_fingerprint(state.gmm), static_batch=4,
+        )
+        path = str(tmp_path / "m.mgproto")
+        save_artifact(path, exported, meta,
+                      calibration=calib if with_calib else None)
+        return path, calib
+
+    def test_refuses_uncalibrated_unless_flagged(self, setup, tmp_path):
+        path, _ = self._export(setup, tmp_path, with_calib=False)
+        with pytest.raises(UncalibratedArtifactError):
+            ServingEngine.from_artifact(path)
+        eng = ServingEngine.from_artifact(
+            path, allow_uncalibrated=True, buckets=(2,)
+        )
+        assert eng.gate.degraded
+        eng.warmup()
+        cfg = setup[0]
+        r = eng.serve_all(_payloads(cfg, 1))[0]
+        assert r.outcome == OUTCOME_PREDICT and r.degraded
+
+    def test_embed_calibration_after_the_fact(self, setup, tmp_path):
+        path, calib = self._export(setup, tmp_path, with_calib=False)
+        assert load_calibration(path) is None
+        embed_calibration(path, calib)
+        assert load_calibration(path) == calib
+        eng = ServingEngine.from_artifact(path, buckets=(2,))
+        assert not eng.gate.degraded
+
+    def test_static_batch_artifact_pins_the_bucket(self, setup, tmp_path):
+        path, _ = self._export(setup, tmp_path, dynamic=False)
+        # caller-supplied buckets cannot override a pinned program shape
+        eng = ServingEngine.from_artifact(path, buckets=(1, 2, 8))
+        assert eng.buckets == (4,)
+        eng.warmup()
+        cfg = setup[0]
+        resps = eng.serve_all(_payloads(cfg, 2))  # padded 2 -> 4
+        assert all(
+            r.outcome in (OUTCOME_PREDICT, OUTCOME_ABSTAIN) for r in resps
+        )
+
+    def test_legacy_static_artifact_recovers_pin_from_avals(
+        self, setup, tmp_path
+    ):
+        """A static export whose meta predates the `static_batch` key (or
+        lost it) must recover the pinned size from the program's input
+        aval instead of crashing at warmup with DEFAULT_BUCKETS."""
+        import json as _json
+        import zipfile as _zip
+
+        cfg, trainer, state = setup
+        calib = calibrate(trainer, state, _id_batches(cfg))
+        exported = export_eval(trainer, state, dynamic_batch=False,
+                               static_batch=4)
+        meta = artifact_meta(cfg, None, False,
+                             gmm_fingerprint=gmm_fingerprint(state.gmm))
+        meta.pop("static_batch")
+        path = str(tmp_path / "legacy.mgproto")
+        save_artifact(path, exported, meta, calibration=calib)
+        with _zip.ZipFile(path) as z:
+            assert "static_batch" not in _json.loads(z.read("meta.json"))
+        eng = ServingEngine.from_artifact(path)
+        assert eng.buckets == (4,)
+        eng.warmup()
+        r = eng.serve_all(_payloads(cfg, 1))[0]
+        assert r.outcome in (OUTCOME_PREDICT, OUTCOME_ABSTAIN)
+
+    def test_artifact_reproduces_evaluate_with_ood_decisions(
+        self, setup, tmp_path
+    ):
+        """Acceptance: mgproto-serve's artifact decisions == the eval
+        driver's ID/OoD split at the same operating point (score_rule=
+        'paper' gates on log p(x), exactly like the serving calibration)."""
+        from mgproto_tpu.engine.evaluate import _run_eval, evaluate_with_ood
+
+        cfg, trainer, state = setup
+        path, calib = self._export(setup, tmp_path)
+        id_batches = _id_batches(cfg)
+        rng = np.random.RandomState(42)
+        ood_imgs = (
+            rng.rand(6, cfg.model.img_size, cfg.model.img_size, 3) * 2.0
+        ).astype(np.float32)
+
+        _, res = evaluate_with_ood(
+            trainer, state, id_batches, [[ood_imgs]],
+            score_rule="paper", log=lambda *_: None,
+        )
+        ood_log_px, _, _, _, _ = _run_eval(trainer, state, [ood_imgs])
+        want_in_dist = ood_log_px.astype(np.float64) > res["ood_thresh"]
+
+        eng = ServingEngine.from_artifact(path, buckets=(1, 2, 4))
+        eng.warmup()
+        resps = eng.serve_all(list(ood_imgs))
+        got_in_dist = np.array(
+            [r.trust == TRUST_IN_DIST for r in resps], bool
+        )
+        # guard against the one unstable case: a sample landing within
+        # float noise of the threshold (would make the assertion vacuous)
+        assert np.abs(ood_log_px - res["ood_thresh"]).min() > 1e-4
+        assert (got_in_dist == want_in_dist).all()
+        assert res["FPR95_1"] == pytest.approx(got_in_dist.mean())
+
+
+# ----------------------------------------------------------------------- CLI
+class TestServeCli:
+    def test_serve_cli_on_artifact(self, setup, tmp_path, capsys):
+        cfg, trainer, state = setup
+        calib = calibrate(trainer, state, _id_batches(cfg))
+        exported = export_eval(trainer, state)
+        meta = artifact_meta(
+            cfg, None, True, gmm_fingerprint=gmm_fingerprint(state.gmm)
+        )
+        path = str(tmp_path / "m.mgproto")
+        save_artifact(path, exported, meta, calibration=calib)
+        imgs = np.stack(_payloads(cfg, 3))
+        npy = str(tmp_path / "batch.npy")
+        np.save(npy, imgs)
+
+        from mgproto_tpu.cli.serve import main as serve_main
+
+        serve_main([
+            "--arch", "tiny", "--artifact", path, "--images", npy,
+            "--buckets", "1,2,4",
+            "--telemetry-dir", str(tmp_path / "telemetry"),
+        ])
+        lines = [
+            json.loads(l)
+            for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")
+        ]
+        summary = lines[-1]
+        responses = [l for l in lines if not l.get("summary")]
+        assert len(responses) == 3
+        assert all(
+            r["outcome"] in ("predict", "abstain") for r in responses
+        )
+        assert summary["requests"] == 3
+        assert summary["steady_state_recompiles"] == 0
+        assert summary["readiness"]["ready"]
+
+        # the telemetry dir must summarize with a serving section
+        from mgproto_tpu.cli.telemetry import summarize
+
+        s = summarize(str(tmp_path / "telemetry"))
+        assert "serving" in s
+        by_outcome = s["serving"]["requests_by_outcome"]
+        assert sum(by_outcome.values()) == 3
+
+    def test_serve_cli_refuses_uncalibrated_artifact(
+        self, setup, tmp_path, capsys
+    ):
+        cfg, trainer, state = setup
+        exported = export_eval(trainer, state)
+        path = str(tmp_path / "u.mgproto")
+        save_artifact(path, exported, artifact_meta(cfg, None, True))
+        from mgproto_tpu.cli.serve import main as serve_main
+
+        with pytest.raises(UncalibratedArtifactError):
+            serve_main(["--arch", "tiny", "--artifact", path])
+        capsys.readouterr()
+
+
+# ------------------------------------------------------------------ lint gate
+class TestLintCoversServing:
+    def test_no_print_lint_scans_serving(self, tmp_path):
+        pkg = tmp_path / "mgproto_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text("def f():\n    print('offender')\n")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_no_print.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "serving/bad.py:2" in proc.stdout.replace(os.sep, "/")
+
+    def test_signal_lint_scans_serving(self, tmp_path):
+        pkg = tmp_path / "mgproto_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import signal\n"
+            "def f():\n    signal.signal(signal.SIGTERM, lambda *a: None)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "check_no_signal_handlers.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "serving/bad.py:3" in proc.stdout.replace(os.sep, "/")
+
+    def test_repo_serving_package_is_clean(self):
+        for script in ("check_no_print.py", "check_no_signal_handlers.py"):
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "scripts", script), REPO],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert proc.returncode == 0, proc.stdout + proc.stderr
