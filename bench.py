@@ -87,6 +87,11 @@ ITERS = _env_int("BENCH_ITERS", 10)
 # A third measurement at this batch captures the throughput-optimal config;
 # 0 disables it (CI smoke runs only the two reference-batch paths).
 BEST_BATCH = _env_int("BENCH_BEST_BATCH", 256)
+# Batch for the selective-remat attempt (`fused_b512_remat_l1`): the r4 DNF
+# point, retried with layer1-only remat (ModelConfig.remat_stages) so the
+# doubled activation working set fits without rematting the whole trunk.
+# 0 disables the entry (CI smoke).
+REMAT_BATCH = _env_int("BENCH_REMAT_BATCH", 512)
 
 MAX_ATTEMPTS = 6
 BACKOFF_S = (5, 10, 20, 40, 60)  # >= 5 attempts spread over >= 2 minutes
@@ -134,11 +139,12 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def flagship_config(fused: bool):
+def flagship_config(fused: bool, remat_stages: tuple = ()):
     """The flagship recipe (ResNet-34, CUB-200 shapes, bf16 trunk) — the ONE
     definition compiled by both this bench and scripts/perf_model.py, so the
     analytic pre-registration in PERF.md can never drift from what is timed
-    on hardware."""
+    on hardware. `remat_stages` opts stages into selective remat (the
+    batch-512 attempt runs layer1-only: the cheap-but-wide 112^2 stage)."""
     from mgproto_tpu.config import Config, ModelConfig
 
     return Config(
@@ -149,6 +155,7 @@ def flagship_config(fused: bool):
             # bf16 trunk on the MXU; params/BN-stats/density/losses stay f32
             compute_dtype="bfloat16",
             fused_scoring=fused,
+            remat_stages=tuple(remat_stages),
         )
     )
 
@@ -176,14 +183,20 @@ def flops_from_cost_analysis(compiled, strict: bool = False):
     return None
 
 
-def run_config(fused: bool, eval_mode: bool = False) -> dict:
+def run_config(
+    fused: bool, eval_mode: bool = False, remat_stages: tuple = ()
+) -> dict:
     """Steady-state throughput for one scoring path. Returns
     {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}.
 
     eval_mode=True times the INFERENCE step instead (forward + mixture
     logits + log p(x), no losses/backward/EM — what a serving host runs,
     incl. via an engine/export.py artifact). Not part of the driver-contract
-    plan; measure ad hoc with `python bench.py --measure eval_fused 256`."""
+    plan; measure ad hoc with `python bench.py --measure eval_fused 256`.
+
+    remat_stages selects per-stage backbone remat (the `fused_remat_l1`
+    measure: layer1-only, so batch 384-512 fits without rematting the whole
+    trunk — PERF.md's batch-512 DNF diagnosis)."""
     if os.environ.get("BENCH_FAIL_INJECT"):
         # deterministic, instant child failure for the contract tests: fires
         # before any jax/model work so the retry ladder is cheap to exercise
@@ -217,7 +230,7 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
     from mgproto_tpu.engine.train import Trainer
 
     _phase("init_model")
-    cfg = flagship_config(fused)
+    cfg = flagship_config(fused, remat_stages)
     trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
@@ -384,7 +397,7 @@ def run_config(fused: bool, eval_mode: bool = False) -> dict:
     }
 
 
-def robust_measure(name: str, fused: bool, batch: int, reemit=None) -> tuple:
+def robust_measure(name: str, measure: str, batch: int, reemit=None) -> tuple:
     """(result dict or None, last error string or None, attempts used).
 
     Retries with exponential backoff on ANY failure — the observed transients
@@ -400,7 +413,7 @@ def robust_measure(name: str, fused: bool, batch: int, reemit=None) -> tuple:
     last_err = None
     cmd = [
         sys.executable, "-u", os.path.abspath(__file__),
-        "--measure", "fused" if fused else "unfused", str(batch),
+        "--measure", measure, str(batch),
     ]
     # the optional best-batch entry is a bonus measurement: give a likely-
     # deterministic failure (e.g. HBM OOM at the bigger batch on a smaller
@@ -622,6 +635,107 @@ def _cached_age_s(cached: dict) -> float:
     return max(0.0, time.time() - epoch)
 
 
+def measure_em() -> dict:
+    """Hermetic EM-phase microbench: XLA cost analysis (FLOPs + bytes
+    accessed) of one `em_update` call, old vs new path, at flagship shapes
+    (C=200 classes, N=800 capacity, d=64, K=10, dirty width = batch 80 — the
+    PERF.md steady state). CPU backend, no device timing, no relay: the
+    delta is verifiable anywhere (`python bench.py --measure em`).
+
+    The two compiled programs:
+      * dense:        the pre-fast-path default (`max_active_classes=0`,
+                      XLA e-step) — reduces over all C banks per EM round;
+      * compact_fused: the compact dirty-class slab + fused E-step kernel
+                      (interpret mode off-TPU), compiled WITHOUT the runtime
+                      lax.cond dispatcher — cost analysis sums both branches
+                      of a conditional, which would double-count the dense
+                      fallback that steady state never executes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mgproto_tpu.config import EMConfig
+    from mgproto_tpu.core import em as em_mod
+    from mgproto_tpu.core.memory import init_memory
+    from mgproto_tpu.core.mgproto import GMMState
+
+    c, n, d, k = 200, 800, 64, 10
+    width = _env_int("BENCH_EM_WIDTH", 80)  # = flagship batch 80
+
+    key = jax.random.PRNGKey(0)
+    feats = jax.random.uniform(key, (c, n, d), jnp.float32)
+    feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+    mem = init_memory(c, n, d)._replace(
+        feats=feats,
+        length=jnp.full((c,), n, jnp.int32),
+        # steady state: `width` classes dirty (one batch's worth)
+        updated=jnp.arange(c) < width,
+    )
+    gmm = GMMState(
+        means=jax.random.normal(jax.random.PRNGKey(1), (c, k, d)) * 0.1,
+        sigmas=jnp.full((c, k, d), 1.0 / (2.0 * 3.14159265) ** 0.5),
+        priors=jnp.full((c, k), 1.0 / k),
+        keep=jnp.ones((c, k), bool),
+    )
+
+    def cost_of(fn, *args) -> dict:
+        t0 = time.perf_counter()
+        # donate like the production step does (engine/train.py donate=True):
+        # without donation the unchanged [C, N, d] bank is copied through to
+        # the output, charging both paths identical phantom traffic
+        compiled = (
+            jax.jit(fn, donate_argnums=(0, 1, 2)).lower(*args).compile()
+        )
+        compile_s = time.perf_counter() - t0
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        return {
+            "flops": ca.get("flops"),
+            "bytes_accessed": ca.get("bytes accessed", ca.get("bytes_accessed")),
+            "compile_s": round(compile_s, 2),
+        }
+
+    dense_cfg = EMConfig(max_active_classes=0, fused_estep=False)
+    compact_cfg = EMConfig(max_active_classes=width, fused_estep=True)
+    dense_tx = em_mod.make_mean_optimizer(dense_cfg)
+    opt = dense_tx.init(gmm.means)
+
+    dense = cost_of(
+        lambda g, m, o: em_mod.em_update(g, m, o, dense_tx, dense_cfg),
+        gmm, mem, opt,
+    )
+    # private on purpose: the public em_update wraps this in the lax.cond
+    # whose cost analysis would double-count (docstring above)
+    fused, interpret = em_mod._resolve_fused_estep(compact_cfg)
+    compact = cost_of(
+        lambda g, m, o: em_mod._compact_em_update(
+            g, m, o, dense_tx, compact_cfg, 1e-10, width, fused, interpret
+        ),
+        gmm, mem, opt,
+    )
+
+    def ratio(a, b):
+        if not a or not b:
+            return None
+        return round(a / b, 3)
+
+    return {
+        "metric": "em_update_cost_analysis",
+        "backend": jax.default_backend(),
+        "shapes": {"C": c, "N": n, "d": d, "K": k, "width": width},
+        "dense": dense,
+        "compact_fused": compact,
+        "flops_ratio_dense_over_compact": ratio(
+            dense["flops"], compact["flops"]
+        ),
+        "bytes_ratio_dense_over_compact": ratio(
+            dense["bytes_accessed"], compact["bytes_accessed"]
+        ),
+    }
+
+
 def _fail(error_obj: dict) -> None:
     """Terminal failure path: emit the live diagnostics, then — if a watcher
     window ever captured a real number — the cached result as the final line
@@ -710,19 +824,29 @@ def main() -> None:
             "errors": {"probe": "see probe event lines above"},
         })
 
-    plan = [("unfused", False, BATCH), ("fused", True, BATCH)]
+    plan = [("unfused", "unfused", BATCH), ("fused", "fused", BATCH)]
     if BEST_BATCH > 0 and BEST_BATCH != BATCH:
         # throughput-optimal batch from the on-device sweep (PERF.md); the
         # two reference-batch paths come FIRST so a deadline-truncated run
         # still records the head-to-head at the reference's batch 80
-        plan.append((f"fused_b{BEST_BATCH}", True, BEST_BATCH))
+        plan.append((f"fused_b{BEST_BATCH}", "fused", BEST_BATCH))
+    if BEST_BATCH > 0 and REMAT_BATCH > 0:
+        # the r4 batch-512 DNF, retried with layer1-only selective remat:
+        # rematting just the cheap-but-wide 112^2 stage trades ~12% of the
+        # FLOPs for the biggest slice of activation HBM (PERF.md) — the
+        # cheapest way to make 512 fit. Bonus entry: 2 attempts max; gated
+        # on BEST_BATCH too because BEST_BATCH=0 marks a CI smoke run at
+        # toy sizes where a 512-batch flagship compile has no business.
+        plan.append(
+            (f"fused_b{REMAT_BATCH}_remat_l1", "fused_remat_l1", REMAT_BATCH)
+        )
     results = {}
     errors = {}
     attempts_total = 0
     partial_line = None
-    for name, fused, batch in plan:
+    for name, measure, batch in plan:
         result, err, attempts = robust_measure(
-            name, fused, batch,
+            name, measure, batch,
             # once a partial result exists, re-flush it after every
             # in-progress line so the last line stays a real number
             reemit=(lambda: _emit(partial_line)) if partial_line else None,
@@ -753,17 +877,24 @@ if __name__ == "__main__":
         # child mode: one measurement, result JSON on the last stdout line.
         # Optional 3rd operand overrides the batch (the best-batch plan
         # entry); BENCH_BATCH env still works for plain 2-operand calls.
+        measure = sys.argv[2]
+        if measure == "em":
+            # hermetic compile-only microbench (no probe, CPU-friendly)
+            print(json.dumps(measure_em()))
+            raise SystemExit(0)
         if len(sys.argv) == 4:
             BATCH = int(sys.argv[3])
         if BATCH <= 0:
             raise SystemExit(f"batch must be > 0, got {BATCH}")
-        measure = sys.argv[2]
-        valid = ("unfused", "fused", "eval_unfused", "eval_fused")
+        valid = (
+            "unfused", "fused", "fused_remat_l1", "eval_unfused", "eval_fused"
+        )
         if measure not in valid:
             raise SystemExit(f"--measure must be one of {valid}, got {measure!r}")
         print(json.dumps(run_config(
-            fused=measure in ("fused", "eval_fused"),
+            fused=measure in ("fused", "fused_remat_l1", "eval_fused"),
             eval_mode=measure.startswith("eval"),
+            remat_stages=("layer1",) if measure == "fused_remat_l1" else (),
         )))
     else:
         main()

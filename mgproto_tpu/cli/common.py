@@ -106,12 +106,33 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="force the XLA scoring path")
     p.add_argument("--remat", action="store_true",
                    help="checkpoint backbone blocks (HBM for FLOPs)")
+    p.add_argument("--remat_stages", default="",
+                   help="comma-separated backbone stages to remat "
+                        "selectively (e.g. 'layer1' — the cheap-but-wide "
+                        "112^2 stage; densenets use 'denseblockN'); "
+                        "--remat overrides with full-trunk remat")
     p.add_argument("--num_workers", type=int, default=8)
     p.add_argument("--worker_backend", default="thread",
                    choices=["thread", "process"],
                    help="train-loader workers: 'process' (spawn pool) scales "
                         "the augmentation math past the GIL on many-core "
                         "hosts")
+    p.add_argument("--prefetch-depth", "--prefetch_depth",
+                   dest="prefetch_depth", type=int, default=2,
+                   help="device-prefetch depth: batches held in flight so "
+                        "the next H2D copy overlaps the current step "
+                        "(data/loader.py device_prefetch; each extra unit "
+                        "costs one batch of HBM)")
+    p.add_argument("--em_max_active", type=int, default=-1,
+                   help="compact dirty-class EM width (core/em.py): -1 auto "
+                        "(min(classes, global batch)), 0 dense path, >0 "
+                        "explicit slab width")
+    p.add_argument("--fused_estep", action="store_true", default=None,
+                   help="force the fused Pallas E-step kernel on (default: "
+                        "auto — on for TPU, off elsewhere)")
+    p.add_argument("--no_fused_estep", dest="fused_estep",
+                   action="store_false",
+                   help="force the XLA E-step path")
     p.add_argument("--seed", type=int, default=0)
     # runtime
     p.add_argument("--distributed", action="store_true",
@@ -186,8 +207,15 @@ def config_from_args(args: argparse.Namespace) -> Config:
             compute_dtype=args.compute_dtype,
             fused_scoring=args.fused_scoring,
             remat=args.remat,
+            remat_stages=tuple(
+                s for s in args.remat_stages.split(",") if s
+            ),
         ),
-        em=EMConfig(reference_stepping=args.em_reference_stepping),
+        em=EMConfig(
+            reference_stepping=args.em_reference_stepping,
+            max_active_classes=args.em_max_active,
+            fused_estep=args.fused_estep,
+        ),
         optim=OptimConfig(),
         schedule=ScheduleConfig(
             num_train_epochs=args.epochs,
@@ -211,6 +239,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             train_push_batch_size=args.batch_size,
             num_workers=args.num_workers,
             worker_backend=args.worker_backend,
+            prefetch_depth=args.prefetch_depth,
         ),
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
         seed=args.seed,

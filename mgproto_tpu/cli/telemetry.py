@@ -23,7 +23,10 @@ from typing import Any, Dict, List, Optional
 
 from mgproto_tpu.telemetry.registry import percentile_from_buckets
 from mgproto_tpu.telemetry.session import (
+    EM_ACTIVE_GAUGE,
+    EM_FALLBACK_COUNTER,
     HEALTH_FILE,
+    META_FILE,
     METRICS_FILE,
     PROM_FILE,
     TRACE_FILE,
@@ -228,6 +231,23 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
         "jit_cache_size": _series_value(last, "jit_cache_size"),
     }
 
+    # EM fast path (compact dirty-class slab, core/em.py): how wide EM ran
+    # and whether it ever overflowed the compact width into the dense branch
+    em = {
+        EM_ACTIVE_GAUGE: _series_value(last, EM_ACTIVE_GAUGE),
+        EM_FALLBACK_COUNTER: _series_value(last, EM_FALLBACK_COUNTER),
+    }
+    if any(v is not None for v in em.values()):
+        summary["em"] = em
+
+    meta_path = os.path.join(d, META_FILE)
+    if os.path.isfile(meta_path):
+        try:
+            with open(meta_path) as f:
+                summary["meta"] = json.load(f)
+        except ValueError:
+            pass
+
     # recovery events (resilience subsystem): retries, sentinel rows,
     # skipped non-finite steps, rollbacks, preemption saves, chaos faults
     from mgproto_tpu.resilience.metrics import ALL_COUNTERS
@@ -320,6 +340,14 @@ def render_table(summary: Dict[str, Any]) -> str:
     section("recompiles")
     for k, v in summary.get("recompiles", {}).items():
         rows.append((k, v))
+    if "em" in summary:
+        section("em (compact dirty-class fast path)")
+        for k, v in summary["em"].items():
+            rows.append((k, v))
+    if "meta" in summary:
+        section("meta")
+        for k, v in sorted(summary["meta"].items()):
+            rows.append((k, v))
     if "resilience" in summary:
         section("resilience (recovery events)")
         for k, v in summary["resilience"].items():

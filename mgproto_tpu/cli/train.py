@@ -215,6 +215,14 @@ def run_training(
     )
     if telem:
         telem.monitor.watch(lambda: trainer.jit_handles)
+        # run-config context next to the metric artifacts (summarize "meta")
+        telem.write_meta({
+            **run_meta,
+            "prefetch_depth": cfg.data.prefetch_depth,
+            "em_max_active_classes": trainer._em_cfg.max_active_classes,
+            "remat": cfg.model.remat,
+            "remat_stages": list(cfg.model.remat_stages),
+        })
 
     # recovery wiring: preemption flag (signal handlers, if any, are
     # installed by main(); chaos raises the same flag), active chaos state,
@@ -397,6 +405,12 @@ def _run_epoch(
             )
         if last is not None:
             m = jax.device_get(last._asdict())
+            if telem:
+                # em_active is the epoch max, em_compact_fallback the epoch
+                # sum (engine/train.py train_epoch accumulators)
+                telem.observe_em(
+                    float(m["em_active"]), float(m["em_compact_fallback"])
+                )
             if not np.isfinite(float(m["loss"])):
                 if guard is None:
                     # failure detection the reference lacks (SURVEY.md

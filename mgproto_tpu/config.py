@@ -44,6 +44,15 @@ class ModelConfig:
     # recomputes block internals instead of storing activations — enables
     # larger per-chip batches at ~1/3 extra FLOPs.
     remat: bool = False
+    # Selective per-stage remat: checkpoint only the named backbone stages
+    # ("layer1".."layer4" for resnets, "denseblock1".."denseblock4" for
+    # densenets). The sweet spot for this model family is ("layer1",): the
+    # reference's no-stem-pool quirk makes layer1 run at 112^2 with only 64
+    # channels — cheap to recompute but the widest activations in the trunk
+    # (PERF.md MFU-headroom decomposition) — so rematting it alone buys most
+    # of the HBM headroom at a fraction of full-remat's recompute tax.
+    # Ignored when `remat` is True (full-trunk remat wins).
+    remat_stages: Tuple[str, ...] = ()
 
     @property
     def num_prototypes(self) -> int:
@@ -69,6 +78,26 @@ class EMConfig:
     # main.py:223-227). Slower (C sequential steps per round); exists so the
     # deviation is a switch, not a belief.
     reference_stepping: bool = False
+    # Compact dirty-class EM (core/em.py): at batch B only <=B of the C class
+    # queues can newly satisfy `updated & full`, yet the dense path reduces
+    # over all C banks every step. With a positive width A, the <=A dirty
+    # banks are compacted (lax.top_k + gather) into an [A, N, d] slab, E/M
+    # runs there, and means/priors scatter back — cutting EM HBM traffic
+    # ~C/A x at steady state. -1 = auto (Trainer resolves to min(C, global
+    # batch)); 0 disables (dense path, the pre-compaction behavior). When
+    # more than A classes are dirty (e.g. the first EM call after the epoch
+    # gate opens), a lax.cond falls back to the dense path for that call —
+    # counted in `em_compact_fallback_total`, never a recompile. Default
+    # path only; reference_stepping keeps its sequential parity scan.
+    max_active_classes: int = -1
+    # Fused E-step Pallas kernel (ops/em_kernels.py): per-class
+    # responsibilities + sufficient statistics (sum r, sum r*x, sum r*x^2)
+    # in one VMEM pass, no [N, K] responsibility or log-density intermediates
+    # in HBM; the m-step objective is evaluated in sufficient-statistics form
+    # (identical math, no custom VJP needed — resp are constants there).
+    # None = auto: ON for TPU backends, OFF elsewhere (the interpret-mode
+    # fallback is correct but slow). True/False force the path.
+    fused_estep: Optional[bool] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,6 +170,11 @@ class DataConfig:
     # rates (VERDICT r3 item 5). Applied to the TRAIN loader only: push/
     # test/ood pipelines are resize-only and not GIL-bound.
     worker_backend: str = "thread"
+    # device_prefetch depth (data/loader.py): batches held in flight so batch
+    # N+1's host->device copy overlaps step N's compute. Each extra unit
+    # costs one batch of HBM (~154 MB at flagship batch 256); >2 only helps
+    # when the loader is bursty relative to the step time.
+    prefetch_depth: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

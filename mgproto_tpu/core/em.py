@@ -21,11 +21,35 @@ default. `EMConfig.reference_stepping=True` switches to a reference-exact
 sequential path (`_reference_em_update`) that reproduces the torch
 bookkeeping — per-(class, round) Adam steps, shared moments, drift included —
 measured against a torch oracle in tests/test_em_parity.py.
+
+Bank fast path (the post-measurement MFU work, PERF.md): at steady state EM
+runs EVERY step, and its bank traffic — not its FLOPs — is what stalls the
+step. Two composable levers, both default-path only:
+
+  * COMPACT DIRTY-CLASS EM (`max_active_classes` > 0): a train batch of B
+    rows can newly dirty at most B classes, so instead of reducing over all
+    C banks, a fixed-width lax.top_k + gather pulls the <=A dirty banks into
+    an [A, N, d] slab, E/M runs there, and means/priors scatter back —
+    ~C/A x less bank traffic (2.5x at flagship C=200, B=80). If more than A
+    classes are dirty (first call after the epoch gate opens), a lax.cond
+    falls back to the dense path for that call: both branches are compiled
+    once, so the fallback is a counter event, never a recompile.
+  * FUSED E-STEP (`fused_estep`, ops/em_kernels.py): responsibilities and
+    their sufficient statistics (sum r, sum r x, sum r x^2) computed in one
+    VMEM pass; the m-step objective is then evaluated in sufficient-
+    statistics form (`_m_step_objective_stats` — exactly the same math as
+    `_m_step_objective`, since responsibilities are constants there), so no
+    [N, K] intermediate ever reaches HBM, forward or backward.
+
+Equivalence contracts are pinned in tests/test_em_compact.py; the dense path
+(`max_active_classes=0`, `fused_estep=False`) is the pre-fast-path behavior
+bit-for-bit, and `reference_stepping=True` is untouched.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,11 +58,13 @@ import optax
 from mgproto_tpu.config import EMConfig
 from mgproto_tpu.core.memory import Memory, clear_updated
 from mgproto_tpu.core.mgproto import GMMState
+from mgproto_tpu.ops.em_kernels import em_estep_stats
 from mgproto_tpu.ops.gaussian import (
     diag_gaussian_log_prob,
     e_step,
     momentum_update,
     pairwise_sq_dists,
+    precompute_diag_gaussian,
 )
 
 
@@ -46,6 +72,10 @@ class EMAux(NamedTuple):
     loss: jax.Array  # final-round masked m-step objective (scalar)
     num_active: jax.Array  # classes that ran EM this call
     log_likelihood: jax.Array  # mean E-step log-likelihood over active classes
+    # 1 when compaction was enabled but more classes were dirty than the
+    # compact width, so this call took the dense lax.cond branch (telemetry:
+    # em_compact_fallback_total); 0 otherwise.
+    compact_fallback: jax.Array
 
 
 def em_health_diagnostics(
@@ -118,6 +148,30 @@ def make_mean_optimizer(cfg: EMConfig) -> optax.GradientTransformation:
     return optax.adam(cfg.mean_lr)
 
 
+def resolve_em_config(
+    cfg: EMConfig, num_classes: int, global_batch: int
+) -> EMConfig:
+    """Resolve `max_active_classes=-1` (auto) to min(C, global batch): one
+    step's enqueue can newly dirty at most one class per batch row, so at
+    EM-every-step steady state the compact slab provably covers every dirty
+    class; the dense fallback only fires when EM was gated off long enough
+    for dirt to accumulate (counted in telemetry, never a recompile)."""
+    if cfg.max_active_classes != -1:
+        return cfg
+    return dataclasses.replace(
+        cfg, max_active_classes=min(num_classes, max(int(global_batch), 1))
+    )
+
+
+def _resolve_fused_estep(cfg: EMConfig) -> Tuple[bool, bool]:
+    """(use fused kernel, run it in interpret mode). None = auto, like
+    ModelConfig.fused_scoring: Mosaic on TPU, off elsewhere (the interpreter
+    is correct but slow — forcing True on CPU is for tests/microbenches)."""
+    on_tpu = jax.default_backend() == "tpu"
+    fused = cfg.fused_estep if cfg.fused_estep is not None else on_tpu
+    return bool(fused), not on_tpu
+
+
 def _class_objective(
     mu: jax.Array,
     x: jax.Array,
@@ -154,6 +208,58 @@ def _m_step_objective(
     per_class = jax.vmap(_class_objective, in_axes=(0, 0, 0, 0, 0, None))(
         means, x, resp, pi_old, sigmas, lam
     )
+    return jnp.sum(per_class * active)
+
+
+def _class_objective_stats(
+    mu: jax.Array,
+    s: jax.Array,
+    sx: jax.Array,
+    sxx: jax.Array,
+    pi_old: jax.Array,
+    sigmas: jax.Array,
+    lam: float,
+    n: int,
+    eps: float = 1e-10,
+) -> jax.Array:
+    """`_class_objective` evaluated from SMOOTHED sufficient statistics
+    (s [K], sx [K,d], sxx [K,d]) instead of resp [N,K] — the same math:
+    with the shared quadratic expansion logN = const + x.(mu/s^2) - x^2/2s^2,
+
+      sum_n r logN = s*const + <mu/s^2, sx> - 0.5 <1/s^2, sxx>
+
+    so the responsibility matrix never needs to exist here (it was reduced
+    away inside ops/em_kernels.py). Gradients flow through mu only —
+    statistics are constants, exactly like resp in `_class_objective`."""
+    m_scaled, inv_var, const = precompute_diag_gaussian(mu, sigmas, eps)
+    ll_sum = (
+        s * (const + jnp.log(pi_old + eps))
+        + jnp.sum(m_scaled * sx, axis=-1)
+        - 0.5 * jnp.sum(inv_var * sxx, axis=-1)
+    )  # [K] = sum_n resp[n, k] * ll[n, k]
+    weighted_nll = -jnp.sum(ll_sum) / n
+    pair = pairwise_sq_dists(mu, mu)
+    off = 1.0 - jnp.eye(mu.shape[0])
+    diversity = jnp.sum(jnp.exp(-pair) * off) / jnp.sum(off)
+    return weighted_nll + lam * diversity
+
+
+def _m_step_objective_stats(
+    means: jax.Array,
+    s: jax.Array,
+    sx: jax.Array,
+    sxx: jax.Array,
+    pi_old: jax.Array,
+    sigmas: jax.Array,
+    active: jax.Array,
+    lam: float,
+    n: int,
+    eps: float = 1e-10,
+) -> jax.Array:
+    """Masked sum over classes of `_class_objective_stats`."""
+    per_class = jax.vmap(
+        _class_objective_stats, in_axes=(0, 0, 0, 0, 0, 0, None, None, None)
+    )(means, s, sx, sxx, pi_old, sigmas, lam, n, eps)
     return jnp.sum(per_class * active)
 
 
@@ -241,6 +347,192 @@ def _reference_em_update(
             loss=jnp.sum(losses * active_f),
             num_active=jnp.sum(active),
             log_likelihood=jnp.sum(lls * active_f) / n_active,
+            compact_fallback=jnp.zeros((), jnp.int32),
+        ),
+    )
+
+
+def _em_rounds(
+    means: jax.Array,
+    pi_slab: jax.Array,
+    x_slab: jax.Array,
+    sigmas_slab: jax.Array,
+    active_slab: jax.Array,
+    idx: Optional[jax.Array],
+    opt_state: optax.OptState,
+    mean_tx: optax.GradientTransformation,
+    cfg: EMConfig,
+    cap: int,
+    eps: float,
+    fused: bool,
+    interpret: bool,
+    mesh,
+) -> Tuple[jax.Array, jax.Array, optax.OptState, jax.Array, jax.Array]:
+    """`num_em_loop` EM rounds over a slab of classes — the shared loop of
+    the dense (idx=None: slab == all classes) and compact (idx [A]: slab ==
+    means[idx]) paths. `means` is always the FULL [C, K, d] tensor: the one
+    Adam step per round runs over it either way, so zero-grad classes see
+    identical moment decay and the two paths' optimizer bookkeeping cannot
+    diverge. Returns (means, pi_slab, opt_state, last loss, last masked
+    mean log-likelihood)."""
+    active_f = active_slab.astype(jnp.float32)
+    n_active = jnp.maximum(jnp.sum(active_f), 1.0)
+    n = x_slab.shape[1]
+    k = sigmas_slab.shape[1]
+    loss = jnp.zeros(())
+    ll_mean = jnp.zeros(())
+    for _ in range(cfg.num_em_loop):
+        mu_slab = means if idx is None else means[idx]
+        if fused:
+            ll, s_raw, sx_raw, sxx_raw = em_estep_stats(
+                x_slab, mu_slab, sigmas_slab, pi_slab, eps,
+                interpret=interpret, mesh=mesh,
+            )
+            # additive smoothing in statistics space (model.py:383): raw
+            # responsibilities sum to 1 over K, so the per-sample smoothing
+            # denominator is the constant 1 + K*alpha, and sum_n x /
+            # sum_n x^2 are recovered from the raw stats themselves
+            # (ops/em_kernels.py docstring)
+            denom = 1.0 + k * cfg.alpha
+            s = (s_raw + n * cfg.alpha) / denom
+            sx = (
+                sx_raw + cfg.alpha * jnp.sum(sx_raw, axis=1, keepdims=True)
+            ) / denom
+            sxx = (
+                sxx_raw + cfg.alpha * jnp.sum(sxx_raw, axis=1, keepdims=True)
+            ) / denom
+            pi_unnorm = s + eps  # == sum_n resp_smoothed + eps
+            pi_old = pi_slab
+
+            def obj(m, s=s, sx=sx, sxx=sxx, pi_old=pi_old):
+                m_slab = m if idx is None else m[idx]
+                return _m_step_objective_stats(
+                    m_slab, s, sx, sxx, pi_old, sigmas_slab, active_f,
+                    cfg.diversity_lambda, n, eps,
+                )
+        else:
+            with jax.named_scope("em_estep"):
+                ll, log_resp = jax.vmap(e_step, in_axes=(0, 0, 0, 0))(
+                    x_slab, mu_slab, sigmas_slab, pi_slab
+                )  # ll [A], log_resp [A, N, K]
+            resp = jnp.exp(log_resp)
+            resp = (resp + cfg.alpha) / jnp.sum(
+                resp + cfg.alpha, axis=-1, keepdims=True
+            )  # model.py:383
+            pi_unnorm = jnp.sum(resp, axis=1) + eps  # [A, K], model.py:385
+            pi_old = pi_slab
+
+            def obj(m, resp=resp, pi_old=pi_old):
+                m_slab = m if idx is None else m[idx]
+                return _m_step_objective(
+                    m_slab, x_slab, resp, pi_old, sigmas_slab, active_f,
+                    cfg.diversity_lambda,
+                )
+
+        with jax.named_scope("em_mstep"):
+            loss, grads = jax.value_and_grad(obj)(means)
+            updates, opt_state = mean_tx.update(grads, opt_state, means)
+            means = optax.apply_updates(means, updates)
+
+        pi_new = pi_unnorm / cap  # model.py:399
+        pi_slab = jnp.where(
+            active_slab[:, None],
+            momentum_update(pi_slab, pi_new, cfg.tau),
+            pi_slab,
+        )
+        ll_mean = jnp.sum(ll * active_f) / n_active
+    return means, pi_slab, opt_state, loss, ll_mean
+
+
+def _dense_em_update(
+    gmm: GMMState,
+    memory: Memory,
+    opt_state: optax.OptState,
+    mean_tx: optax.GradientTransformation,
+    cfg: EMConfig,
+    eps: float,
+    fused: bool,
+    interpret: bool,
+    mesh,
+) -> Tuple[GMMState, Memory, optax.OptState, EMAux]:
+    """All-class EM (reference `update_GMM`, model.py:277-301): vmapped over
+    the full class axis, inactive classes masked and pinned."""
+    c, cap, _ = memory.feats.shape
+    active = memory.updated & (memory.length == cap)  # model.py:283,289
+    means, priors, opt_state, loss, ll_mean = _em_rounds(
+        gmm.means, gmm.priors, memory.feats, gmm.sigmas, active, None,
+        opt_state, mean_tx, cfg, cap, eps, fused, interpret, mesh,
+    )
+    new_gmm = gmm._replace(
+        means=jnp.where(active[:, None, None], means, gmm.means),
+        priors=priors,
+    )
+    return (
+        new_gmm,
+        clear_updated(memory),
+        opt_state,
+        EMAux(
+            loss=loss,
+            num_active=jnp.sum(active),
+            log_likelihood=ll_mean,
+            compact_fallback=jnp.zeros((), jnp.int32),
+        ),
+    )
+
+
+def _compact_em_update(
+    gmm: GMMState,
+    memory: Memory,
+    opt_state: optax.OptState,
+    mean_tx: optax.GradientTransformation,
+    cfg: EMConfig,
+    eps: float,
+    width: int,
+    fused: bool,
+    interpret: bool,
+) -> Tuple[GMMState, Memory, optax.OptState, EMAux]:
+    """Compact dirty-class EM: gather the <=`width` dirty banks into an
+    [A, N, d] slab, run E/M there, scatter means/priors back.
+
+    The bank is touched ONLY through the `[idx]` gathers below (the lint
+    scripts/check_em_compact.py pins this): E-step reads [A, N, d] instead
+    of [C, N, d] and the m-step backward never sees the bank at all in the
+    fused mode. The Adam step still spans the full [C, K, d] means tensor
+    (tiny next to the bank) with the slab gradient scattered in, so the
+    optimizer trajectory is the dense path's exactly."""
+    c, cap, _ = memory.feats.shape
+    active = memory.updated & (memory.length == cap)
+    with jax.named_scope("em_compact_gather"):
+        # fixed-width compaction: top_k over the dirty mask pulls the dirty
+        # class ids to the front (ties resolve to ascending class id, so the
+        # slab order is deterministic); when fewer than `width` classes are
+        # dirty the tail slots carry arbitrary clean classes, masked inert
+        # by `slab_active`.
+        _, idx = jax.lax.top_k(active.astype(jnp.int32), width)
+        slab_active = active[idx]  # [A]
+        x_slab = memory.feats[idx]  # [A, N, d] — the only bank traffic
+        sig_slab = gmm.sigmas[idx]
+        pi_slab = gmm.priors[idx]
+    means, pi_slab, opt_state, loss, ll_mean = _em_rounds(
+        gmm.means, pi_slab, x_slab, sig_slab, slab_active, idx,
+        opt_state, mean_tx, cfg, cap, eps, fused, interpret, None,
+    )
+    with jax.named_scope("em_compact_scatter"):
+        # inactive slab slots still hold their gathered (untouched) priors,
+        # so the distinct-index scatter writes them back bit-identically
+        new_gmm = gmm._replace(
+            means=jnp.where(active[:, None, None], means, gmm.means),
+            priors=gmm.priors.at[idx].set(pi_slab),
+        )
+    return (
+        new_gmm,
+        clear_updated(memory),
+        opt_state,
+        EMAux(
+            loss=loss,
+            num_active=jnp.sum(active),
+            log_likelihood=ll_mean,
+            compact_fallback=jnp.zeros((), jnp.int32),
         ),
     )
 
@@ -252,52 +544,52 @@ def em_update(
     mean_tx: optax.GradientTransformation,
     cfg: EMConfig,
     eps: float = 1e-10,
+    mesh=None,
 ) -> Tuple[GMMState, Memory, optax.OptState, EMAux]:
     """One full EM call (reference `update_GMM`, model.py:277-301). Jittable;
     call every `update_interval` training steps once the epoch gate is open.
-    Dispatches on cfg.reference_stepping (a static config bool): the
-    TPU-native vmapped path below, or the reference-exact sequential path."""
+
+    Dispatch (all static python branches except the one lax.cond):
+      * cfg.reference_stepping: the reference-exact sequential scan.
+      * compaction disabled (`max_active_classes` <= 0, unresolved auto, or
+        >= C where it cannot help) or `mesh` given: the dense path.
+      * otherwise: lax.cond on the dirty count — compact slab when it fits
+        the width, dense fallback (flagged in EMAux.compact_fallback) when
+        it does not. Both branches compile once; steady state never
+        retraces.
+
+    `mesh` (a Mesh with a 'model' axis, from ShardedTrainer's score mesh)
+    marks the class axis as sharded: compaction is disabled there (a global
+    top_k over the sharded dirty mask would defeat the per-shard locality)
+    and the fused E-step kernel runs shard_mapped per class shard instead.
+    """
     if cfg.reference_stepping:
         return _reference_em_update(gmm, memory, opt_state, mean_tx, cfg, eps)
+    fused, interpret = _resolve_fused_estep(cfg)
     c, cap, _ = memory.feats.shape
-    active = memory.updated & (memory.length == cap)  # model.py:283,289
-    active_f = active.astype(jnp.float32)
-
-    x = memory.feats  # [C, N, d]; full queues only, so no masking needed
-    means, priors = gmm.means, gmm.priors
-    pi_old = priors  # [C, K] (reference reads them from the last layer)
-
-    loss = jnp.zeros(())
-    ll_mean = jnp.zeros(())
-    for _ in range(cfg.num_em_loop):
-        ll, log_resp = jax.vmap(e_step, in_axes=(0, 0, 0, 0))(
-            x, means, gmm.sigmas, pi_old
-        )  # ll [C], log_resp [C, N, K] (vmapped e_step squeezes to [N, K])
-        resp = jnp.exp(log_resp)
-        resp = (resp + cfg.alpha) / jnp.sum(
-            resp + cfg.alpha, axis=-1, keepdims=True
-        )  # model.py:383
-        pi_unnorm = jnp.sum(resp, axis=1) + eps  # [C, K], model.py:385
-
-        loss, grads = jax.value_and_grad(_m_step_objective)(
-            means, x, resp, pi_old, gmm.sigmas, active_f, cfg.diversity_lambda
+    width = cfg.max_active_classes
+    if mesh is not None:
+        width = 0
+    if width <= 0 or width >= c:
+        return _dense_em_update(
+            gmm, memory, opt_state, mean_tx, cfg, eps, fused, interpret, mesh
         )
-        updates, opt_state = mean_tx.update(grads, opt_state, means)
-        means = optax.apply_updates(means, updates)
+    active = memory.updated & (memory.length == cap)
+    n_active = jnp.sum(active)
 
-        pi_new = pi_unnorm / cap  # model.py:399
-        pi_old = jnp.where(
-            active[:, None], momentum_update(pi_old, pi_new, cfg.tau), pi_old
+    def compact(ops):
+        g, m, o = ops
+        return _compact_em_update(
+            g, m, o, mean_tx, cfg, eps, width, fused, interpret
         )
-        ll_mean = jnp.sum(ll * active_f) / jnp.maximum(jnp.sum(active_f), 1)
 
-    new_gmm = gmm._replace(
-        means=jnp.where(active[:, None, None], means, gmm.means),
-        priors=pi_old,
-    )
-    return (
-        new_gmm,
-        clear_updated(memory),
-        opt_state,
-        EMAux(loss=loss, num_active=jnp.sum(active), log_likelihood=ll_mean),
-    )
+    def dense(ops):
+        g, m, o = ops
+        g2, m2, o2, aux = _dense_em_update(
+            g, m, o, mean_tx, cfg, eps, fused, interpret, None
+        )
+        return g2, m2, o2, aux._replace(
+            compact_fallback=jnp.ones((), jnp.int32)
+        )
+
+    return jax.lax.cond(n_active <= width, compact, dense, (gmm, memory, opt_state))

@@ -3,15 +3,29 @@
 Reference: /root/reference/utils/memory.py — an nn.Module holding one mutable
 `cls%d` buffer per class, pushed to from inside `forward` (a replica-lost-write
 hazard under DataParallel, SURVEY.md §2.3). TPU-native design: the memory is a
-fixed-shape pytree threaded through the jitted train step; the push is a single
-masked scatter (no per-class python loop), so it is safe under any sharding —
-candidates are globally visible after an all_gather over the data axis.
+fixed-shape pytree threaded through the jitted train step; the push is one
+fixed-shape, scatter-free merge (no per-class python loop), so it is safe
+under any sharding — candidates are globally visible after an all_gather over
+the data axis.
 
 FIFO semantics: a circular buffer per class. The reference keeps buffers
 left-compacted and shifts on eviction (memory.py:56-67); since the only
 consumer is EM, which treats the bank as a *set* (model.py:279-291), a cursor-
 based circular write preserves the exact same retained-set semantics (oldest
 evicted first) with O(1) work.
+
+Scatter-free enqueue (PERF.md stall list: "the memory-bank enqueue scatter"):
+the original write was `feats.at[cls, pos].set(..., mode='drop')` — a
+row-scatter of up to B*K updates that TPUs lower as a serial chain of tiny
+dynamic-update-slices, latency-bound at ~800 updates/step at flagship
+shapes. Instead the batch is STABLY SORTED by class (one [N] argsort of
+int32 keys), which lays the kept rows out as per-class contiguous segments
+in rank order; each bank slot then *gathers* its writer — slot j of class c
+is written by segment row `(j - cursor_c) mod cap` iff that rank is below
+the class's batch count — and one fused select pass produces the new bank.
+Same bit-exact contents (tests/test_em_compact.py pins it against the
+scatter oracle), but the op mix is sort + gather + select: everything
+vectorizes, nothing serializes.
 """
 
 from __future__ import annotations
@@ -60,41 +74,58 @@ def memory_push(
       classes: [N] int class ids.
       valid:   [N] bool; invalid rows are dropped.
 
-    Jit-safe: everything is fixed-shape; invalid rows scatter out-of-bounds
-    and are dropped by XLA. If a single push holds more than `capacity` valid
-    rows of one class, the first `capacity` are kept (the reference random-
-    samples `capacity` of them, memory.py:51-53 — deterministic-first is the
+    Jit-safe and scatter-free: everything is fixed-shape, and the bank write
+    is a sort + gather + select (module docstring) — no scatter for XLA to
+    serialize. If a single push holds more than `capacity` valid rows of one
+    class, the first `capacity` are kept (the reference random-samples
+    `capacity` of them, memory.py:51-53 — deterministic-first is the
     jit-friendly equivalent; a batch never realistically exceeds capacity).
     """
-    c, cap, _ = mem.feats.shape
-    sentinel = jnp.int32(c)
-    # negative ids must also hit the sentinel: .at[] with mode='drop' drops
-    # out-of-bounds but *wraps* negative indices
-    ok = valid & (classes >= 0) & (classes < c)
-    cls = jnp.where(ok, classes.astype(jnp.int32), sentinel)  # [N]
+    with jax.named_scope("memory_push"):
+        n, _ = feats.shape
+        if n == 0:  # static shape: nothing to enqueue
+            return mem
+        c, cap, _ = mem.feats.shape
+        sentinel = jnp.int32(c)
+        ok = valid & (classes >= 0) & (classes < c)
+        cls = jnp.where(ok, classes.astype(jnp.int32), sentinel)  # [N]
 
-    one_hot = jax.nn.one_hot(cls, c, dtype=jnp.int32)  # [N, C] (sentinel -> 0s)
-    csum = jnp.cumsum(one_hot, axis=0)  # inclusive
-    rank = (
-        jnp.take_along_axis(csum, jnp.clip(cls, 0, c - 1)[:, None], axis=1)[:, 0]
-        - 1
-    )  # [N] 0-based rank within class, in batch order
-    keep = ok & (rank < cap)
-    cls = jnp.where(keep, cls, sentinel)
+        one_hot = jax.nn.one_hot(cls, c, dtype=jnp.int32)  # [N, C] (sentinel -> 0s)
+        csum = jnp.cumsum(one_hot, axis=0)  # inclusive
+        rank = (
+            jnp.take_along_axis(
+                csum, jnp.clip(cls, 0, c - 1)[:, None], axis=1
+            )[:, 0]
+            - 1
+        )  # [N] 0-based rank within class, in batch order
+        keep = ok & (rank < cap)
+        cls = jnp.where(keep, cls, sentinel)
+        counts = jnp.sum(one_hot * keep[:, None], axis=0)  # [C] (<= cap)
 
-    cursor_ext = jnp.concatenate([mem.cursor, jnp.zeros((1,), jnp.int32)])
-    pos = (cursor_ext[jnp.clip(cls, 0, c)] + rank) % cap
+        # per-class segment layout: a stable sort by class id groups the kept
+        # rows class-contiguously IN BATCH ORDER (stable => rank order);
+        # dropped rows carry the sentinel key and sort to the tail. Segment c
+        # spans [start_c, start_c + counts_c).
+        order = jnp.argsort(cls, stable=True)  # [N]
+        start = jnp.cumsum(counts) - counts  # [C] exclusive prefix
 
-    new_feats = mem.feats.at[cls, pos].set(
-        feats.astype(mem.feats.dtype), mode="drop"
-    )
-    counts = jnp.sum(one_hot * keep[:, None], axis=0)  # [C]
-    return Memory(
-        feats=new_feats,
-        length=jnp.minimum(mem.length + counts, cap),
-        cursor=(mem.cursor + counts) % cap,
-        updated=mem.updated | (counts > 0),
-    )
+        # each bank slot gathers its writer: slot j of class c receives the
+        # class's rank-r row, r = (j - cursor_c) mod cap, iff r < counts_c
+        slot = jnp.arange(cap, dtype=jnp.int32)[None, :]  # [1, cap]
+        r = (slot - mem.cursor[:, None]) % cap  # [C, cap]
+        written = r < counts[:, None]  # [C, cap]
+        src = order[jnp.clip(start[:, None] + r, 0, max(n - 1, 0))]  # [C, cap]
+        new_feats = jnp.where(
+            written[..., None],
+            feats.astype(mem.feats.dtype)[src],
+            mem.feats,
+        )
+        return Memory(
+            feats=new_feats,
+            length=jnp.minimum(mem.length + counts, cap),
+            cursor=(mem.cursor + counts) % cap,
+            updated=mem.updated | (counts > 0),
+        )
 
 
 def memory_pull_all(mem: Memory) -> Tuple[jax.Array, jax.Array]:

@@ -142,13 +142,27 @@ class MGProtoFeatures(nn.Module):
         dtype = jnp.dtype(self.cfg.compute_dtype)
         dtype = None if dtype == jnp.float32 else dtype
         kw = {"dtype": dtype}
-        if self.cfg.remat:
+        if self.cfg.remat or self.cfg.remat_stages:
             if not self.cfg.arch.startswith(("resnet", "densenet")):
                 raise ValueError(
                     "remat is implemented for resnet/densenet blocks only "
                     f"(got arch={self.cfg.arch!r})"
                 )
+        if self.cfg.remat:
+            # full-trunk remat wins over any stage selection
             kw["remat"] = True
+        elif self.cfg.remat_stages:
+            prefix = (
+                "layer" if self.cfg.arch.startswith("resnet") else "denseblock"
+            )
+            known = {f"{prefix}{i}" for i in range(1, 5)}
+            unknown = set(self.cfg.remat_stages) - known
+            if unknown:
+                raise ValueError(
+                    f"unknown remat_stages {sorted(unknown)} for arch "
+                    f"{self.cfg.arch!r}; options: {sorted(known)}"
+                )
+            kw["remat_stages"] = tuple(self.cfg.remat_stages)
         self.features = build_backbone(self.cfg.arch, **kw)
         self.add_on = AddOnLayers(
             proto_dim=self.cfg.proto_dim,
@@ -217,9 +231,13 @@ def _fused_pool(
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
 
-        from mgproto_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+        from mgproto_tpu.parallel.mesh import (
+            DATA_AXIS,
+            MODEL_AXIS,
+            shard_map_compat,
+        )
 
-        sharded_score = jax.shard_map(
+        sharded_score = shard_map_compat(
             lambda f, m, s: score_pool(
                 f, m, s, mine_T, DEFAULT_SIGMA_EPS, interpret
             ),
@@ -228,8 +246,6 @@ def _fused_pool(
             # local [B/nd, (C/nm)*K, T] blocks tile the global [B, C*K, T]
             # class-major, matching the unfused path's prototype ordering
             out_specs=(P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS)),
-            check_vma=False,  # custom_vjp inside; varying-axis checking
-            # can't see through it
         )
         vals, idx = sharded_score(feat, gmm.means, gmm.sigmas)
     else:

@@ -24,7 +24,7 @@ import optax
 
 from mgproto_tpu.config import Config
 from mgproto_tpu.core import losses as L
-from mgproto_tpu.core.em import em_update, make_mean_optimizer
+from mgproto_tpu.core.em import em_update, make_mean_optimizer, resolve_em_config
 from mgproto_tpu.core.memory import memory_push
 from mgproto_tpu.core.mgproto import (
     MGProtoFeatures,
@@ -47,6 +47,9 @@ class TrainMetrics(NamedTuple):
     accuracy: jax.Array
     full_mem_ratio: jax.Array  # fraction of classes with a full queue
     em_active: jax.Array  # classes EM touched this step
+    # EM calls that exceeded the compact width and took the dense lax.cond
+    # fallback (core/em.py; per-step 0/1, epoch SUM after train_epoch)
+    em_compact_fallback: jax.Array
     nonfinite: jax.Array  # bool: this step's update was SKIPPED (bad loss/grads)
 
 
@@ -80,6 +83,15 @@ class Trainer:
         self.joint_tx = make_joint_optimizer(cfg, steps_per_epoch)
         self.warm_tx = make_warm_optimizer(cfg)
         self.proto_tx = make_mean_optimizer(cfg.em)
+        # compact dirty-class EM: auto width resolves to the GLOBAL batch
+        # (one step can newly dirty at most one class per batch row), so the
+        # dense fallback fires only when EM was gated off long enough for
+        # dirt to accumulate (core/em.py resolve_em_config)
+        self._em_cfg = resolve_em_config(
+            cfg.em,
+            cfg.model.num_classes,
+            cfg.data.train_batch_size * jax.process_count(),
+        )
         # donate=True reuses the incoming state's buffers (params + opt
         # moments + memory bank, ~300 MB at flagship scale) in place instead
         # of copying each step. The production drivers (cli.train, bench.py)
@@ -209,16 +221,22 @@ class Trainer:
 
         def run_em(args):
             gmm, mem, popt = args
+            # the score mesh doubles as the EM mesh: both mark the class
+            # axis sharded (compaction off, fused E-step shard_mapped)
             gmm, mem, popt, aux_em = em_update(
-                gmm, mem, popt, self.proto_tx, self.cfg.em
+                gmm, mem, popt, self.proto_tx, self._em_cfg,
+                mesh=self._score_mesh,
             )
-            return gmm, mem, popt, aux_em.num_active
+            return gmm, mem, popt, aux_em.num_active, aux_em.compact_fallback
 
         def skip_em(args):
             gmm, mem, popt = args
-            return gmm, mem, popt, jnp.zeros((), jnp.int32)
+            return (
+                gmm, mem, popt,
+                jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+            )
 
-        gmm, memory, proto_opt_state, em_active = jax.lax.cond(
+        gmm, memory, proto_opt_state, em_active, em_fallback = jax.lax.cond(
             do_em, run_em, skip_em, (state.gmm, memory, state.proto_opt_state)
         )
 
@@ -245,6 +263,7 @@ class Trainer:
                 (memory.length == memory.capacity).astype(jnp.float32)
             ),
             em_active=em_active,
+            em_compact_fallback=em_fallback,
             nonfinite=~finite,
         )
         return new_state, metrics
@@ -325,10 +344,12 @@ class Trainer:
         state.
 
         The returned metrics are the LAST step's, except `em_active` and
-        `full_mem_ratio`, which are epoch maxima: EM width varies per step
-        with batch label composition (the step where queues first fill can
-        touch every class at once), so a last-step sample would understate
-        it. The max runs on-device (no per-step host sync).
+        `full_mem_ratio`, which are epoch maxima, and
+        `em_compact_fallback`, which is the epoch SUM (the telemetry
+        counter increments by it): EM width varies per step with batch
+        label composition (the step where queues first fill can touch every
+        class at once), so a last-step sample would understate it. The
+        accumulators run on-device (no per-step host sync).
 
         `guard` (a resilience EpochGuard) wraps the batch stream (chaos
         injection) and observes each completed step: it may STOP the epoch
@@ -346,9 +367,11 @@ class Trainer:
             guard.begin_epoch(epoch, state)
             batches = guard.wrap_batches(batches)
         last = None
-        em_max = fm_max = None
+        em_max = fm_max = fb_sum = None
         t_prev = time.perf_counter()
-        for images, labels in device_prefetch(batches, self.put_batch):
+        for images, labels in device_prefetch(
+            batches, self.put_batch, depth=self.cfg.data.prefetch_depth
+        ):
             # already device-placed: train_step sees jax.Arrays and skips
             # its host-conversion path
             state, last = self.train_step(
@@ -375,10 +398,17 @@ class Trainer:
                 last.full_mem_ratio if fm_max is None
                 else jnp.maximum(fm_max, last.full_mem_ratio)
             )
+            fb_sum = (
+                last.em_compact_fallback if fb_sum is None
+                else fb_sum + last.em_compact_fallback
+            )
             if guard is not None and guard.after_step(state, last):
                 break  # preemption: stop AFTER the completed step
         if guard is not None:
             guard.end_epoch()
         if last is not None:
-            last = last._replace(em_active=em_max, full_mem_ratio=fm_max)
+            last = last._replace(
+                em_active=em_max, full_mem_ratio=fm_max,
+                em_compact_fallback=fb_sum,
+            )
         return state, last

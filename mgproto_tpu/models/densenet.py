@@ -9,7 +9,7 @@ ops only (the reference counts the removed pool0, densenet_features.py:119).
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence
+from typing import Any, List, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -60,6 +60,9 @@ class DenseNetFeatures(nn.Module):
     stem_pool: bool = False  # reference removes pool0 (densenet_features.py:116)
     dtype: Any = None
     remat: bool = False  # jax.checkpoint each dense layer (see resnet.py)
+    # selective per-stage remat: checkpoint only the named dense blocks
+    # ("denseblock1".."denseblock4"); ignored when `remat` is True
+    remat_stages: Tuple[str, ...] = ()
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -69,13 +72,13 @@ class DenseNetFeatures(nn.Module):
         if self.stem_pool:
             x = max_pool(x, 3, 2, 1)
 
-        layer_cls = (
-            nn.remat(DenseLayer, static_argnums=(2,))
-            if self.remat
-            else DenseLayer
-        )
+        remat_cls = nn.remat(DenseLayer, static_argnums=(2,))
         num_features = self.num_init_features
         for bi, num_layers in enumerate(self.block_config):
+            stage_remat = (
+                self.remat or f"denseblock{bi + 1}" in self.remat_stages
+            )
+            layer_cls = remat_cls if stage_remat else DenseLayer
             for li in range(num_layers):
                 x = layer_cls(
                     growth_rate=self.growth_rate,

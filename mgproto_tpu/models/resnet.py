@@ -14,7 +14,7 @@ reference, which always counts the skipped maxpool, resnet_features.py:140).
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence
+from typing import Any, List, Sequence, Tuple
 
 import flax.linen as nn
 
@@ -98,6 +98,12 @@ class ResNetFeatures(nn.Module):
     # instead of storing them — HBM for FLOPs, the standard remat trade for
     # larger batches (scope names are preserved, so checkpoints interchange)
     remat: bool = False
+    # selective per-stage remat: checkpoint only the named stages
+    # ("layer1".."layer4"). layer1 is the sweet spot at the reference's
+    # no-stem-pool 112^2 resolution: its 64-channel blocks are cheap to
+    # recompute but hold the widest activations in the trunk (PERF.md).
+    # Ignored when `remat` is True.
+    remat_stages: Tuple[str, ...] = ()
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -107,15 +113,13 @@ class ResNetFeatures(nn.Module):
         if self.stem_pool:
             x = max_pool(x, 3, 2, 1)
 
-        block_cls = (
-            nn.remat(self.block_cls, static_argnums=(2,))
-            if self.remat
-            else self.block_cls
-        )
+        remat_cls = nn.remat(self.block_cls, static_argnums=(2,))
         inplanes = 64
         for li, (planes, blocks) in enumerate(
             zip((64, 128, 256, 512), self.layers)
         ):
+            stage_remat = self.remat or f"layer{li + 1}" in self.remat_stages
+            block_cls = remat_cls if stage_remat else self.block_cls
             stride = 1 if li == 0 else 2
             for bi in range(blocks):
                 s = stride if bi == 0 else 1

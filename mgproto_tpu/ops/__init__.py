@@ -3,6 +3,7 @@ from mgproto_tpu.ops.gaussian import (
     mixture_log_likelihood,
     e_step,
 )
+from mgproto_tpu.ops.em_kernels import em_estep_stats
 from mgproto_tpu.ops.pooling import top_t_pool, mine_mask_activations
 from mgproto_tpu.ops import receptive_field
 
@@ -10,6 +11,7 @@ __all__ = [
     "diag_gaussian_log_prob",
     "mixture_log_likelihood",
     "e_step",
+    "em_estep_stats",
     "top_t_pool",
     "mine_mask_activations",
     "receptive_field",

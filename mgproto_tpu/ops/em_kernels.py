@@ -1,0 +1,205 @@
+"""Fused Pallas E-step: responsibilities + sufficient statistics in VMEM.
+
+EM's E-step over the memory bank is the heaviest non-MXU phase of the
+steady-state train step (PERF.md: "EM's masked reductions over the full
+[200, 800, 64] memory bank"). Evaluated the XLA way (ops/gaussian.py e_step
+vmapped over classes), each EM round materializes per-class [N, K]
+log-density and responsibility matrices in HBM, and the m-step objective's
+backward re-reads the bank and the responsibilities once more.
+
+This kernel keeps one class's whole E-step in VMEM: two MXU matmuls produce
+the [N, K] weighted log-densities, a stable softmax turns them into
+responsibilities, and only the SUFFICIENT STATISTICS leave the chip —
+
+    s   [K]    = sum_n r[n, k]
+    sx  [K, d] = sum_n r[n, k] * x[n]
+    sxx [K, d] = sum_n r[n, k] * x[n]^2
+    ll  scalar = mean_n logsumexp_k
+
+(~2 KB per class at flagship K=10, d=64 vs ~2.6 MB of intermediates). The
+m-step objective is an exact function of (s, sx, sxx) — see core/em.py
+`_m_step_objective_stats` — so no [N, K] array is ever needed again, and
+because responsibilities are CONSTANTS in the m-step (the reference computes
+them under no_grad, model.py:340-344), the kernel needs no custom VJP at
+all: nothing differentiates through it.
+
+Smoothing note (why raw stats suffice): the reference smooths
+resp' = (resp + alpha) / sum_k(resp + alpha) (model.py:383); since
+sum_k resp[n, :] = 1, the denominator is the constant 1 + K*alpha, so
+smoothed statistics are affine in the raw ones, with sum_n x = sum_k sx
+and sum_n x^2 = sum_k sxx (again because responsibilities sum to 1).
+core/em.py applies that affine map; the kernel stays smoothing-agnostic.
+
+Numerics: the same `precompute_diag_gaussian` as every other density path
+(single source of the quadratic expansion), f32 with HIGHEST matmul
+precision. Auto-gated like ops/fused_scoring.py: Mosaic on TPU, interpret
+mode elsewhere (correct but slow — tests only). On class-sharded meshes the
+call is shard_map-composed (each model shard runs the same pallas_call on
+its local class slab; per-class stats need no collective).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from mgproto_tpu.ops.gaussian import DEFAULT_SIGMA_EPS, precompute_diag_gaussian
+
+_NEG_INF = float("-inf")
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _estep_kernel(x_ref, msc_ref, ivar_ref, const_ref, s_ref, sx_ref, sxx_ref, ll_ref):
+    """One class per grid cell.
+
+    x_ref:     [1, N, d]   the class's memory-bank slab.
+    msc_ref:   [1, KP, d]  mu / sigma^2 (K padded to KP lanes).
+    ivar_ref:  [1, KP, d]  1 / sigma^2 (0 in padded slots).
+    const_ref: [1, KP]     density const + log prior (-inf in padded slots).
+    s_ref:     [1, KP]     out: sum_n resp.
+    sx_ref:    [1, KP, d]  out: resp^T x.
+    sxx_ref:   [1, KP, d]  out: resp^T x^2.
+    ll_ref:    [1, LP]     out: mean log-likelihood, broadcast over LP.
+    """
+    x = x_ref[0]  # [N, d]
+    xx = x * x
+    # weighted log-density w[n, k] = const_k + x.(mu*s) - 0.5 (x*x).s
+    cross = jax.lax.dot_general(
+        x, msc_ref[0],
+        (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # [N, KP]
+    quad = jax.lax.dot_general(
+        xx, ivar_ref[0],
+        (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # [N, KP]
+    w = const_ref[0][None, :] + cross - 0.5 * quad  # [N, KP]
+
+    # stable softmax over K: padded slots hold -inf -> exp 0, never selected
+    m = jnp.max(w, axis=1, keepdims=True)  # [N, 1]; finite (K live slots)
+    e = jnp.exp(w - m)
+    z = jnp.sum(e, axis=1, keepdims=True)
+    resp = e / z  # [N, KP]
+    log_norm = m + jnp.log(z)  # [N, 1] logsumexp
+
+    s_ref[0, :] = jnp.sum(resp, axis=0)
+    sx_ref[0] = jax.lax.dot_general(
+        resp, x,
+        (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # [KP, d]
+    sxx_ref[0] = jax.lax.dot_general(
+        resp, xx,
+        (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    ll_ref[0, :] = jnp.full((ll_ref.shape[1],), jnp.mean(log_norm), jnp.float32)
+
+
+def _estep_stats_impl(
+    x: jax.Array,
+    means: jax.Array,
+    sigmas: jax.Array,
+    priors: jax.Array,
+    eps: float,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    a, n, d = x.shape
+    k = means.shape[1]
+    # K is a SUBLANE dim in the [1, KP, d] blocks (d is the lane dim) and
+    # the lane dim only of the in-VMEM [N, KP] density tile, which Mosaic
+    # pads to lane width internally for free — so 8-alignment suffices, and
+    # the HBM-resident padded tensors stay ~K-sized instead of 128-sized
+    # (12.8x at flagship K=10)
+    kp = _round_up(k, 8)
+    lp = 8  # ll is a per-class scalar; a sublane-aligned row to write it to
+
+    # shared density precompute (ops/gaussian.py — the ONE quadratic
+    # expansion), then fold the log prior in and pad K. Padded slots get
+    # inv_var=0 / const=-inf: densities -inf, responsibilities exactly 0.
+    m_scaled, inv_var, const = precompute_diag_gaussian(means, sigmas, eps)
+    m_scaled = m_scaled.reshape(a, k, d)
+    inv_var = inv_var.reshape(a, k, d)
+    const = const.reshape(a, k) + jnp.log(priors.astype(jnp.float32) + eps)
+    msc = jnp.pad(m_scaled, ((0, 0), (0, kp - k), (0, 0)))
+    ivar = jnp.pad(inv_var, ((0, 0), (0, kp - k), (0, 0)))
+    const = jnp.pad(const, ((0, 0), (0, kp - k)), constant_values=_NEG_INF)
+
+    with jax.named_scope("em_estep_fused"):
+        s, sx, sxx, ll = pl.pallas_call(
+            _estep_kernel,
+            grid=(a,),
+            in_specs=[
+                pl.BlockSpec((1, n, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, kp, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, kp, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, kp), lambda i: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, kp), lambda i: (i, 0)),
+                pl.BlockSpec((1, kp, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, kp, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, lp), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((a, kp), jnp.float32),
+                jax.ShapeDtypeStruct((a, kp, d), jnp.float32),
+                jax.ShapeDtypeStruct((a, kp, d), jnp.float32),
+                jax.ShapeDtypeStruct((a, lp), jnp.float32),
+            ],
+            interpret=interpret,
+        )(x.astype(jnp.float32), msc, ivar, const)
+    return ll[:, 0], s[:, :k], sx[:, :k, :], sxx[:, :k, :]
+
+
+def em_estep_stats(
+    x: jax.Array,
+    means: jax.Array,
+    sigmas: jax.Array,
+    priors: jax.Array,
+    eps: float = DEFAULT_SIGMA_EPS,
+    interpret: bool = False,
+    mesh=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused E-step over a class slab.
+
+    Args:
+      x:      [A, N, d] per-class memory features (full queues).
+      means:  [A, K, d] mixture means.
+      sigmas: [A, K, d] mixture stds.
+      priors: [A, K] mixture priors.
+      mesh:   optional jax.sharding.Mesh with a 'model' axis: the call is
+        shard_mapped so each model shard runs the kernel on its local class
+        slab (class-sharded EM state; per-class stats need no collective).
+
+    Returns:
+      (ll [A] mean log-likelihood — e_step's first output,
+       s [A, K], sx [A, K, d], sxx [A, K, d] RAW responsibility statistics).
+    """
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from mgproto_tpu.parallel.mesh import MODEL_AXIS, shard_map_compat
+
+        spec = P(MODEL_AXIS)
+        return shard_map_compat(
+            functools.partial(
+                _estep_stats_impl, eps=eps, interpret=interpret
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, spec, spec, spec),
+        )(x, means, sigmas, priors)
+    return _estep_stats_impl(x, means, sigmas, priors, eps, interpret)

@@ -28,6 +28,27 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+    """`jax.shard_map` across the jax versions this repo meets: the CPU CI
+    image ships 0.4.x (shard_map lives in jax.experimental with a
+    `check_rep` kwarg) while the TPU relay runs a current jax (top-level
+    `jax.shard_map` with `check_vma`). Both checks are disabled for the
+    same reason: the wrapped bodies contain pallas_call/custom_vjp
+    primitives the replication/varying-axis checker cannot see through
+    (core/mgproto._fused_pool's long-standing caveat)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 _distributed_initialized = False
 
 

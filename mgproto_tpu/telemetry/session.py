@@ -35,6 +35,13 @@ PROM_FILE = "metrics.prom"
 METRICS_FILE = "metrics.jsonl"
 HEALTH_FILE = "health.jsonl"
 TRACE_FILE = "trace.json"
+META_FILE = "meta.json"
+
+# EM fast-path metrics (core/em.py): pre-registered so a clean run's
+# snapshots carry explicit values and `mgproto-telemetry summarize` always
+# shows the EM story
+EM_ACTIVE_GAUGE = "em_active_classes"
+EM_FALLBACK_COUNTER = "em_compact_fallback_total"
 
 
 def _is_primary_host() -> bool:
@@ -87,6 +94,48 @@ class TelemetrySession:
             "whole-epoch throughput summed across hosts",
         )
         self._g_epoch = self.registry.gauge("epoch", "last completed epoch")
+        # EM fast path (pre-registered, see module constants): gauge tracks
+        # the widest EM call of the last epoch; the counter accumulates
+        # dense-path fallbacks of the compact dirty-class slab
+        self._g_em_active = self.registry.gauge(
+            EM_ACTIVE_GAUGE,
+            "classes EM touched (epoch max of the per-step width)",
+        )
+        self._g_em_active.set(0.0)
+        self._c_em_fallback = self.registry.counter(
+            EM_FALLBACK_COUNTER,
+            "EM calls that exceeded the compact width and ran the dense "
+            "fallback branch",
+        )
+        self._c_em_fallback.inc(0.0)
+
+    def observe_em(self, active_classes: float, compact_fallbacks: float = 0.0):
+        """Record one epoch's EM fast-path outcome (host floats — callers
+        device_get their metrics first)."""
+        self._g_em_active.set(float(active_classes))
+        if compact_fallbacks:
+            self._c_em_fallback.inc(float(compact_fallbacks))
+
+    def write_meta(self, meta: Dict[str, Any]) -> None:
+        """Persist run configuration context (e.g. prefetch depth, compute
+        dtype) as meta.json next to the metric artifacts — primary host
+        only; merged over any earlier meta so repeated calls accumulate."""
+        if not self.primary or self._closed:
+            return
+        import json
+
+        path = os.path.join(self.out_dir, META_FILE)
+        merged: Dict[str, Any] = {}
+        try:
+            with open(path) as f:
+                merged = json.load(f)
+        except (OSError, ValueError):
+            pass
+        merged.update(meta)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
 
     def span(self, name: str, **attrs):
         return self.tracer.span(name, **attrs)

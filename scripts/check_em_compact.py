@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Lint: the compact EM path must never touch the full memory bank.
+
+The whole point of `core/em.py::_compact_em_update` is that EM's bank
+traffic scales with the compact width A, not the class count C. That
+property is easy to lose silently — one refactor that passes `memory.feats`
+(or a full-C slab) into the shared round loop and the fast path quietly
+becomes the dense path with extra steps. This grep-based check pins it:
+
+  * inside `_compact_em_update`, every mention of `memory.feats` must be a
+    subscripted gather (`memory.feats[`) — the bare array must not escape
+    into compute;
+  * `_em_rounds` (the shared dense/compact round loop) must not reference
+    `memory` at all: it may only see the slab-shaped arrays its caller
+    gathered.
+
+Run from anywhere:  python scripts/check_em_compact.py [repo_root]
+Exit 0 when clean, 1 with one finding per line otherwise. Wired into
+tier-1 via tests/test_em_compact.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+
+def _function_body(source: str, name: str) -> str:
+    """The source lines of top-level `def name(...)` up to the next
+    top-level statement (textual, matching the grep-based contract)."""
+    lines = source.splitlines()
+    out: List[str] = []
+    inside = False
+    for line in lines:
+        if re.match(rf"def {re.escape(name)}\b", line):
+            inside = True
+            out.append(line)
+            continue
+        if inside:
+            if line and not line[0].isspace() and not line.startswith(")"):
+                break
+            out.append(line)
+    return "\n".join(out)
+
+
+def findings(repo_root: str) -> List[str]:
+    path = os.path.join(repo_root, "mgproto_tpu", "core", "em.py")
+    with open(path) as f:
+        source = f.read()
+    found: List[str] = []
+
+    compact = _function_body(source, "_compact_em_update")
+    if not compact:
+        found.append("core/em.py: _compact_em_update not found")
+    else:
+        # bare bank references: every `memory.feats` must be a gather
+        # subscript (shape reads are metadata, not traffic)
+        bare = len(re.findall(r"memory\.feats(?!\[|\.shape)", compact))
+        gathered = len(re.findall(r"memory\.feats\[", compact))
+        if bare:
+            found.append(
+                f"core/em.py: _compact_em_update references the full bank "
+                f"`memory.feats` without a gather subscript ({bare}x) — the "
+                "compact path must only touch `memory.feats[idx]`"
+            )
+        if not gathered:
+            found.append(
+                "core/em.py: _compact_em_update never gathers "
+                "`memory.feats[...]` — compaction is not compacting"
+            )
+
+    rounds = _function_body(source, "_em_rounds")
+    if not rounds:
+        found.append("core/em.py: _em_rounds not found")
+    elif re.search(r"\bmemory\b", rounds):
+        found.append(
+            "core/em.py: _em_rounds references `memory` — the shared round "
+            "loop must only see slab-shaped arrays its caller gathered"
+        )
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = findings(root)
+    for f in found:
+        print(f)
+    if found:
+        return 1
+    print("check_em_compact: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
