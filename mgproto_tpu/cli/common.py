@@ -117,6 +117,16 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="train-loader workers: 'process' (spawn pool) scales "
                         "the augmentation math past the GIL on many-core "
                         "hosts")
+    p.add_argument("--device_augment", action="store_true", default=None,
+                   help="force the uint8 wire format + device augmentation "
+                        "tail on: the train loader ships u8 geometry-only "
+                        "samples (4x fewer bytes per hop) and flip + "
+                        "brightness/contrast/saturation jitter + normalize "
+                        "run inside the jitted step (default: auto — on "
+                        "for TPU, off elsewhere)")
+    p.add_argument("--no_device_augment", dest="device_augment",
+                   action="store_false",
+                   help="force the classic f32 host augmentation pipeline")
     p.add_argument("--prefetch-depth", "--prefetch_depth",
                    dest="prefetch_depth", type=int, default=2,
                    help="device-prefetch depth: batches held in flight so "
@@ -240,6 +250,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             num_workers=args.num_workers,
             worker_backend=args.worker_backend,
             prefetch_depth=args.prefetch_depth,
+            device_augment=args.device_augment,
         ),
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
         seed=args.seed,

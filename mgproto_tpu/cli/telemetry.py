@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional
 
 from mgproto_tpu.telemetry.registry import percentile_from_buckets
 from mgproto_tpu.telemetry.session import (
+    DATA_SHM_SLABS_GAUGE,
+    DATA_WAIT_GAUGE,
     EM_ACTIVE_GAUGE,
     EM_FALLBACK_COUNTER,
     HEALTH_FILE,
@@ -240,6 +242,21 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     if any(v is not None for v in em.values()):
         summary["em"] = em
 
+    # input pipeline (ISSUE 5 fast path): was the run input-bound, and did
+    # the shm batch assembly / u8 wire carry it (wire dtype is in meta)
+    data = {
+        DATA_WAIT_GAUGE: _series_value(last, DATA_WAIT_GAUGE),
+        DATA_SHM_SLABS_GAUGE: _series_value(last, DATA_SHM_SLABS_GAUGE),
+        "host_transfer_bytes_total": _series_value(
+            last, "host_transfer_bytes_total"
+        ),
+        "loader_sentinel_rows_total": _series_value(
+            last, "loader_sentinel_rows_total"
+        ),
+    }
+    if any(v is not None for v in data.values()):
+        summary["data"] = data
+
     meta_path = os.path.join(d, META_FILE)
     if os.path.isfile(meta_path):
         try:
@@ -343,6 +360,10 @@ def render_table(summary: Dict[str, Any]) -> str:
     if "em" in summary:
         section("em (compact dirty-class fast path)")
         for k, v in summary["em"].items():
+            rows.append((k, v))
+    if "data" in summary:
+        section("data (input pipeline)")
+        for k, v in summary["data"].items():
             rows.append((k, v))
     if "meta" in summary:
         section("meta")

@@ -67,8 +67,11 @@ from mgproto_tpu.utils.log import profiler_trace
 
 
 def _labeled(loader):
-    for images, labels, _ids in loader:
-        yield images, labels
+    """(images, labels, ids[, seeds]) loader stream -> (images, labels
+    [, seeds]) step batches: ids are host bookkeeping, the augmentation
+    seeds (u8 wire format) ride along to the device."""
+    for batch in loader:
+        yield (batch[0], batch[1]) + tuple(batch[3:])
 
 
 def _test(trainer, state, test_loader, ood_loaders, log, score_rule="sum"):
@@ -222,6 +225,10 @@ def run_training(
             "em_max_active_classes": trainer._em_cfg.max_active_classes,
             "remat": cfg.model.remat,
             "remat_stages": list(cfg.model.remat_stages),
+            # input fast path: u8 wire + device augmentation tail
+            "device_augment": trainer._device_augment,
+            "wire_dtype": "uint8" if trainer._device_augment else "float32",
+            "worker_backend": cfg.data.worker_backend,
         })
 
     # recovery wiring: preemption flag (signal handlers, if any, are
@@ -361,6 +368,10 @@ def run_training(
             chaos_mod.set_active(prev_chaos)
         if telem:
             telem.close()
+        # release loader resources deterministically (worker pools, shm
+        # slab ring) instead of leaving them to interpreter shutdown
+        for loader in (train_loader, push_loader, test_loader, *ood_loaders):
+            loader.close()
         metrics.close()
         log.close()
     return state, accu

@@ -175,6 +175,14 @@ class DataConfig:
     # costs one batch of HBM (~154 MB at flagship batch 256); >2 only helps
     # when the loader is bursty relative to the step time.
     prefetch_depth: int = 2
+    # uint8 wire format + device-side augmentation tail (ops/augment.py):
+    # the host train pipeline stops at geometry and ships uint8 (4x fewer
+    # bytes through worker IPC and the H2D copy); horizontal flip +
+    # brightness/contrast/saturation jitter + normalize run inside the
+    # jitted step, seeded per sample from the same (seed, epoch, index)
+    # streams. None = auto: ON for TPU backends, OFF elsewhere (parity with
+    # pre-existing f32 CPU runs). True/False force the path.
+    device_augment: Optional[bool] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,7 +27,10 @@ __all__ = [
 
 def build_pipelines(cfg):
     """The reference's four loaders from one DataConfig (main.py:96-163):
-    (train, push, test, [ood...]) — ood list may be empty.
+    (train, push, test, [ood...]) — ood list may be empty. With the uint8
+    wire format on (DataConfig.device_augment, auto on TPU) the train
+    loader yields (u8 images, labels, ids, augment seeds) 4-tuples; the
+    others keep their f32 3-tuples.
 
     Under multi-host (`jax.distributed`), every loader shards its dataset by
     process: each host loads a disjoint 1/num_processes of every global
@@ -36,23 +39,32 @@ def build_pipelines(cfg):
     import jax
 
     from mgproto_tpu.config import Config
+    from mgproto_tpu.ops.augment import resolve_device_augment
 
     assert isinstance(cfg, Config)
     shard = dict(
         shard_index=jax.process_index(), shard_count=jax.process_count()
     )
     d, img = cfg.data, cfg.model.img_size
+    # uint8 wire format: the train transform stops at geometry and returns
+    # u8; flip + b/c/s jitter + normalize run inside the jitted step,
+    # seeded per sample by the loader (with_seeds). Eval/push pipelines are
+    # deterministic resize-only and stay host-side f32.
+    device_augment = resolve_device_augment(d.device_augment)
+    wire_dtype = "uint8" if device_augment else "float32"
     # worker_backend applies to the TRAIN loader only: the augmentation
     # stack is the GIL-bound stage; push/test/ood are resize-only, and a
     # per-loader persistent spawn pool would sit idle on each of them
     train = DataLoader(
-        ImageFolder(d.train_dir, train_transform(img)),
+        ImageFolder(d.train_dir, train_transform(img, device_augment)),
         d.train_batch_size,
         shuffle=True,
         drop_last=True,
         num_workers=d.num_workers,
         worker_backend=d.worker_backend,
         seed=cfg.seed,
+        with_seeds=device_augment,
+        sample_spec=((img, img, 3), wire_dtype),
         **shard,
     )
     push = DataLoader(

@@ -409,14 +409,29 @@ Transform = Callable[[Image.Image, Optional[np.random.Generator]], np.ndarray]
 
 
 class TrainTransform:
-    """The reference's training augmentation stack (main.py:98-106)."""
+    """The reference's training augmentation stack (main.py:98-106).
 
-    def __init__(self, img_size: int):
+    `device_augment=True` is the host half of the uint8 wire format
+    (ops/augment.py): only the geometry ops that need PIL resampling —
+    perspective, affine, resized-crop — run here, and the output stays
+    uint8 [H, W, 3]. Flip + the whole color jitter (brightness/contrast/
+    saturation/hue) + normalize then run inside the jitted train step,
+    seeded per sample. The wire carries 4x fewer bytes at every hop
+    (worker -> parent IPC, host -> device copy), and the host sheds the
+    jitter math — including the HSV hue round trip, the profiled hot spot
+    of the whole stack at flagship sizes."""
+
+    def __init__(self, img_size: int, device_augment: bool = False):
         self.img_size = img_size
+        self.device_augment = device_augment
 
     def __call__(self, img: Image.Image, rng: np.random.Generator) -> np.ndarray:
         img = img.convert("RGB")
         img = random_perspective(img, rng)
+        if self.device_augment:
+            img = random_affine(img, rng)
+            img = random_resized_crop(img, rng, self.img_size)
+            return np.asarray(img.convert("RGB"), np.uint8)
         img = color_jitter(img, rng)
         img = random_horizontal_flip(img, rng)
         img = random_affine(img, rng)
@@ -456,8 +471,8 @@ class OodTransform:
         return _to_norm_f32(resize(img, (self.img_size, self.img_size)))
 
 
-def train_transform(img_size: int) -> Transform:
-    return TrainTransform(img_size)
+def train_transform(img_size: int, device_augment: bool = False) -> Transform:
+    return TrainTransform(img_size, device_augment=device_augment)
 
 
 def push_transform(img_size: int) -> Transform:

@@ -37,6 +37,7 @@ from mgproto_tpu.core.state import (
     make_joint_optimizer,
     make_warm_optimizer,
 )
+from mgproto_tpu.ops.augment import augment_tail, resolve_device_augment
 
 
 class TrainMetrics(NamedTuple):
@@ -80,6 +81,12 @@ class Trainer:
         # set by ShardedTrainer when the class axis is sharded: head_forward
         # then shard_maps the Pallas kernel over this mesh (core/mgproto.py)
         self._score_mesh = None
+        # uint8 wire format + device augmentation tail (ops/augment.py):
+        # flip + b/c/s jitter + normalize run inside the jitted step on the
+        # u8 batch, per-sample seeded. Resolved like fused_scoring (auto =
+        # TPU); a static python bool, so the traced program has no augment
+        # code at all when off.
+        self._device_augment = resolve_device_augment(cfg.data.device_augment)
         self.joint_tx = make_joint_optimizer(cfg, steps_per_epoch)
         self.warm_tx = make_warm_optimizer(cfg)
         self.proto_tx = make_mean_optimizer(cfg.em)
@@ -175,11 +182,17 @@ class Trainer:
         state: TrainState,
         images: jax.Array,
         labels: jax.Array,
+        seeds: jax.Array,
         use_mine: jax.Array,
         update_gmm: jax.Array,
         *,
         warm: bool = False,
     ) -> Tuple[TrainState, TrainMetrics]:
+        if self._device_augment:
+            # uint8 wire -> augmented normalized f32, fused by XLA into the
+            # trunk's first conv read (ops/augment.py). Upstream of the
+            # grads: images are inputs, not parameters.
+            images = augment_tail(images, seeds)
         grad_fn = jax.value_and_grad(self._loss_fn, has_aux=True)
         (loss, (new_stats, enq, ce, mine, aux, acc)), grads = grad_fn(
             state.params, state, images, labels, use_mine
@@ -269,12 +282,18 @@ class Trainer:
         return new_state, metrics
 
     def train_step(
-        self, state, images, labels, use_mine: bool, update_gmm: bool, warm: bool = False
+        self, state, images, labels, use_mine: bool, update_gmm: bool,
+        warm: bool = False, seeds=None,
     ) -> Tuple[TrainState, TrainMetrics]:
+        if seeds is None:
+            # no loader-shipped seeds (direct callers, tests): a zero
+            # stream — only consumed when device_augment is on
+            seeds = jnp.zeros((np.shape(images)[0],), jnp.uint32)
         return self._train_step(
             state,
             images,
             labels,
+            seeds,
             jnp.asarray(use_mine, jnp.float32),
             jnp.asarray(update_gmm, bool),
             warm=warm,
@@ -318,12 +337,17 @@ class Trainer:
         }
 
     def put_batch(self, batch):
-        """(images, labels) host arrays -> device arrays (async placement).
+        """(images, labels[, seeds]) host arrays -> device arrays (async
+        placement). uint8 images stay uint8 — the 4x-smaller wire format
+        crosses PCIe as-is and widens on device (ops/augment.py).
         ShardedTrainer overrides with the mesh-sharded multi-host variant."""
-        images, labels = batch
-        return jax.device_put((
-            np.asarray(images, np.float32), np.asarray(labels, np.int32)
-        ))
+        images = np.asarray(batch[0])
+        if images.dtype != np.uint8:
+            images = images.astype(np.float32, copy=False)
+        out = (images, np.asarray(batch[1], np.int32))
+        if len(batch) > 2:
+            out = out + (np.asarray(batch[2], np.uint32),)
+        return jax.device_put(out)
 
     def train_epoch(self, state, batches, epoch: int, monitor=None,
                     guard=None):
@@ -334,7 +358,9 @@ class Trainer:
         post-55.8%-MFU lever named in PERF.md.
 
         `monitor` (a telemetry StepMonitor) observes each step: wall time,
-        throughput, batch transfer bytes, recompile detection. Each interval
+        throughput, batch transfer bytes, loader wait (the blocking part of
+        the batch fetch, gauged as `loader_wait_fraction` of epoch wall
+        time), recompile detection. Each interval
         runs from the END of the previous step call to the end of this one,
         so loader/prefetch wait is charged to the step that waited — the
         intervals sum to true epoch wall time and an input-bound epoch shows
@@ -369,9 +395,19 @@ class Trainer:
         last = None
         em_max = fm_max = fb_sum = None
         t_prev = time.perf_counter()
-        for images, labels in device_prefetch(
+        prefetched = device_prefetch(
             batches, self.put_batch, depth=self.cfg.data.prefetch_depth
-        ):
+        )
+        while True:
+            # time the fetch separately: this is where an input-bound epoch
+            # blocks (loader decode/IPC; the H2D copy itself is async), and
+            # it feeds the `loader_wait_fraction` gauge
+            t_fetch = time.perf_counter()
+            batch = next(prefetched, None)
+            if batch is None:
+                break
+            wait_s = time.perf_counter() - t_fetch
+            images, labels = batch[0], batch[1]
             # already device-placed: train_step sees jax.Arrays and skips
             # its host-conversion path
             state, last = self.train_step(
@@ -381,13 +417,15 @@ class Trainer:
                 use_mine=flags["use_mine"],
                 update_gmm=flags["update_gmm"],
                 warm=flags["warm"],
+                seeds=batch[2] if len(batch) > 2 else None,
             )
             if monitor is not None:
                 now = time.perf_counter()
                 monitor.observe_step(
                     int(images.shape[0]),
                     now - t_prev,
-                    transfer_bytes=tree_transfer_bytes((images, labels)),
+                    transfer_bytes=tree_transfer_bytes(batch),
+                    wait_seconds=wait_s,
                 )
                 t_prev = now
             em_max = (

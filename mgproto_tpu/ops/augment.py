@@ -1,0 +1,179 @@
+"""Device-side augmentation tail: flip + color jitter + normalize in-step.
+
+The host input pipeline ships every 224² train sample as a ~602 KB float32
+array — pickled across the worker boundary, copied again into the batch,
+and pushed over PCIe — when the information content is a 150 KB uint8
+image. This module is the device half of the uint8 wire format (ISSUE 5,
+the tf.data/DALI split named in PAPERS.md): the host keeps samples uint8
+through decode → geometry → IPC → H2D, and the cheap per-pixel tail —
+horizontal flip, brightness/contrast/saturation/hue jitter, u8→f32
+normalize — runs HERE, inside the jitted train step, where XLA fuses it
+into the trunk's first conv. Geometry (perspective/affine/resized-crop)
+stays host-side on PIL (data/transforms.py
+TrainTransform(device_augment=True)).
+
+Determinism: every sample carries a uint32 seed derived by the loader from
+the SAME (seed, epoch, index) identity that seeds the host RNG streams
+(data/loader.py `augment_seeds`), so a batch's augmentation is reproducible
+regardless of worker scheduling, backend, or sharding — the per-sample
+draws are pure functions of the seed.
+
+Parity vs the host path (documented tolerance, pinned in
+tests/test_augment.py): each jitter op mirrors PIL's semantics in f32 —
+brightness `f·x`, contrast `deg + f·(x-deg)` with `deg` the rounded mean
+of the PIL luma, saturation `luma + f·(x-luma)`, hue the RGB→HSV→RGB
+round trip with the same uint8-quantized shift — but WITHOUT the uint8
+truncation PIL performs between chained ops, and in the fixed order
+brightness → contrast → saturation → hue rather than a random
+permutation. Each op therefore agrees with its host counterpart to a few
+u8 steps at equal factors; the factor distributions are identical, the
+draws come from a different (device threefry vs host PCG64) stream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mgproto_tpu.utils.images import IMAGENET_MEAN, IMAGENET_STD
+
+# ColorJitter ranges of the reference train stack (main.py:100)
+BRIGHTNESS: Tuple[float, float] = (0.6, 1.4)
+CONTRAST: Tuple[float, float] = (0.6, 1.4)
+SATURATION: Tuple[float, float] = (0.6, 1.4)
+HUE: Tuple[float, float] = (-0.02, 0.02)
+FLIP_P: float = 0.5
+
+# distinguishes the raw key data built from a loader seed from an actual
+# threefry hash (the seeds are already splitmix64-mixed by the loader)
+_KEY_TAG = np.uint32(0x6D675F61)  # "mg_a"
+
+
+def resolve_device_augment(flag: Optional[bool]) -> bool:
+    """None = auto: ON for TPU backends (where the u8 wire + fused tail
+    measured wins live), OFF elsewhere. True/False force the path."""
+    if flag is not None:
+        return bool(flag)
+    return jax.default_backend() == "tpu"
+
+
+def _luma(x: jax.Array) -> jax.Array:
+    """PIL convert("L") luminance in float: (19595 R + 38470 G + 7471 B)
+    / 65536 — same integer coefficients, no final rounding (≤1 u8 step)."""
+    return (
+        19595.0 * x[..., 0] + 38470.0 * x[..., 1] + 7471.0 * x[..., 2]
+    ) / 65536.0
+
+
+def adjust_brightness(x: jax.Array, factor: jax.Array) -> jax.Array:
+    """PIL ImageEnhance.Brightness in f32: blend toward black."""
+    return jnp.clip(factor * x, 0.0, 255.0)
+
+
+def adjust_contrast(x: jax.Array, factor: jax.Array) -> jax.Array:
+    """PIL ImageEnhance.Contrast in f32: blend toward the rounded mean
+    luma. `x` is [..., H, W, 3]; the mean is per image."""
+    deg = jnp.round(jnp.mean(_luma(x), axis=(-2, -1), keepdims=True))
+    deg = deg[..., None]  # broadcast over channels
+    return jnp.clip(deg + factor * (x - deg), 0.0, 255.0)
+
+
+def adjust_saturation(x: jax.Array, factor: jax.Array) -> jax.Array:
+    """PIL ImageEnhance.Color in f32: blend toward per-pixel luma."""
+    lum = _luma(x)[..., None]
+    return jnp.clip(lum + factor * (x - lum), 0.0, 255.0)
+
+
+def adjust_hue(x: jax.Array, factor: jax.Array) -> jax.Array:
+    """Hue shift by `factor` turns: the RGB→HSV→(H+shift)→RGB round trip
+    in continuous f32. The shift is quantized to the same uint8 step the
+    host path uses (trunc(f·255) mod 256), so device and host agree on the
+    shift itself; the host additionally quantizes H/S to uint8 mid-trip,
+    which this path doesn't — the residual is a few u8 steps on saturated
+    pixels (the documented tolerance). This was the profiled hot spot of
+    the whole host jitter stack at flagship sizes (~6.5 ms/sample at
+    500×375 even native); here it is a handful of fused elementwise ops."""
+    shift = jnp.mod(jnp.trunc(factor * 255.0), 256.0) / 255.0
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    c = mx - mn
+    safe_c = jnp.where(c == 0, 1.0, c)
+    h6 = jnp.where(
+        mx == r, jnp.mod((g - b) / safe_c, 6.0),
+        jnp.where(mx == g, (b - r) / safe_c + 2.0, (r - g) / safe_c + 4.0),
+    )
+    h = jnp.where(c == 0, 0.0, h6 / 6.0)
+    h = jnp.mod(h + shift, 1.0)
+    s = jnp.where(mx == 0, 0.0, c / jnp.where(mx == 0, 1.0, mx))
+    h6 = h * 6.0
+    i = jnp.floor(h6)
+    f = h6 - i
+    p = mx * (1.0 - s)
+    q = mx * (1.0 - s * f)
+    t = mx * (1.0 - s * (1.0 - f))
+    i = i.astype(jnp.int32) % 6
+    out = jnp.stack(
+        [
+            jnp.select([i == k for k in range(6)], [mx, q, p, p, t, mx]),
+            jnp.select([i == k for k in range(6)], [t, mx, mx, q, p, p]),
+            jnp.select([i == k for k in range(6)], [p, p, t, mx, mx, q]),
+        ],
+        axis=-1,
+    )
+    return jnp.where((c == 0)[..., None], x, out)
+
+
+def normalize_u8(x: jax.Array, mean=IMAGENET_MEAN, std=IMAGENET_STD) -> jax.Array:
+    """u8-domain values (0..255, any float/int dtype) -> normalized f32,
+    in the same scale/bias form as the host's native u8_to_f32_norm pass
+    (x·1/(255σ) − μ/σ), so unaugmented pixels agree to f32 rounding."""
+    scale = jnp.asarray(1.0 / (255.0 * np.asarray(std, np.float32)), jnp.float32)
+    bias = jnp.asarray(
+        -np.asarray(mean, np.float32) / np.asarray(std, np.float32), jnp.float32
+    )
+    return x.astype(jnp.float32) * scale + bias
+
+
+def _keys_from_seeds(seeds: jax.Array) -> jax.Array:
+    """[B] uint32 loader seeds -> [B, 2] raw threefry key data. The seeds
+    are already splitmix64-mixed host-side, so they are used as key words
+    directly (no second hash)."""
+    seeds = seeds.astype(jnp.uint32)
+    return jnp.stack([jnp.full_like(seeds, _KEY_TAG), seeds], axis=-1)
+
+
+def augment_tail(
+    images: jax.Array,
+    seeds: jax.Array,
+    brightness: Tuple[float, float] = BRIGHTNESS,
+    contrast: Tuple[float, float] = CONTRAST,
+    saturation: Tuple[float, float] = SATURATION,
+    hue: Tuple[float, float] = HUE,
+    flip_p: float = FLIP_P,
+    mean=IMAGENET_MEAN,
+    std=IMAGENET_STD,
+) -> jax.Array:
+    """[B, H, W, 3] uint8 wire batch + [B] uint32 seeds -> augmented,
+    normalized f32 batch. Pure; traced into the train step (every op is a
+    vectorized elementwise pass — XLA fuses the whole tail into the first
+    conv's input read, so it costs HBM bandwidth, not a kernel launch)."""
+    x = images.astype(jnp.float32)  # u8 wire (accepts f32 chaos batches)
+    keys = _keys_from_seeds(seeds)
+    sub = jax.vmap(lambda k: jax.random.split(k, 5))(keys)  # [B, 5, 2]
+
+    def draw(col: int, lo: float, hi: float) -> jax.Array:
+        return jax.vmap(
+            lambda k: jax.random.uniform(k, (), jnp.float32, lo, hi)
+        )(sub[:, col])[:, None, None, None]
+
+    x = adjust_brightness(x, draw(1, *brightness))
+    x = adjust_contrast(x, draw(2, *contrast))
+    x = adjust_saturation(x, draw(3, *saturation))
+    x = adjust_hue(x, draw(4, *hue)[..., 0])  # [B,1,1] broadcast over HW
+    flip = jax.vmap(lambda k: jax.random.bernoulli(k, flip_p))(sub[:, 0])
+    x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+    return normalize_u8(x, mean, std)

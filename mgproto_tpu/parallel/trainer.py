@@ -84,6 +84,13 @@ class ShardedTrainer(Trainer):
         self._repl = replicated(self.mesh)
         self._batch_sh = batch_sharding(self.mesh)
         self._state_sh = None  # built lazily from the first state seen
+        # placed zero-seed arrays by global batch size: with device_augment
+        # off the loader ships no seeds, and a host-side zeros array must
+        # NOT force an already-placed (prefetched) batch back through
+        # put_batch — under multi-host that would np.asarray a
+        # non-addressable global array; here the inert stream is placed
+        # once and reused
+        self._zero_seeds: dict = {}
         # With a sharded class axis, the fused Pallas kernel runs via
         # shard_map over this mesh (core/mgproto.py _fused_pool): each model
         # shard scores its local prototype slab, so the 1.9x kernel survives
@@ -112,8 +119,13 @@ class ShardedTrainer(Trainer):
         self._state_sh = state_sh
         # pjit forbids kwargs alongside in_shardings, so the static `warm`
         # flag becomes two compiled variants dispatched host-side (matching
-        # the two optimizer topologies, reference main.py:205-220)
-        in_sh = (state_sh, self._batch_sh, self._batch_sh, self._repl, self._repl)
+        # the two optimizer topologies, reference main.py:205-220). The
+        # batch triple (images, labels, seeds) all shard over 'data' — the
+        # u8 wire batch and its augmentation seeds travel together.
+        in_sh = (
+            state_sh, self._batch_sh, self._batch_sh, self._batch_sh,
+            self._repl, self._repl,
+        )
         out_sh = (state_sh, self._repl)
         jits = {
             w: jax.jit(
@@ -124,8 +136,10 @@ class ShardedTrainer(Trainer):
             )
             for w in (False, True)
         }
-        self._train_step = lambda state, images, labels, mine, gmm, warm=False: (
-            jits[bool(warm)](state, images, labels, mine, gmm)
+        self._train_step = (
+            lambda state, images, labels, seeds, mine, gmm, warm=False: (
+                jits[bool(warm)](state, images, labels, seeds, mine, gmm)
+            )
         )
         eval_out_sh = EvalOutput(
             logits=self._batch_sh, log_px=self._batch_sh, correct=self._batch_sh
@@ -150,15 +164,19 @@ class ShardedTrainer(Trainer):
         return self.prepare(super().init_state(rng, for_restore=for_restore))
 
     def put_batch(self, batch: Any) -> Any:
-        """Host batch -> data-sharded device arrays (multi-host aware).
-        Host-side dtype conversion happens here so device-prefetched batches
-        (engine/train.py train_epoch) arrive fully placed."""
-        images, labels = batch
+        """Host batch (images, labels[, seeds]) -> data-sharded device
+        arrays (multi-host aware). Host-side dtype conversion happens here
+        so device-prefetched batches (engine/train.py train_epoch) arrive
+        fully placed; uint8 images keep the 4x-smaller wire format."""
+        images = batch[0]
         if not isinstance(images, jax.Array):
-            images = np.asarray(images, np.float32)
-        if not isinstance(labels, jax.Array):
-            labels = np.asarray(labels, np.int32)
-        return put_batch((images, labels), self.mesh)
+            images = np.asarray(images)
+            if images.dtype != np.uint8:
+                images = images.astype(np.float32, copy=False)
+        out = [images]
+        for x, dt in zip(batch[1:], (np.int32, np.uint32)):
+            out.append(x if isinstance(x, jax.Array) else np.asarray(x, dt))
+        return put_batch(tuple(out), self.mesh)
 
     def _placed(self, x: Any) -> bool:
         """True iff `x` already carries THIS trainer's batch sharding (i.e.
@@ -169,6 +187,20 @@ class ShardedTrainer(Trainer):
         return isinstance(x, jax.Array) and x.sharding == self._batch_sh
 
     # ----------------------------------------------------------------- steps
+    def _zero_seed_stream(self, n_global: int) -> jax.Array:
+        """A placed, batch-sharded zeros seed array for a global batch of
+        `n_global` rows (cached per size — one placement, not one per
+        step). Only consumed when device_augment is on, which implies
+        loader-shipped seeds; this is the inert stream for direct callers."""
+        s = self._zero_seeds.get(n_global)
+        if s is None:
+            local = n_global // max(jax.process_count(), 1)
+            (s,) = put_batch(
+                (np.zeros((local,), np.uint32),), self.mesh
+            )
+            self._zero_seeds[n_global] = s
+        return s
+
     def train_step(
         self,
         state: TrainState,
@@ -177,12 +209,24 @@ class ShardedTrainer(Trainer):
         use_mine: bool,
         update_gmm: bool,
         warm: bool = False,
+        seeds=None,
     ) -> Tuple[TrainState, TrainMetrics]:
         if not (self._placed(images) and self._placed(labels)):
             # not batch-sharded yet: place now (prefetched batches skip this)
-            images, labels = self.put_batch((images, labels))
+            if seeds is None:
+                seeds = np.zeros((np.shape(images)[0],), np.uint32)
+            images, labels, seeds = self.put_batch((images, labels, seeds))
+        elif seeds is None:
+            # prefetched seedless batch (device_augment off): a cached
+            # placed zero stream — never un-place the prefetched operands
+            seeds = self._zero_seed_stream(int(images.shape[0]))
+        elif not self._placed(seeds):
+            (seeds,) = put_batch(
+                (np.asarray(seeds, np.uint32),), self.mesh
+            )
         return Trainer.train_step(
-            self, state, images, labels, use_mine, update_gmm, warm
+            self, state, images, labels, use_mine, update_gmm, warm,
+            seeds=seeds,
         )
 
     def eval_step(

@@ -143,7 +143,15 @@ class ChaosState:
 
     # ------------------------------------------------------------- NaN batch
     def corrupt_batch(self, global_step: int, images: np.ndarray):
-        """NaN-poison the batch for `nan_at_step` (once)."""
+        """NaN-poison the batch for `nan_at_step` (once).
+
+        The poisoned batch is always float32 — uint8 has no NaN, so under
+        the u8 wire format (DataConfig.device_augment) the drill's one
+        batch changes the step's input dtype and compiles a second step
+        variant. That is a property of the DRILL, not steady state: one
+        extra compile per injected NaN, identical numerics (the augment
+        tail consumes f32 transparently), and the divergence guard fires
+        exactly as on the f32 pipeline."""
         with self._lock:
             due = (
                 self.plan.nan_at_step is not None

@@ -114,14 +114,16 @@ class EpochGuard:
             return batches
 
         def _gen():
-            for i, (images, labels) in enumerate(batches):
+            for i, batch in enumerate(batches):
                 global_step = self._base_step + i
                 if self.chaos.preempt_due(global_step) and (
                     self.preemption is not None
                 ):
                     self.preemption.request("chaos preempt_at_step")
-                images = self.chaos.corrupt_batch(global_step, images)
-                yield images, labels
+                # batch is (images, labels[, seeds]) — corrupt the images,
+                # pass the rest through untouched
+                images = self.chaos.corrupt_batch(global_step, batch[0])
+                yield (images,) + tuple(batch[1:])
 
         return _gen()
 

@@ -16,7 +16,11 @@ Records into the metric registry, per observed step:
     its number of compiled variants, and any later growth is a genuine
     shape-driven retrace.
   * `host_transfer_bytes_total` counter — host->device bytes for the step's
-    operands (`tree_transfer_bytes` of the batch).
+    operands (`tree_transfer_bytes` of the batch; the uint8 wire format
+    shows up here as a ~4x drop).
+  * `loader_wait_fraction` gauge — cumulative fraction of epoch wall time
+    the step loop spent blocked fetching the next batch (an input-bound
+    epoch reads close to 1; a compute-bound one close to 0).
 
 Compile-time cost analysis (FLOPs / bytes accessed of an AOT-compiled step)
 can be attached via `record_cost_analysis` — bench.py uses it so its
@@ -110,6 +114,14 @@ class StepMonitor:
         self._c_transfer = r.counter(
             "host_transfer_bytes_total", "host->device bytes for step operands"
         )
+        self._epoch_wait = 0.0
+        self._g_wait_frac = r.gauge(
+            "loader_wait_fraction",
+            "fraction of epoch wall time the step loop spent blocked on "
+            "the input pipeline (batch fetch wait / step time, cumulative "
+            "over the epoch)",
+        )
+        self._g_wait_frac.set(0.0, phase=phase)
 
     # ------------------------------------------------------------- recompiles
     def watch(self, *targets: WatchTarget) -> "StepMonitor":
@@ -159,6 +171,7 @@ class StepMonitor:
         seconds: float,
         transfer_bytes: int = 0,
         check_recompiles: bool = True,
+        wait_seconds: float = 0.0,
     ) -> None:
         ph = self.phase
         self._h_step.observe(seconds, phase=ph)
@@ -176,6 +189,11 @@ class StepMonitor:
             self._c_transfer.inc(transfer_bytes, phase=ph)
         self._epoch_images += int(n_images)
         self._epoch_seconds += float(seconds)
+        self._epoch_wait += float(wait_seconds)
+        if self._epoch_seconds > 0:
+            self._g_wait_frac.set(
+                min(1.0, self._epoch_wait / self._epoch_seconds), phase=ph
+            )
         if check_recompiles:
             self.check_recompiles()
 
@@ -201,6 +219,7 @@ class StepMonitor:
     def begin_epoch(self) -> None:
         self._epoch_images = 0
         self._epoch_seconds = 0.0
+        self._epoch_wait = 0.0
 
     @property
     def epoch_images(self) -> int:
@@ -209,6 +228,10 @@ class StepMonitor:
     @property
     def epoch_seconds(self) -> float:
         return self._epoch_seconds
+
+    @property
+    def epoch_wait_seconds(self) -> float:
+        return self._epoch_wait
 
     # ---------------------------------------------------------- cost analysis
     def record_cost_analysis(self, compiled: Any) -> None:

@@ -43,6 +43,12 @@ META_FILE = "meta.json"
 EM_ACTIVE_GAUGE = "em_active_classes"
 EM_FALLBACK_COUNTER = "em_compact_fallback_total"
 
+# input-pipeline metrics (data/loader.py + StepMonitor): pre-registered so
+# summarize always shows the data story — a run that never waited on its
+# loader (or never used shm slabs) reports explicit zeros
+DATA_WAIT_GAUGE = "loader_wait_fraction"
+DATA_SHM_SLABS_GAUGE = "loader_shm_slabs_in_use"
+
 
 def _is_primary_host() -> bool:
     from mgproto_tpu.parallel.multihost import is_primary_host
@@ -108,6 +114,13 @@ class TelemetrySession:
             "fallback branch",
         )
         self._c_em_fallback.inc(0.0)
+        # input pipeline (loader_wait_fraction is also created by the
+        # StepMonitor above — this pins the shm-ring gauge, which only the
+        # loader's process backend would otherwise create)
+        self.registry.gauge(
+            DATA_SHM_SLABS_GAUGE,
+            "shared-memory batch slabs currently held by in-flight batches",
+        ).set(0.0)
 
     def observe_em(self, active_classes: float, compact_fallbacks: float = 0.0):
         """Record one epoch's EM fast-path outcome (host floats — callers

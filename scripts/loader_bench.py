@@ -1,10 +1,21 @@
-"""Input-pipeline micro-bench: sync vs thread vs process loader backends.
+"""Input-pipeline micro-bench: sync vs thread vs process loader backends,
+plus the u8-vs-f32 × pickle-vs-shm wire-format grid (ISSUE 5).
 
-Measures augmented images/sec through the REAL train pipeline (ImageFolder +
-train_transform + DataLoader) for each worker backend, on a generated
-synthetic image tree (VERDICT r3 item 5: the mechanism must exist and be
-measured before any pod run; the reference's num_workers=0 loader is its
-bottleneck-by-neglect, reference main.py:94).
+Default mode measures augmented images/sec through the REAL train pipeline
+(ImageFolder + train_transform + DataLoader) for each worker backend, on a
+generated synthetic image tree (VERDICT r3 item 5: the mechanism must exist
+and be measured before any pod run; the reference's num_workers=0 loader is
+its bottleneck-by-neglect, reference main.py:94).
+
+`--grid` measures the input fast path hermetically: the four cells of
+{f32 classic transform, u8 geometry-only (device-augment wire)} ×
+{per-sample pickle IPC (the pre-fast-path baseline), shared-memory slab
+ring} through the process backend, in img/s/core (throughput / workers,
+median of --grid_repeats runs). Sources are RAM-held encoded PNGs decoded
+per sample: real decode + augmentation work, but no file-open syscalls —
+on this sandbox's gVisor-style kernel a warm open() costs ~1-2 ms (vs
+~50 µs on a page-cached production host), a shared constant that would
+flatten exactly the comparison the grid exists to make.
 
 On a 1-vCPU sandbox thread/process parity with sync is EXPECTED — there is
 no parallelism to harvest and the process backend additionally pays IPC for
@@ -14,6 +25,8 @@ augmentation math (~5.8 ms/sample of PIL color-jitter/affine, measured in
 evidence/README.md). cpu_count is recorded so readers can interpret the run.
 
 Usage: python scripts/loader_bench.py [--out evidence/loader_bench.json]
+       python scripts/loader_bench.py --grid \\
+           [--out evidence/loader_bench_grid.json]
 Prints one JSON line; also writes it to --out when given.
 """
 
@@ -110,25 +123,168 @@ def measure(ds, batch, workers, backend, epochs=2):
         ds, batch, shuffle=True, drop_last=True,
         num_workers=workers, worker_backend=backend, seed=0,
     )
-    n = 0
-    # epoch 0 is a warmup for page cache + pool spin-up; time epoch 1+
-    for imgs, labels, ids in loader:
-        pass
-    t0 = time.perf_counter()
-    for _ in range(epochs):
+    try:
+        n = 0
+        # epoch 0 is a warmup for page cache + pool spin-up; time epoch 1+
         for imgs, labels, ids in loader:
-            n += imgs.shape[0]
-    return n / (time.perf_counter() - t0)
+            pass
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            for imgs, labels, ids in loader:
+                n += imgs.shape[0]
+        return n / (time.perf_counter() - t0)
+    finally:
+        loader.close()
+
+
+# ------------------------------------------------- u8/f32 x pickle/shm grid
+class BytesImageDataset:
+    """Encoded image bytes held in RAM, decoded per load — the hermetic
+    source for the grid (see module docstring: file-open syscall cost is a
+    sandbox artifact, not an input-pipeline property). Picklable, so the
+    spawn pool's initializer ships it to workers once."""
+
+    def __init__(self, blobs, transform):
+        self.blobs = blobs
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.blobs)
+
+    def load(self, index, rng):
+        import io
+
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(self.blobs[index])).convert("RGB")
+        return self.transform(img, rng), index % 4, index
+
+
+def _make_blobs(n: int, src: int = 96):
+    import io
+
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    blobs = []
+    for _ in range(n):
+        buf = io.BytesIO()
+        Image.fromarray(
+            (rng.rand(src, src, 3) * 255).astype(np.uint8)
+        ).save(buf, "PNG")
+        blobs.append(buf.getvalue())
+    return blobs
+
+
+def _measure_cell(ds, batch, workers, use_shm, with_seeds,
+                  warmup=2, epochs=3, prefetch=4):
+    from mgproto_tpu.data import DataLoader
+
+    loader = DataLoader(
+        ds, batch, shuffle=True, drop_last=True, num_workers=workers,
+        worker_backend="process", seed=0, use_shm=use_shm,
+        with_seeds=with_seeds, prefetch_batches=prefetch,
+    )
+    try:
+        for _ in range(warmup):  # pool spin-up + shm page faults
+            for b in loader:
+                pass
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            for b in loader:
+                n += b[0].shape[0]
+        return n / (time.perf_counter() - t0)
+    finally:
+        loader.close()
+
+
+def measure_grid(img_size: int, n_images: int, batch: int, workers: int,
+                 repeats: int = 3):
+    """The four wire-format cells, img/s/core (median of `repeats`).
+
+    f32 cells run the full classic host pipeline (color jitter + flip +
+    normalize on the host, f32 wire); u8 cells run the device-augment host
+    half (geometry only, uint8 wire + per-sample seeds). pickle cells use
+    the legacy per-sample result protocol the slab ring replaced; shm
+    cells use the ring (chunked tasks, rows written in place)."""
+    from mgproto_tpu.data import train_transform
+
+    blobs = _make_blobs(n_images)
+    cells = {}
+    for wire in ("f32", "u8"):
+        ds = BytesImageDataset(
+            blobs, train_transform(img_size, device_augment=(wire == "u8"))
+        )
+        for transport, use_shm in (("pickle", False), ("shm", None)):
+            rates = [
+                _measure_cell(
+                    ds, batch, workers, use_shm, with_seeds=(wire == "u8")
+                )
+                for _ in range(repeats)
+            ]
+            cells[f"{wire}_{transport}_imgs_per_sec_per_core"] = round(
+                float(np.median(rates)) / workers, 1
+            )
+    base = cells["f32_pickle_imgs_per_sec_per_core"]
+    fast = cells["u8_shm_imgs_per_sec_per_core"]
+    return {
+        "what": "u8-vs-f32 x pickle-vs-shm host input-pipeline grid",
+        "img_size": img_size,
+        "n_images": n_images,
+        "batch": batch,
+        "workers": workers,
+        "repeats": repeats,
+        "cpu_count": os.cpu_count(),
+        **cells,
+        "speedup_u8_shm_vs_f32_pickle": round(fast / max(base, 1e-9), 2),
+        "note": (
+            "img/s/core = loader throughput / workers, median of repeats; "
+            "sources are RAM-held encoded PNGs (decode+augment measured, "
+            "sandbox file-open syscall tax excluded — see module "
+            "docstring). f32+pickle is the pre-fast-path pipeline; u8+shm "
+            "is the ISSUE-5 wire format (geometry-only host half, jitter "
+            "on device) over the shared-memory slab ring"
+        ),
+    }
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="")
-    p.add_argument("--n_images", type=int, default=256)
-    p.add_argument("--img_size", type=int, default=64)
-    p.add_argument("--batch", type=int, default=16)
-    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--n_images", type=int, default=0,
+                   help="0 = mode default (256 classic, 384 grid)")
+    p.add_argument("--img_size", type=int, default=0,
+                   help="0 = mode default (64 classic, 224 grid)")
+    p.add_argument("--batch", type=int, default=0,
+                   help="0 = mode default (16 classic, 64 grid)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="0 = mode default (4 classic, min(2, cpus) grid)")
+    p.add_argument("--grid", action="store_true",
+                   help="measure the u8-vs-f32 x pickle-vs-shm wire-format "
+                        "grid (ISSUE 5) instead of the backend comparison")
+    p.add_argument("--grid_repeats", type=int, default=3)
     args = p.parse_args()
+
+    if args.grid:
+        result = measure_grid(
+            img_size=args.img_size or 224,
+            n_images=args.n_images or 384,
+            batch=args.batch or 64,
+            workers=args.workers or max(1, min(2, os.cpu_count() or 1)),
+            repeats=args.grid_repeats,
+        )
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return
+    args.n_images = args.n_images or 256
+    args.img_size = args.img_size or 64
+    args.batch = args.batch or 16
+    args.workers = args.workers or 4
 
     import shutil
     import tempfile

@@ -147,8 +147,8 @@ def main() -> None:
         host.randint(0, cfg.model.num_classes, size=(args.batch,)), jnp.int32
     )
     lowered = trainer._train_step.lower(
-        state, images, labels, jnp.asarray(1.0, jnp.float32),
-        jnp.asarray(True, bool), warm=False,
+        state, images, labels, jnp.zeros((args.batch,), jnp.uint32),
+        jnp.asarray(1.0, jnp.float32), jnp.asarray(True, bool), warm=False,
     )
     hlo = lowered.as_text()  # StableHLO: backend-neutral shapes
 

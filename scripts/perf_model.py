@@ -77,8 +77,9 @@ def main() -> None:
     # because for it MFU is a best-effort extra)
     train_flops = flops_from_cost_analysis(
         trainer._train_step.lower(
-            state, imgs, lbls, jnp.asarray(1.0, jnp.float32),
-            jnp.asarray(True, bool), warm=False,
+            state, imgs, lbls, jnp.zeros((batch,), jnp.uint32),
+            jnp.asarray(1.0, jnp.float32), jnp.asarray(True, bool),
+            warm=False,
         ).compile(),
         strict=True,
     )

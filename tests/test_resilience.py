@@ -280,6 +280,70 @@ def test_loader_pool_restart_recovers_hung_worker(registry, monkeypatch):
     assert registry.counter(res_metrics.WORKER_RESTARTS).value() == 1
 
 
+def test_loader_pool_restart_writes_recovered_rows_into_shm_slab(
+    registry, monkeypatch
+):
+    """Under shared-memory batch assembly a hung worker's CHUNK is
+    recovered in-parent, with the recovered images written into the slab
+    rows exactly where the worker would have put them — the batch is
+    identical to an incident-free run and the restart is counted once."""
+    import mgproto_tpu.data.loader as L
+
+    monkeypatch.setattr(L, "_RESULT_TIMEOUT_S", 3.0)
+    ds = _HangOutsideParent(n=8, shape=(4, 4, 3), hang_index=2)
+    dl = L.DataLoader(
+        ds, 4, num_workers=2, worker_backend="process", prefetch_batches=1,
+        seed=0, use_shm=True, sample_spec=((4, 4, 3), np.float32),
+    )
+    try:
+        batches = list(dl)
+    finally:
+        dl.close()
+    assert len(batches) == 2
+    for b, (imgs, labels, ids) in enumerate(batches):
+        np.testing.assert_array_equal(ids, [4 * b + j for j in range(4)])
+        for j in range(4):
+            np.testing.assert_array_equal(
+                imgs[j], np.full((4, 4, 3), float(4 * b + j), np.float32)
+            )
+    assert registry.counter(res_metrics.WORKER_RESTARTS).value() == 1
+
+
+def test_sentinel_probe_routes_through_retry_path(registry, monkeypatch):
+    """The sentinel-shape probe must use `_load_sample` (retry/chaos
+    aware), not a bare dataset.load(0): a TRANSIENT failure of sample 0
+    heals invisibly instead of crashing the substitution machinery."""
+    from mgproto_tpu.data.loader import DataLoader
+
+    _patch_fast_retries(monkeypatch)
+    dl = DataLoader(_FlakyDataset(fail_attempts={0: 2}), 8, num_workers=0,
+                    seed=3)
+    img, label, sid = dl._sentinel_row()
+    assert img.shape == (8, 8, 3) and img.dtype == np.float32
+    assert (img == 0).all() and label == -1 and sid == -1
+    assert registry.counter(res_metrics.RETRIES).value(scope="loader") == 2
+
+
+def test_sentinel_probe_falls_back_to_sample_spec(registry, monkeypatch):
+    """When even the probe fails (sample 0 permanently rotted), a
+    configured sample_spec still lets the loader synthesize sentinel rows;
+    without one the error is explicit, not a decode crash."""
+    from mgproto_tpu.data.loader import DataLoader
+
+    _patch_fast_retries(monkeypatch)
+    broken = _FlakyDataset(n=4, fail_attempts={i: 10_000 for i in range(4)})
+    dl = DataLoader(broken, 4, num_workers=0, seed=3,
+                    sample_spec=((8, 8, 3), "float32"))
+    (imgs, labels, ids), = list(dl)
+    assert imgs.shape == (4, 8, 8, 3) and (imgs == 0).all()
+    assert (labels == -1).all() and (ids == -1).all()
+
+    broken2 = _FlakyDataset(n=4, fail_attempts={i: 10_000 for i in range(4)})
+    dl2 = DataLoader(broken2, 4, num_workers=0, seed=3)
+    with pytest.raises(RuntimeError, match="sample_spec"):
+        list(dl2)
+
+
 # ------------------------------------------------------------------ preemption
 def test_preemption_handler_flag_and_reset():
     h = preemption.PreemptionHandler()

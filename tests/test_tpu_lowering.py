@@ -49,12 +49,13 @@ def test_bf16_fused_train_step_lowers_to_tpu():
     st = tr.init_state(jax.random.PRNGKey(0))
     imgs = jnp.zeros((4, 32, 32, 3), jnp.float32)
     lbls = jnp.zeros((4,), jnp.int32)
+    seeds = jnp.zeros((4,), jnp.uint32)  # augment-seed operand (ISSUE 5)
 
-    def step(state, images, labels):
+    def step(state, images, labels, seeds):
         return tr._step(
-            state, images, labels, jnp.float32(1.0), jnp.asarray(True),
-            warm=False,
+            state, images, labels, seeds, jnp.float32(1.0),
+            jnp.asarray(True), warm=False,
         )
 
-    exp = _export_tpu(step, st, imgs, lbls)
+    exp = _export_tpu(step, st, imgs, lbls, seeds)
     assert len(exp.mlir_module_serialized) > 0
