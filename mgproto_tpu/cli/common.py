@@ -143,6 +143,24 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no_fused_estep", dest="fused_estep",
                    action="store_false",
                    help="force the XLA E-step path")
+    p.add_argument("--async_bank", action="store_true", default=None,
+                   help="force the async bank pipeline on: memory enqueue "
+                        "+ EM run as their own program dispatched one step "
+                        "behind the trunk (scoring sees one-step-stale "
+                        "prototypes; bank buffers donated in place). "
+                        "Default: auto — on for TPU, off elsewhere")
+    p.add_argument("--no_async_bank", dest="async_bank",
+                   action="store_false",
+                   help="force the synchronous monolithic step")
+    p.add_argument("--auto_tune", action="store_true",
+                   help="HBM-budget auto-tuner (perf/planner.py): compile "
+                        "candidate (batch, remat, prefetch, augment, "
+                        "async_bank) plans, read XLA's memory analysis, and "
+                        "run the largest plan that fits the device HBM with "
+                        "margin (MGPROTO_HBM_MARGIN, default 0.08; budget "
+                        "override MGPROTO_HBM_BUDGET_BYTES). The chosen "
+                        "plan + every candidate's predicted peak land in "
+                        "telemetry meta.json")
     p.add_argument("--seed", type=int, default=0)
     # runtime
     p.add_argument("--distributed", action="store_true",
@@ -225,6 +243,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             reference_stepping=args.em_reference_stepping,
             max_active_classes=args.em_max_active,
             fused_estep=args.fused_estep,
+            async_bank=args.async_bank,
         ),
         optim=OptimConfig(),
         schedule=ScheduleConfig(

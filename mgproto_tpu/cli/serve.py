@@ -173,6 +173,10 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--calibrate", action="store_true",
                    help="live mode: derive calibration from the --test_dir "
                         "loader before serving")
+    # NB: add_train_args already contributes --auto_tune; here it sizes the
+    # warmup bucket set instead of the train plan (perf/planner.py
+    # plan_serve_buckets): over-budget buckets are dropped before warmup
+    # compiles them, and the outcome lands in telemetry meta when enabled.
     args = p.parse_args(argv)
 
     from mgproto_tpu.resilience import chaos as chaos_mod
@@ -191,6 +195,30 @@ def main(argv: Optional[list] = None) -> None:
 
     engine = build_engine(args, monitor=monitor)
     try:
+        if args.auto_tune:
+            from mgproto_tpu.perf.planner import plan_serve_buckets
+
+            fitting, outcome = plan_serve_buckets(engine)
+            print(json.dumps({
+                "autotune": True,
+                "buckets": list(fitting),
+                "rejected": outcome.rejected,
+                "budget_bytes": outcome.budget_bytes,
+            }))
+            if telem:
+                telem.observe_autotune(outcome)
+            if not fitting:
+                # fail CLOSED: warming the rejected set would execute the
+                # exact OOM the planner just predicted. Rerun without
+                # --auto_tune (or raise the budget) to override.
+                raise SystemExit(
+                    "auto_tune: no warmup bucket fits the HBM budget "
+                    f"({outcome.budget_bytes} bytes, margin "
+                    f"{outcome.margin}); refusing to warm an over-budget "
+                    "bucket set"
+                )
+            if tuple(fitting) != engine.buckets:
+                engine.buckets = tuple(fitting)
         compiled = engine.warmup()
         payloads, ids = _load_payloads(args)
         responses = engine.serve_all(payloads, request_ids=ids)

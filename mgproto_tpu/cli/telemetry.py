@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional
 
 from mgproto_tpu.telemetry.registry import percentile_from_buckets
 from mgproto_tpu.telemetry.session import (
+    AUTOTUNE_REJECTED_COUNTER,
+    BANK_OVERLAP_GAUGE,
     DATA_SHM_SLABS_GAUGE,
     DATA_WAIT_GAUGE,
     EM_ACTIVE_GAUGE,
@@ -233,11 +235,17 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
         "jit_cache_size": _series_value(last, "jit_cache_size"),
     }
 
-    # EM fast path (compact dirty-class slab, core/em.py): how wide EM ran
-    # and whether it ever overflowed the compact width into the dense branch
+    # EM fast path (compact dirty-class slab, core/em.py): how wide EM ran,
+    # whether it ever overflowed the compact width into the dense branch,
+    # how much of the epoch the async bank pipeline actually overlapped,
+    # and whether the auto-tuner rejected over-budget plans on the way in
     em = {
         EM_ACTIVE_GAUGE: _series_value(last, EM_ACTIVE_GAUGE),
         EM_FALLBACK_COUNTER: _series_value(last, EM_FALLBACK_COUNTER),
+        BANK_OVERLAP_GAUGE: _series_value(last, BANK_OVERLAP_GAUGE),
+        AUTOTUNE_REJECTED_COUNTER: _series_value(
+            last, AUTOTUNE_REJECTED_COUNTER
+        ),
     }
     if any(v is not None for v in em.values()):
         summary["em"] = em
@@ -322,6 +330,25 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     return summary
 
 
+def _fmt_gb(v: Any) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    return f"{v / 1e9:.2f}GB"
+
+
+def _fmt_autotune(v: Dict[str, Any]) -> str:
+    """One line for the meta table: the chosen plan, its predicted peak vs
+    the budget, and the rejection count (full record stays in --json)."""
+    plan = v.get("plan") or {}
+    return (
+        f"plan={plan.get('name', 'none')} "
+        f"peak={_fmt_gb(plan.get('peak_bytes'))} "
+        f"budget={_fmt_gb(v.get('budget_bytes'))} "
+        f"margin={v.get('margin')} "
+        f"rejected={v.get('rejected')}"
+    )
+
+
 def _fmt(v: Any) -> str:
     if v is None:
         return "-"
@@ -368,6 +395,8 @@ def render_table(summary: Dict[str, Any]) -> str:
     if "meta" in summary:
         section("meta")
         for k, v in sorted(summary["meta"].items()):
+            if k == "autotune" and isinstance(v, dict):
+                v = _fmt_autotune(v)
             rows.append((k, v))
     if "resilience" in summary:
         section("resilience (recovery events)")

@@ -101,6 +101,7 @@ def run_training(
     keep_last: int = 0,
     keep_best: int = 1,
     chaos=None,
+    auto_tune: bool = False,
 ):
     """Run the full schedule; returns (final_state, last_test_accuracy).
 
@@ -156,6 +157,56 @@ def run_training(
         log(note)
     metrics = MetricsWriter(os.path.join(cfg.model_dir, "metrics.jsonl"))
 
+    # HBM-budget auto-tuner (perf/planner.py): pick the run's (batch,
+    # remat, prefetch, augment, async_bank) from the compiled-module memory
+    # model BEFORE anything sizes itself off the config — the loaders and
+    # the trainer below both read the plan's batch size. On a device with
+    # no memory_stats (CPU) the v5e-class default budget applies, so the
+    # plan is still a deliberate choice, never a trial-and-error OOM.
+    autotune_outcome = None
+    autotune_plan_meta = None
+    if auto_tune:
+        from mgproto_tpu.perf.planner import (
+            PlanCandidate,
+            apply_plan,
+            autotune as run_autotune,
+        )
+
+        saved_plan = (
+            (load_metadata(resume_path) or {}).get("autotune_plan")
+            if resume_path else None
+        )
+        if saved_plan:
+            # a resumed run must NOT re-plan: the budget environment may
+            # have changed since the checkpoint, and a different batch
+            # would desync the mid-epoch `batch_in_epoch` skip count (the
+            # bit-exact-resume contract). Adopt the checkpointed plan
+            # verbatim — it is recorded in every checkpoint's metadata.
+            cand = PlanCandidate(
+                batch=max(
+                    int(saved_plan["batch"])
+                    // max(jax.process_count(), 1), 1,
+                ),
+                remat_stages=tuple(saved_plan.get("remat_stages", ())),
+                prefetch_depth=int(saved_plan.get("prefetch_depth", 0)),
+                device_augment=bool(saved_plan.get("device_augment", False)),
+                async_bank=bool(saved_plan.get("async_bank", False)),
+            )
+            cfg = apply_plan(cfg, cand)
+            autotune_plan_meta = saved_plan
+            log("autotune: resume adopts checkpointed plan "
+                f"{saved_plan.get('name', '?')} (no re-planning)")
+        else:
+            cfg, autotune_outcome = run_autotune(cfg, log=log)
+            if autotune_outcome.chosen is None:
+                log("autotune: NO candidate plan fits the budget; keeping "
+                    "the hand-set config (see telemetry meta for the "
+                    "rejections)")
+            else:
+                autotune_plan_meta = autotune_outcome.chosen.to_meta()
+                log("autotune: running "
+                    f"{autotune_outcome.chosen.candidate.name}")
+
     log(describe(cfg))
     train_loader, push_loader, test_loader, ood_loaders = build_pipelines(cfg)
     steps_per_epoch = len(train_loader)
@@ -206,6 +257,11 @@ def run_training(
         # switch EM math mid-training (trajectory change, no error)
         "em_reference_stepping": cfg.em.reference_stepping,
     }
+    if autotune_plan_meta is not None:
+        # every checkpoint carries the plan the run was sized with, so a
+        # `--resume auto --auto_tune` invocation adopts it instead of
+        # re-planning (see the autotune block above)
+        run_meta["autotune_plan"] = autotune_plan_meta
     push_ds = push_loader.dataset
     accu = 0.0
 
@@ -229,7 +285,13 @@ def run_training(
             "device_augment": trainer._device_augment,
             "wire_dtype": "uint8" if trainer._device_augment else "float32",
             "worker_backend": cfg.data.worker_backend,
+            # async bank pipeline (one-step-stale EM when on)
+            "async_bank": trainer.async_bank,
         })
+        if autotune_outcome is not None:
+            # chosen plan + per-candidate predicted peaks -> meta.json
+            # "autotune", rejections -> autotune_plan_rejected_total
+            telem.observe_autotune(autotune_outcome)
 
     # recovery wiring: preemption flag (signal handlers, if any, are
     # installed by main(); chaos raises the same flag), active chaos state,
@@ -534,6 +596,7 @@ def main(argv: Optional[list] = None) -> None:
         keep_last=args.keep_last,
         keep_best=args.keep_best,
         chaos=chaos_state,
+        auto_tune=args.auto_tune,
     )
     # a preempted run exits 0: the scheduler sees a clean shutdown and the
     # marker file + checkpoint make the next invocation resume bit-exactly

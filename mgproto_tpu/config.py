@@ -98,6 +98,16 @@ class EMConfig:
     # None = auto: ON for TPU backends, OFF elsewhere (the interpret-mode
     # fallback is correct but slow). True/False force the path.
     fused_estep: Optional[bool] = None
+    # Async bank pipeline (engine/train.py): split the train step into a
+    # trunk program (forward + losses + backward + optimizer) and a bank
+    # program (memory enqueue + EM), dispatching batch N's bank program
+    # concurrently with batch N+1's trunk — scoring then consumes ONE-STEP-
+    # STALE prototypes (deterministic, parity-pinned in
+    # tests/test_async_bank.py), and the bank/EM buffers are donated to the
+    # bank program so the [C, cap, d] bank never round-trips HBM as a copy.
+    # None = auto: ON for TPU backends (where the hidden bank phase is HBM
+    # time off the trunk's critical path), OFF elsewhere. True/False force.
+    async_bank: Optional[bool] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -56,7 +56,7 @@ import jax.numpy as jnp
 import optax
 
 from mgproto_tpu.config import EMConfig
-from mgproto_tpu.core.memory import Memory, clear_updated
+from mgproto_tpu.core.memory import Memory, clear_updated, memory_push
 from mgproto_tpu.core.mgproto import GMMState
 from mgproto_tpu.ops.em_kernels import em_estep_stats
 from mgproto_tpu.ops.gaussian import (
@@ -66,6 +66,73 @@ from mgproto_tpu.ops.gaussian import (
     pairwise_sq_dists,
     precompute_diag_gaussian,
 )
+
+
+class BankAux(NamedTuple):
+    """Scalars the bank phase reports back to the step metrics."""
+
+    num_active: jax.Array  # classes EM touched this call (0 when gated off)
+    # dense-fallback flag forwarded from EMAux (telemetry counter)
+    compact_fallback: jax.Array
+
+
+def bank_update(
+    gmm: GMMState,
+    memory: Memory,
+    opt_state: optax.OptState,
+    mean_tx: optax.GradientTransformation,
+    cfg: EMConfig,
+    feats: jax.Array,
+    classes: jax.Array,
+    valid: jax.Array,
+    step: jax.Array,
+    update_gmm: jax.Array,
+    finite: jax.Array,
+    mesh=None,
+) -> Tuple[GMMState, Memory, optax.OptState, BankAux]:
+    """The BANK PHASE of one train step: memory enqueue + gated EM.
+
+    This is the ONE definition of the phase, shared by the monolithic train
+    step and the standalone async bank program (engine/train.py) so the two
+    cannot drift: under `--async_bank` the same function is compiled as its
+    own program and dispatched one step behind the trunk.
+
+    Gating (reference train_and_test.py:61-63 + the divergence guard):
+      * `finite` (the trunk's loss/grad finiteness) freezes BOTH the enqueue
+        and EM — a poisoned batch must not touch the bank;
+      * EM additionally requires the epoch flag `update_gmm`, the step
+        interval phase (`step` is the PRE-increment counter of the batch the
+        candidates came from — under the async pipeline that is the
+        *previous* batch's counter, keeping the interval phase identical to
+        the synchronous schedule), and a non-empty bank.
+
+    All gates are traced scalars under lax.cond: one compiled program,
+    zero steady-state recompiles.
+    """
+    mem = jax.lax.cond(
+        finite,
+        lambda m: memory_push(m, feats, classes, valid),
+        lambda m: m,
+        memory,
+    )
+    interval_ok = (step % cfg.update_interval) == 0
+    do_em = update_gmm & interval_ok & (jnp.sum(mem.length) > 0) & finite
+
+    def run_em(args):
+        g, m, o = args
+        g, m, o, aux_em = em_update(g, m, o, mean_tx, cfg, mesh=mesh)
+        return g, m, o, aux_em.num_active, aux_em.compact_fallback
+
+    def skip_em(args):
+        g, m, o = args
+        return g, m, o, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)
+
+    gmm, mem, opt_state, num_active, fallback = jax.lax.cond(
+        do_em, run_em, skip_em, (gmm, mem, opt_state)
+    )
+    return gmm, mem, opt_state, BankAux(
+        num_active=num_active, compact_fallback=fallback
+    )
 
 
 class EMAux(NamedTuple):

@@ -136,6 +136,18 @@ def memory_pull_all(mem: Memory) -> Tuple[jax.Array, jax.Array]:
     return mem.feats, mask
 
 
+def memory_nbytes(num_classes: int, capacity: int, dim: int) -> int:
+    """Device bytes one Memory pytree occupies (f32 feats + the int32/bool
+    per-class bookkeeping). The HBM-budget planner (perf/planner.py
+    measure_candidate) reports this as the analytic cross-check next to
+    XLA's measured peak — under bank-buffer donation (engine/train.py
+    async pipeline) exactly one generation is live, which is the
+    copy-traffic saving the donation exists for."""
+    feats = num_classes * capacity * dim * 4
+    per_class = num_classes * (4 + 4 + 1)  # length + cursor + updated
+    return feats + per_class
+
+
 def clear_updated(mem: Memory) -> Memory:
     """Reset the per-class updated flags after an EM pass
     (reference model.py:287)."""

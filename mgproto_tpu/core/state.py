@@ -5,7 +5,7 @@ steps."""
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import flax.struct
 import jax
@@ -29,6 +29,63 @@ class TrainState:
     opt_state: Any  # joint optimizer state
     warm_opt_state: Any  # warm-phase optimizer state (separate Adam, main.py:215-220)
     proto_opt_state: Any  # EM mean-optimizer state
+
+
+class TrunkState(NamedTuple):
+    """The trunk program's slice of TrainState: everything the forward +
+    losses + backward + optimizer phase mutates. Donated as a unit by the
+    async bank pipeline's trunk program (engine/train.py)."""
+
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    warm_opt_state: Any
+
+
+class BankState(NamedTuple):
+    """The bank program's slice of TrainState: the memory bank, the GMM head
+    it trains, and the EM mean-optimizer state. Donated as a unit by the
+    async bank program so the [C, cap, d] bank is updated in place instead
+    of round-tripping HBM as a copy every step."""
+
+    gmm: GMMState
+    memory: Memory
+    proto_opt_state: Any
+
+
+def split_state(state: "TrainState") -> Tuple[TrunkState, BankState]:
+    """TrainState -> (trunk slice, bank slice). Works on any TrainState-
+    shaped pytree — including the NamedSharding tree `state_shardings`
+    builds, which is how the sharded trunk/bank jits get their specs."""
+    return (
+        TrunkState(
+            step=state.step,
+            params=state.params,
+            batch_stats=state.batch_stats,
+            opt_state=state.opt_state,
+            warm_opt_state=state.warm_opt_state,
+        ),
+        BankState(
+            gmm=state.gmm,
+            memory=state.memory,
+            proto_opt_state=state.proto_opt_state,
+        ),
+    )
+
+
+def merge_state(trunk: TrunkState, bank: BankState) -> TrainState:
+    """Inverse of `split_state`."""
+    return TrainState(
+        step=trunk.step,
+        params=trunk.params,
+        batch_stats=trunk.batch_stats,
+        gmm=bank.gmm,
+        memory=bank.memory,
+        opt_state=trunk.opt_state,
+        warm_opt_state=trunk.warm_opt_state,
+        proto_opt_state=bank.proto_opt_state,
+    )
 
 
 def torch_adam(

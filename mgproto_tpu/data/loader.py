@@ -824,9 +824,19 @@ def device_prefetch(batches, put_fn, depth: int = 2):
     post-55.8%-MFU lever. depth=2 costs one extra batch of HBM
     (~154 MB at flagship batch 256 f32 wire — a quarter of that with the
     uint8 wire format).
+
+    depth <= 0 DISABLES prefetch cleanly: each batch is placed with
+    `put_fn` only when the consumer asks for it and yielded immediately —
+    no queue, no batch ever held in flight, no prefetch HBM headroom (the
+    `--prefetch-depth 0` operating point the HBM planner can select on a
+    tight budget).
     """
     import collections
 
+    if depth <= 0:
+        for batch in batches:
+            yield put_fn(batch)
+        return
     q = collections.deque()
     for batch in batches:
         q.append(put_fn(batch))

@@ -11,10 +11,26 @@ Dynamic gates (`use_mine`, `update_gmm`) are traced scalars, not python
 bools — flipping them mid-training does not retrigger compilation. The
 warm/joint phase IS a static switch (two optimizers with different
 topologies, reference main.py:205-220), giving two compiled variants.
+
+Async bank pipeline (`EMConfig.async_bank`, PERF.md lever 6): the step is
+internally two phases with no backward data dependence between them —
+a TRUNK (forward + losses + backward + optimizer) and a BANK (memory
+enqueue + EM). Batch N's bank output is only *read* by batch N+1's trunk
+(scoring against the updated prototypes), so the pipeline may legally run
+one step behind: with the flag on, the bank program for batch N is
+dispatched right AFTER batch N+1's trunk, scoring consumes ONE-STEP-STALE
+prototypes (deterministic — parity-pinned against a hand-rolled oracle in
+tests/test_async_bank.py), and the bank/EM buffers are donated to the bank
+program so the [C, cap, d] bank is updated in place instead of copied
+through HBM every step. Flag off compiles both phases into the one
+monolithic program (`_step`) — same ops, same order, bit-exact to the
+pre-pipeline step. Both phases share single definitions (`_trunk_step`,
+`core.em.bank_update`) so the two modes cannot drift.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -24,20 +40,35 @@ import optax
 
 from mgproto_tpu.config import Config
 from mgproto_tpu.core import losses as L
-from mgproto_tpu.core.em import em_update, make_mean_optimizer, resolve_em_config
-from mgproto_tpu.core.memory import memory_push
+from mgproto_tpu.core.em import bank_update, make_mean_optimizer, resolve_em_config
 from mgproto_tpu.core.mgproto import (
     MGProtoFeatures,
     head_forward,
     log_px,
 )
 from mgproto_tpu.core.state import (
+    BankState,
     TrainState,
+    TrunkState,
     create_train_state,
     make_joint_optimizer,
     make_warm_optimizer,
+    merge_state,
+    split_state,
 )
 from mgproto_tpu.ops.augment import augment_tail, resolve_device_augment
+
+
+def resolve_async_bank(flag: Optional[bool]) -> bool:
+    """Resolve `EMConfig.async_bank` (None = auto, like fused_scoring): the
+    pipeline only pays off where the bank phase is real device time on the
+    step's critical path — TPU. The ONE definition of the auto rule —
+    Trainer and the HBM planner's candidate builder (perf/planner.py) both
+    use it, so the planner can never measure a different mode than the run
+    executes. Explicit True/False always honored (tests force ON on CPU)."""
+    if flag is not None:
+        return bool(flag)
+    return jax.default_backend() == "tpu"
 
 
 class TrainMetrics(NamedTuple):
@@ -52,6 +83,33 @@ class TrainMetrics(NamedTuple):
     # fallback (core/em.py; per-step 0/1, epoch SUM after train_epoch)
     em_compact_fallback: jax.Array
     nonfinite: jax.Array  # bool: this step's update was SKIPPED (bad loss/grads)
+
+
+class TrunkOut(NamedTuple):
+    """Everything the trunk program hands the bank phase + step metrics.
+    The enqueue candidates and gates cross the program boundary as OUTPUTS
+    (fresh buffers): under the async pipeline the host holds them for one
+    step, and they must stay valid after the trunk's donated inputs die."""
+
+    enq_feats: jax.Array  # [B*K, d] memory-enqueue candidates
+    enq_classes: jax.Array  # [B*K] int32
+    enq_valid: jax.Array  # [B*K] bool
+    step0: jax.Array  # the PRE-increment step counter (EM interval phase)
+    finite: jax.Array  # bool: loss/grads finite (divergence guard gate)
+    loss: jax.Array
+    cross_entropy: jax.Array
+    mine: jax.Array
+    aux: jax.Array
+    accuracy: jax.Array
+
+
+class BankStepOut(NamedTuple):
+    """Bank-program scalars folded into TrainMetrics (one step late under
+    the async pipeline)."""
+
+    num_active: jax.Array  # classes EM touched
+    compact_fallback: jax.Array  # 0/1: dense lax.cond fallback taken
+    full_mem_ratio: jax.Array  # fraction of classes with a full queue
 
 
 class EvalOutput(NamedTuple):
@@ -109,11 +167,38 @@ class Trainer:
             static_argnames=("warm",),
             donate_argnums=(0,) if donate else (),
         )
+        # async bank pipeline (module docstring): a static python bool —
+        # OFF never touches the pipeline code paths at all
+        self._async_bank = resolve_async_bank(cfg.em.async_bank)
+        # the split programs. Compiled lazily on first use, so a sync run
+        # never pays for them; the bank program donates the bank/EM buffers
+        # under the same `donate` contract as the monolithic state donation
+        # above — the [C, cap, d] bank is then updated in place.
+        self._trunk_jit = jax.jit(
+            self._trunk_step,
+            static_argnames=("warm",),
+            donate_argnums=(0,) if donate else (),
+        )
+        self._bank_jit = jax.jit(
+            self._bank_step, donate_argnums=(0,) if donate else ()
+        )
+        # pipeline registers (async mode only): the held enqueue candidates
+        # of the newest trunk (dispatched as a bank program one step later),
+        # and the per-step host-side overlap window behind telemetry's
+        # `bank_dispatch_overlap_fraction` gauge (StepMonitor accumulates
+        # the epoch fraction — the one owner of that metric)
+        self._held_enq = None
+        self._bank_dispatch_t: Optional[float] = None
+        self._bank_overlap_step_s = 0.0
+        self._zero_bank_out = None
         self._eval_step = jax.jit(self._eval)
         # the live jit callables, for telemetry's recompile detection
         # (StepMonitor reads their _cache_size deltas). ShardedTrainer
         # rebinds this when it builds its sharded jits.
-        self._jit_handles = [self._train_step, self._eval_step]
+        self._jit_handles = [
+            self._train_step, self._trunk_jit, self._bank_jit,
+            self._eval_step,
+        ]
 
     @property
     def jit_handles(self):
@@ -125,6 +210,11 @@ class Trainer:
         if fused is not None:
             return fused
         return jax.default_backend() == "tpu"
+
+    @property
+    def async_bank(self) -> bool:
+        """Resolved async-bank mode (telemetry meta records this)."""
+        return self._async_bank
 
     def init_state(self, rng: jax.Array, for_restore: bool = False) -> TrainState:
         """`for_restore=True` builds a restore TARGET: skips the pretrained
@@ -156,13 +246,13 @@ class Trainer:
         return (proto_map, embed), batch_stats
 
     def _loss_fn(
-        self, params, state: TrainState, images, labels, use_mine: jax.Array
+        self, params, batch_stats, gmm, images, labels, use_mine: jax.Array
     ):
         (proto_map, embed), new_stats = self._apply(
-            params, state.batch_stats, images, train=True
+            params, batch_stats, images, train=True
         )
         logits, pooled, enq = head_forward(
-            proto_map, state.gmm, labels, self.cfg.model.mine_T,
+            proto_map, gmm, labels, self.cfg.model.mine_T,
             fused=self._fused, mesh=self._score_mesh,
         )
         ce = L.cross_entropy(logits[..., 0], labels)
@@ -177,6 +267,116 @@ class Trainer:
         acc = jnp.mean(jnp.argmax(logits[..., 0], -1) == labels)
         return loss, (new_stats, enq, ce, mine, aux, acc)
 
+    def _trunk_step(
+        self,
+        trunk: TrunkState,
+        gmm,
+        images: jax.Array,
+        labels: jax.Array,
+        seeds: jax.Array,
+        use_mine: jax.Array,
+        *,
+        warm: bool = False,
+    ) -> Tuple[TrunkState, TrunkOut]:
+        """TRUNK program: forward + losses + backward + optimizer. Scores
+        against `gmm` but never mutates it; the enqueue candidates and the
+        gates the bank phase needs come back as outputs. The monolithic step
+        inlines this; the async pipeline compiles it standalone (donating
+        `trunk`, NOT `gmm` — the held bank program still owns that)."""
+        if self._device_augment:
+            # uint8 wire -> augmented normalized f32, fused by XLA into the
+            # trunk's first conv read (ops/augment.py). Upstream of the
+            # grads: images are inputs, not parameters.
+            images = augment_tail(images, seeds)
+        grad_fn = jax.value_and_grad(self._loss_fn, has_aux=True)
+        (loss, (new_stats, enq, ce, mine, aux, acc)), grads = grad_fn(
+            trunk.params, trunk.batch_stats, gmm, images, labels, use_mine
+        )
+
+        # divergence guard: a non-finite loss or gradient freezes EVERY state
+        # mutation this step — params, optimizer moments, BatchNorm running
+        # stats (already poisoned by the forward on a NaN batch), and via the
+        # exported `finite` gate the memory enqueue and EM too. lax.cond
+        # keeps the step pure (no host callback) and skips the update compute
+        # at runtime; the host-side policy (resilience.guard.EpochGuard)
+        # reads the `nonfinite` metric and rolls back after K consecutive
+        # bad steps.
+        finite = jnp.isfinite(loss)
+        for g in jax.tree_util.tree_leaves(grads):
+            # NaN/Inf propagate through the sum: one scalar check per leaf
+            finite = finite & jnp.isfinite(jnp.sum(g))
+
+        tx = self.warm_tx if warm else self.joint_tx
+        opt_state0 = trunk.warm_opt_state if warm else trunk.opt_state
+
+        def _apply(_):
+            updates, new_opt = tx.update(grads, opt_state0, trunk.params)
+            new_params = optax.apply_updates(trunk.params, updates)
+            return new_params, new_opt, new_stats
+
+        def _skip(_):
+            return trunk.params, opt_state0, trunk.batch_stats
+
+        params, opt_state, batch_stats = jax.lax.cond(
+            finite, _apply, _skip, None
+        )
+        new_trunk = TrunkState(
+            # step counts ATTEMPTS (a skipped step still advances it, so the
+            # host's global-step bookkeeping and the EM interval phase never
+            # depend on how many steps diverged)
+            step=trunk.step + 1,
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=trunk.opt_state if warm else opt_state,
+            warm_opt_state=opt_state if warm else trunk.warm_opt_state,
+        )
+        return new_trunk, TrunkOut(
+            enq_feats=enq[0],
+            enq_classes=enq[1],
+            enq_valid=enq[2],
+            step0=trunk.step,
+            finite=finite,
+            loss=loss,
+            cross_entropy=ce,
+            mine=mine,
+            aux=aux,
+            accuracy=acc,
+        )
+
+    def _bank_step(
+        self,
+        bank: BankState,
+        feats: jax.Array,
+        classes: jax.Array,
+        valid: jax.Array,
+        step0: jax.Array,
+        update_gmm: jax.Array,
+        finite: jax.Array,
+    ) -> Tuple[BankState, BankStepOut]:
+        """BANK program: memory enqueue + gated EM (the one shared
+        definition, core.em.bank_update). Compiled standalone for the async
+        pipeline with `bank` donated: gmm/memory/EM-moment buffers are
+        updated in place. The score mesh doubles as the EM mesh — both mark
+        the class axis sharded (compaction off, fused E-step shard_mapped),
+        and the EM sufficient statistics stay correct under one-step
+        staleness because the collective pattern is unchanged: every shard
+        runs the SAME (stale) schedule, so the psum'd statistics of a given
+        bank generation are the sync step's statistics, one step late."""
+        gmm, memory, popt, baux = bank_update(
+            bank.gmm, bank.memory, bank.proto_opt_state,
+            self.proto_tx, self._em_cfg,
+            feats, classes, valid, step0, update_gmm, finite,
+            mesh=self._score_mesh,
+        )
+        out = BankStepOut(
+            num_active=baux.num_active,
+            compact_fallback=baux.compact_fallback,
+            full_mem_ratio=jnp.mean(
+                (memory.length == memory.capacity).astype(jnp.float32)
+            ),
+        )
+        return BankState(gmm=gmm, memory=memory, proto_opt_state=popt), out
+
     def _step(
         self,
         state: TrainState,
@@ -188,98 +388,128 @@ class Trainer:
         *,
         warm: bool = False,
     ) -> Tuple[TrainState, TrainMetrics]:
-        if self._device_augment:
-            # uint8 wire -> augmented normalized f32, fused by XLA into the
-            # trunk's first conv read (ops/augment.py). Upstream of the
-            # grads: images are inputs, not parameters.
-            images = augment_tail(images, seeds)
-        grad_fn = jax.value_and_grad(self._loss_fn, has_aux=True)
-        (loss, (new_stats, enq, ce, mine, aux, acc)), grads = grad_fn(
-            state.params, state, images, labels, use_mine
+        """The monolithic (sync) step: trunk + bank phases in ONE compiled
+        program — `--async_bank` off. Same phase definitions as the
+        pipelined mode, fused by XLA exactly as before the split."""
+        trunk0, bank0 = split_state(state)
+        new_trunk, out = self._trunk_step(
+            trunk0, bank0.gmm, images, labels, seeds, use_mine, warm=warm
         )
-
-        # divergence guard: a non-finite loss or gradient freezes EVERY state
-        # mutation this step — params, optimizer moments, BatchNorm running
-        # stats (already poisoned by the forward on a NaN batch), memory
-        # enqueue and EM. lax.cond keeps the step pure (no host callback) and
-        # skips the update compute at runtime; the host-side policy
-        # (resilience.guard.EpochGuard) reads the `nonfinite` metric and
-        # rolls back after K consecutive bad steps.
-        finite = jnp.isfinite(loss)
-        for g in jax.tree_util.tree_leaves(grads):
-            # NaN/Inf propagate through the sum: one scalar check per leaf
-            finite = finite & jnp.isfinite(jnp.sum(g))
-
-        tx = self.warm_tx if warm else self.joint_tx
-        opt_state0 = state.warm_opt_state if warm else state.opt_state
-
-        def _apply(_):
-            updates, new_opt = tx.update(grads, opt_state0, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            # memory enqueue (reference model.py:228-252, inside forward)
-            new_memory = memory_push(state.memory, *enq)
-            return new_params, new_opt, new_stats, new_memory
-
-        def _skip(_):
-            return state.params, opt_state0, state.batch_stats, state.memory
-
-        params, opt_state, batch_stats, memory = jax.lax.cond(
-            finite, _apply, _skip, None
-        )
-
-        # EM gate (reference train_and_test.py:61-63): epoch-level flag AND
-        # anything in memory AND step % interval == 0 (AND a finite step)
-        interval_ok = (state.step % self.cfg.em.update_interval) == 0
-        do_em = update_gmm & interval_ok & (jnp.sum(memory.length) > 0) & finite
-
-        def run_em(args):
-            gmm, mem, popt = args
-            # the score mesh doubles as the EM mesh: both mark the class
-            # axis sharded (compaction off, fused E-step shard_mapped)
-            gmm, mem, popt, aux_em = em_update(
-                gmm, mem, popt, self.proto_tx, self._em_cfg,
-                mesh=self._score_mesh,
-            )
-            return gmm, mem, popt, aux_em.num_active, aux_em.compact_fallback
-
-        def skip_em(args):
-            gmm, mem, popt = args
-            return (
-                gmm, mem, popt,
-                jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
-            )
-
-        gmm, memory, proto_opt_state, em_active, em_fallback = jax.lax.cond(
-            do_em, run_em, skip_em, (state.gmm, memory, state.proto_opt_state)
-        )
-
-        new_state = state.replace(
-            # step counts ATTEMPTS (a skipped step still advances it, so the
-            # host's global-step bookkeeping and the EM interval phase never
-            # depend on how many steps diverged)
-            step=state.step + 1,
-            params=params,
-            batch_stats=batch_stats,
-            gmm=gmm,
-            memory=memory,
-            opt_state=state.opt_state if warm else opt_state,
-            warm_opt_state=opt_state if warm else state.warm_opt_state,
-            proto_opt_state=proto_opt_state,
+        new_bank, bank_out = self._bank_step(
+            bank0, out.enq_feats, out.enq_classes, out.enq_valid,
+            out.step0, update_gmm, out.finite,
         )
         metrics = TrainMetrics(
-            loss=loss,
-            cross_entropy=ce,
-            mine=mine,
-            aux=aux,
-            accuracy=acc,
-            full_mem_ratio=jnp.mean(
-                (memory.length == memory.capacity).astype(jnp.float32)
-            ),
-            em_active=em_active,
-            em_compact_fallback=em_fallback,
-            nonfinite=~finite,
+            loss=out.loss,
+            cross_entropy=out.cross_entropy,
+            mine=out.mine,
+            aux=out.aux,
+            accuracy=out.accuracy,
+            full_mem_ratio=bank_out.full_mem_ratio,
+            em_active=bank_out.num_active,
+            em_compact_fallback=bank_out.compact_fallback,
+            nonfinite=~out.finite,
         )
-        return new_state, metrics
+        return merge_state(new_trunk, new_bank), metrics
+
+    # ------------------------------------------------- async bank pipeline
+    def _zero_bank_metrics(self) -> BankStepOut:
+        """Placeholder bank metrics for the pipeline's fill step (no bank
+        output exists yet); cached so it costs one placement per run."""
+        if self._zero_bank_out is None:
+            self._zero_bank_out = BankStepOut(
+                num_active=jnp.zeros((), jnp.int32),
+                compact_fallback=jnp.zeros((), jnp.int32),
+                full_mem_ratio=jnp.zeros((), jnp.float32),
+            )
+        return self._zero_bank_out
+
+    def _dispatch_pending_bank(
+        self, bank: BankState
+    ) -> Tuple[BankState, Optional[BankStepOut]]:
+        """Dispatch the HELD bank program (the previous batch's enqueue +
+        EM) against `bank`. Dispatch ORDER is load-bearing: the current
+        batch's trunk must already be in flight reading `bank.gmm` before
+        this call donates it — in-flight reads are sequenced by the runtime,
+        later host reads are use-after-donate errors. After the dispatch
+        below the donated operands are dead to the host;
+        scripts/check_bank_donation.py lints that `bank` is never
+        referenced past the dispatch line."""
+        held = self._held_enq
+        if held is None:
+            return bank, None
+        self._held_enq = None
+        new_bank, bank_out = self._bank_jit(bank, *held)
+        # opens the overlap window the NEXT trunk dispatch closes (the
+        # bank_dispatch_overlap_fraction gauge)
+        self._bank_dispatch_t = time.perf_counter()
+        return new_bank, bank_out
+
+    def _async_train_step(
+        self, state, images, labels, seeds, use_mine, update_gmm, warm
+    ) -> Tuple[TrainState, TrainMetrics]:
+        """One pipelined step: dispatch batch N's trunk against the NEWEST
+        COMPLETED bank generation (one-step-stale prototypes), then dispatch
+        batch N-1's held bank program, then hold batch N's enqueue
+        candidates for the next call. Metrics mix batch N's trunk scalars
+        with batch N-1's bank scalars (zeros on the fill step)."""
+        trunk0, bank0 = split_state(state)
+        new_trunk, out = self._trunk_jit(
+            trunk0, bank0.gmm, images, labels, seeds, use_mine, warm=warm
+        )
+        now = time.perf_counter()
+        if self._bank_dispatch_t is not None:
+            # close the overlap window: the previously dispatched bank
+            # program was in flight across this step's fetch + trunk
+            # dispatch. Host dispatch-clock estimate, an upper bound on
+            # true device overlap — honest about whether the pipeline ran
+            # pipelined; train_epoch feeds it to the StepMonitor gauge.
+            self._bank_overlap_step_s = now - self._bank_dispatch_t
+            self._bank_dispatch_t = None
+        else:
+            self._bank_overlap_step_s = 0.0
+        new_bank, bank_out = self._dispatch_pending_bank(bank0)
+        self._held_enq = (
+            out.enq_feats, out.enq_classes, out.enq_valid,
+            out.step0, update_gmm, out.finite,
+        )
+        if bank_out is None:
+            bank_out = self._zero_bank_metrics()
+        metrics = TrainMetrics(
+            loss=out.loss,
+            cross_entropy=out.cross_entropy,
+            mine=out.mine,
+            aux=out.aux,
+            accuracy=out.accuracy,
+            full_mem_ratio=bank_out.full_mem_ratio,
+            em_active=bank_out.num_active,
+            em_compact_fallback=bank_out.compact_fallback,
+            nonfinite=~out.finite,
+        )
+        return merge_state(new_trunk, new_bank), metrics
+
+    def flush_bank(
+        self, state: TrainState
+    ) -> Tuple[TrainState, Optional[BankStepOut]]:
+        """Drain the pipeline: dispatch the held bank program (the LAST
+        batch's enqueue + EM) and fold its output into `state`. Must run
+        before anything reads the bank state as current — epoch end,
+        checkpointing, eval; train_epoch calls it at every exit. No-op in
+        sync mode or when nothing is held."""
+        if self._held_enq is None:
+            return state, None
+        trunk, bank = split_state(state)
+        new_bank, bank_out = self._dispatch_pending_bank(bank)
+        self._bank_dispatch_t = None  # no trunk follows: nothing overlaps
+        return merge_state(trunk, new_bank), bank_out
+
+    def reset_bank_pipeline(self) -> None:
+        """Discard any held (undispatched) bank work + overlap clocks. Run
+        at epoch start: after a mid-epoch exception (divergence rollback),
+        the held candidates refer to a state that no longer exists."""
+        self._held_enq = None
+        self._bank_dispatch_t = None
+        self._bank_overlap_step_s = 0.0
 
     def train_step(
         self, state, images, labels, use_mine: bool, update_gmm: bool,
@@ -289,14 +519,14 @@ class Trainer:
             # no loader-shipped seeds (direct callers, tests): a zero
             # stream — only consumed when device_augment is on
             seeds = jnp.zeros((np.shape(images)[0],), jnp.uint32)
+        use_mine = jnp.asarray(use_mine, jnp.float32)
+        update_gmm = jnp.asarray(update_gmm, bool)
+        if self._async_bank:
+            return self._async_train_step(
+                state, images, labels, seeds, use_mine, update_gmm, warm
+            )
         return self._train_step(
-            state,
-            images,
-            labels,
-            seeds,
-            jnp.asarray(use_mine, jnp.float32),
-            jnp.asarray(update_gmm, bool),
-            warm=warm,
+            state, images, labels, seeds, use_mine, update_gmm, warm=warm
         )
 
     # ------------------------------------------------------------------- eval
@@ -382,12 +612,19 @@ class Trainer:
         (preemption — the in-flight step finishes first, matching the
         SIGTERM contract) or raise DivergenceError (consecutive non-finite
         steps — the driver rolls back). The guard's accounting runs on
-        device at step cadence; host syncs only at its check_every cadence."""
-        import time
+        device at step cadence; host syncs only at its check_every cadence.
 
+        Async bank mode: the pipeline registers are reset on entry (a
+        previous epoch that exited through an exception may have left stale
+        held work), the final held bank program is FLUSHED on every exit
+        path (normal end and guard-preemption stop both fall through the
+        flush below), its metrics fold into the epoch accumulators, and
+        each step's bank-in-flight window feeds the monitor's
+        `bank_dispatch_overlap_fraction` gauge."""
         from mgproto_tpu.data.loader import device_prefetch
         from mgproto_tpu.telemetry.monitor import tree_transfer_bytes
 
+        self.reset_bank_pipeline()
         flags = self.epoch_flags(state, epoch)
         if guard is not None:
             guard.begin_epoch(epoch, state)
@@ -426,6 +663,7 @@ class Trainer:
                     now - t_prev,
                     transfer_bytes=tree_transfer_bytes(batch),
                     wait_seconds=wait_s,
+                    bank_overlap_seconds=self._bank_overlap_step_s,
                 )
                 t_prev = now
             em_max = (
@@ -442,6 +680,23 @@ class Trainer:
             )
             if guard is not None and guard.after_step(state, last):
                 break  # preemption: stop AFTER the completed step
+        # async mode: the last batch's bank program is still held — drain it
+        # so the returned state's bank fields are CURRENT (epoch_flags, the
+        # test pass, checkpoints and eval all read them next)
+        state, flushed = self.flush_bank(state)
+        if flushed is not None:
+            em_max = (
+                flushed.num_active if em_max is None
+                else jnp.maximum(em_max, flushed.num_active)
+            )
+            fm_max = (
+                flushed.full_mem_ratio if fm_max is None
+                else jnp.maximum(fm_max, flushed.full_mem_ratio)
+            )
+            fb_sum = (
+                flushed.compact_fallback if fb_sum is None
+                else fb_sum + flushed.compact_fallback
+            )
         if guard is not None:
             guard.end_epoch()
         if last is not None:

@@ -26,8 +26,14 @@ import numpy as np
 from jax.sharding import Mesh
 
 from mgproto_tpu.config import Config
-from mgproto_tpu.engine.train import EvalOutput, Trainer, TrainMetrics
-from mgproto_tpu.core.state import TrainState
+from mgproto_tpu.engine.train import (
+    BankStepOut,
+    EvalOutput,
+    Trainer,
+    TrainMetrics,
+    TrunkOut,
+)
+from mgproto_tpu.core.state import TrainState, split_state
 from mgproto_tpu.parallel.mesh import make_mesh
 from mgproto_tpu.parallel.sharding import (
     batch_sharding,
@@ -149,9 +155,66 @@ class ShardedTrainer(Trainer):
             in_shardings=(state_sh, self._batch_sh, self._batch_sh),
             out_shardings=eval_out_sh,
         )
+        # async bank pipeline: the SAME trunk/bank split as the single-chip
+        # Trainer, SPMD-sharded. The trunk reads the (one-step-stale) gmm at
+        # its class sharding; the bank program keeps gmm/memory/EM state
+        # class-sharded and its enqueue operands data-sharded — inside it,
+        # GSPMD inserts the same all-gather (enqueue sees the global batch)
+        # and the shard_mapped EM keeps its psum'd sufficient statistics,
+        # so staleness changes WHEN the collectives run, never their
+        # pattern: every shard follows the same one-step-stale schedule.
+        trunk_sh, bank_sh = split_state(state_sh)
+        trunk_out_sh = TrunkOut(
+            enq_feats=self._batch_sh,
+            enq_classes=self._batch_sh,
+            enq_valid=self._batch_sh,
+            step0=self._repl,
+            finite=self._repl,
+            loss=self._repl,
+            cross_entropy=self._repl,
+            mine=self._repl,
+            aux=self._repl,
+            accuracy=self._repl,
+        )
+        trunk_jits = {
+            w: jax.jit(
+                functools.partial(self._trunk_step, warm=w),
+                in_shardings=(
+                    trunk_sh, bank_sh.gmm, self._batch_sh, self._batch_sh,
+                    self._batch_sh, self._repl,
+                ),
+                out_shardings=(trunk_sh, trunk_out_sh),
+                donate_argnums=(0,) if self.donate else (),
+            )
+            for w in (False, True)
+        }
+        self._trunk_jit = (
+            lambda trunk, gmm, images, labels, seeds, use_mine, warm=False: (
+                trunk_jits[bool(warm)](
+                    trunk, gmm, images, labels, seeds, use_mine
+                )
+            )
+        )
+        bank_out_sh = BankStepOut(
+            num_active=self._repl,
+            compact_fallback=self._repl,
+            full_mem_ratio=self._repl,
+        )
+        self._bank_jit = jax.jit(
+            self._bank_step,
+            in_shardings=(
+                bank_sh, self._batch_sh, self._batch_sh, self._batch_sh,
+                self._repl, self._repl, self._repl,
+            ),
+            out_shardings=(bank_sh, bank_out_sh),
+            donate_argnums=(0,) if self.donate else (),
+        )
         # telemetry recompile detection must watch the REAL jit objects, not
         # the dispatching lambda above (which has no _cache_size)
-        self._jit_handles = list(jits.values()) + [self._eval_step]
+        self._jit_handles = (
+            list(jits.values()) + list(trunk_jits.values())
+            + [self._bank_jit, self._eval_step]
+        )
 
     def prepare(self, state: TrainState) -> TrainState:
         """Pin `state` to its mesh sharding (and build the sharded jits)."""

@@ -1,0 +1,13 @@
+"""Performance planning: compiled-module memory models and the HBM-budget
+auto-tuner (`--auto_tune`). See perf/planner.py."""
+
+from mgproto_tpu.perf.planner import (  # noqa: F401
+    HBMPlanner,
+    PlanCandidate,
+    PlanOutcome,
+    PlanReport,
+    apply_plan,
+    autotune,
+    candidate_plans,
+    default_budget_bytes,
+)

@@ -1,0 +1,550 @@
+"""HBM-budget auto-tuner: pick (batch, remat, prefetch, augment, async_bank)
+from a memory model instead of by DNF.
+
+The batch-512 DNF (PERF.md "MFU headroom") and the hand-curated sweep showed
+run sizing was still trial-and-error: a config either fit the chip's HBM or
+died on the relay with nothing learned. Following "Memory Safe Computations
+with XLA Compiler" (PAPERS.md), this module turns sizing into a solved
+problem: for each candidate plan it compiles the EXACT production step
+program(s) and reads XLA's compiled-module memory analysis — the same
+machinery `scripts/perf_model.py` and `bench.py --measure em/overlap`
+already use — then selects the largest plan that fits the device budget
+with a configurable margin.
+
+Peak model per candidate (`PlanReport.detail` carries the breakdown):
+
+    peak = program peak (arguments + outputs + temps - donation aliasing,
+           summed over the trunk+bank programs when async_bank — the two
+           can be resident together)
+         + prefetch headroom: prefetch_depth x batch_bytes (PERF.md lever
+           2 — each in-flight batch is HBM the step never sees; ~154 MB
+           per unit at f32 batch 256, a quarter of that under the uint8
+           wire format)
+
+Donation matters twice: the bank program's `alias_size_in_bytes` is the
+[C, cap, d] bank + EM state it updates in place (engine/train.py), and the
+monolithic step aliases the whole TrainState — the model charges aliased
+bytes once, like the runtime does.
+
+Budget resolution order: explicit argument > MGPROTO_HBM_BUDGET_BYTES env >
+the device's own `memory_stats()['bytes_limit']` > a 16 GiB v5e-class
+default (the CPU backend has no device budget — `--auto_tune` still plans
+there, which is exactly how the unit tests and a laptop dry-run use it).
+The safety margin defaults to 8% and is overridable via MGPROTO_HBM_MARGIN.
+
+`measure` is injectable so tests (and future analytic models) can replace
+the compile with a simulation; the default compiles through
+`engine.train.Trainer`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_BUDGET_BYTES = 16 * 1024**3  # v5e-class HBM
+BUDGET_ENV = "MGPROTO_HBM_BUDGET_BYTES"
+MARGIN_ENV = "MGPROTO_HBM_MARGIN"
+DEFAULT_MARGIN = 0.08
+
+# backbone families whose stages accept selective remat (models/common.py
+# validates stage names; other archs get no remat candidates)
+_REMAT_ARCH_PREFIXES = ("resnet", "densenet")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanCandidate:
+    """One (batch, remat, prefetch, augment, async_bank) tuple under
+    consideration. `batch` is the GLOBAL train batch size."""
+
+    batch: int
+    remat_stages: Tuple[str, ...] = ()
+    prefetch_depth: int = 2
+    device_augment: bool = False
+    async_bank: bool = False
+
+    @property
+    def name(self) -> str:
+        parts = [f"b{self.batch}"]
+        if self.remat_stages:
+            parts.append("remat_" + "+".join(self.remat_stages))
+        parts.append(f"pf{self.prefetch_depth}")
+        if self.device_augment:
+            parts.append("u8")
+        if self.async_bank:
+            parts.append("async")
+        return "_".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanReport:
+    """One measured candidate: predicted peak bytes vs the effective
+    budget, plus the breakdown (telemetry meta records all of these)."""
+
+    candidate: PlanCandidate
+    peak_bytes: int
+    fits: bool
+    detail: Dict[str, int]
+    error: str = ""
+
+    def to_meta(self) -> Dict:
+        return {
+            "name": self.candidate.name,
+            "batch": self.candidate.batch,
+            "remat_stages": list(self.candidate.remat_stages),
+            "prefetch_depth": self.candidate.prefetch_depth,
+            "device_augment": self.candidate.device_augment,
+            "async_bank": self.candidate.async_bank,
+            "peak_bytes": int(self.peak_bytes),
+            "fits": bool(self.fits),
+            **({"error": self.error} if self.error else {}),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanOutcome:
+    chosen: Optional[PlanReport]
+    reports: Tuple[PlanReport, ...]
+    budget_bytes: int
+    margin: float
+
+    @property
+    def rejected(self) -> int:
+        return sum(1 for r in self.reports if not r.fits)
+
+    def to_meta(self) -> Dict:
+        """The telemetry meta.json "autotune" record: the chosen plan plus
+        every candidate's predicted peak, so a DNF is a read, not a rerun."""
+        return {
+            "plan": self.chosen.to_meta() if self.chosen else None,
+            "budget_bytes": int(self.budget_bytes),
+            "margin": self.margin,
+            "rejected": self.rejected,
+            "candidates": [r.to_meta() for r in self.reports],
+        }
+
+
+def default_budget_bytes() -> Tuple[int, str]:
+    """(budget bytes, source) — env override, else the device's own limit,
+    else the v5e-class default (CPU backends report no bytes_limit)."""
+    raw = os.environ.get(BUDGET_ENV)
+    if raw:
+        return int(raw), "env"
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit), "device"
+    except Exception:  # no backend / no stats: fall through to the default
+        pass
+    return DEFAULT_BUDGET_BYTES, "default"
+
+
+def resolve_margin(margin: Optional[float] = None) -> float:
+    if margin is not None:
+        return float(margin)
+    raw = os.environ.get(MARGIN_ENV)
+    if raw:
+        return float(raw)
+    return DEFAULT_MARGIN
+
+
+def batch_bytes(
+    batch: int, img_size: int, device_augment: bool
+) -> int:
+    """Host->device bytes of one train batch: images (uint8 wire under
+    device_augment, f32 otherwise) + labels + augmentation seeds."""
+    px = batch * img_size * img_size * 3
+    images = px if device_augment else px * 4
+    return images + batch * 4 + batch * 4  # + int32 labels + uint32 seeds
+
+
+def _program_peak(compiled) -> Tuple[int, Dict[str, int]]:
+    """Peak resident bytes of one compiled program from XLA's memory
+    analysis: arguments + outputs + temps, minus donation aliasing (an
+    aliased output IS its argument buffer — charging both would bill the
+    donated TrainState twice)."""
+    ma = compiled.memory_analysis()
+    args = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+    out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+    temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    peak = max(args + out + temp - alias, 0)
+    return peak, {
+        "argument_bytes": args,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+    }
+
+
+def plan_config(base_cfg, cand: PlanCandidate):
+    """`base_cfg` with the candidate's knobs applied (the same projection
+    `apply_plan` uses, shared so measurement and application can't drift)."""
+    data = dataclasses.replace(
+        base_cfg.data,
+        train_batch_size=cand.batch,
+        prefetch_depth=cand.prefetch_depth,
+        device_augment=cand.device_augment,
+    )
+    model = dataclasses.replace(
+        base_cfg.model, remat_stages=tuple(cand.remat_stages)
+    )
+    em = dataclasses.replace(base_cfg.em, async_bank=cand.async_bank)
+    return base_cfg.replace(data=data, model=model, em=em)
+
+
+apply_plan = plan_config  # the public name run_training uses
+
+
+def data_axis_size(cfg) -> int:
+    """Devices on the mesh's data axis for this config — the divisor that
+    turns a GLOBAL candidate batch into the per-chip batch one device
+    actually materializes."""
+    import jax
+
+    n_model = max(int(cfg.mesh.model), 1)
+    if cfg.mesh.data == -1:
+        return max(jax.device_count() // n_model, 1)
+    return max(int(cfg.mesh.data), 1)
+
+
+def lower_split_programs(trainer, state, images, labels, seeds, use_mine,
+                         update_gmm):
+    """Lower (NOT compile) the async pipeline's two programs for one
+    operand set. The ONE definition of the trunk/bank lowering (bench.py
+    --measure overlap and measure_candidate both use it, so a signature
+    change in either program cannot leave one caller silently measuring
+    the wrong thing). Returns (trunk_lowered, bank_lowered); callers
+    `.compile()` each — separately, so per-program compile time stays
+    attributable."""
+    import jax
+    import jax.numpy as jnp
+
+    from mgproto_tpu.core.state import split_state
+
+    trunk, bank = split_state(state)
+    trunk_lowered = trainer._trunk_jit.lower(
+        trunk, bank.gmm, images, labels, seeds, use_mine, warm=False
+    )
+    _, out_shape = jax.eval_shape(
+        lambda *a: trainer._trunk_step(*a, warm=False),
+        trunk, bank.gmm, images, labels, seeds, use_mine,
+    )
+    enq = tuple(
+        jax.ShapeDtypeStruct(s.shape, s.dtype)
+        for s in (
+            out_shape.enq_feats, out_shape.enq_classes, out_shape.enq_valid
+        )
+    )
+    bank_lowered = trainer._bank_jit.lower(
+        bank, *enq, state.step, update_gmm, jnp.asarray(True)
+    )
+    return trunk_lowered, bank_lowered
+
+
+def measure_candidate(base_cfg, cand: PlanCandidate) -> Tuple[int, Dict]:
+    """Default measurement: compile the candidate's ACTUAL step program(s)
+    (trunk + bank when async, the monolithic step otherwise) via the
+    production Trainer and read the compiled-module memory analysis, then
+    add the prefetch-depth headroom. Returns (peak_bytes, detail).
+
+    PER-CHIP model: the candidate batch is GLOBAL, but HBM is a per-chip
+    resource — the program is compiled at the per-chip batch share
+    (global / data-axis size) with the full replicated state, which is
+    what one device actually holds under the production ShardedTrainer's
+    data-parallel layout. Class-sharded state (mesh.model > 1) is charged
+    unsharded — a deliberate conservative over-count of the bank shard."""
+    import jax
+    import jax.numpy as jnp
+
+    from mgproto_tpu.core.memory import memory_nbytes
+    from mgproto_tpu.engine.train import Trainer
+
+    cfg = plan_config(base_cfg, cand)
+    trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
+    # shapes only: lowering accepts ShapeDtypeStructs, so no candidate ever
+    # allocates a real state (or loads pretrained weights — for_restore
+    # skips that too, and eval_shape never runs the init anyway)
+    state = jax.eval_shape(
+        lambda rng: trainer.init_state(rng, for_restore=True),
+        jax.random.PRNGKey(0),
+    )
+    m = cfg.model
+    per_chip = max(cand.batch // data_axis_size(cfg), 1)
+    img_dtype = jnp.uint8 if trainer._device_augment else jnp.float32
+    images = jax.ShapeDtypeStruct(
+        (per_chip, m.img_size, m.img_size, 3), img_dtype
+    )
+    labels = jax.ShapeDtypeStruct((per_chip,), jnp.int32)
+    seeds = jax.ShapeDtypeStruct((per_chip,), jnp.uint32)
+    use_mine = jnp.asarray(1.0, jnp.float32)
+    update_gmm = jnp.asarray(True, bool)
+
+    detail: Dict[str, int] = {"per_chip_batch": per_chip}
+    if trainer.async_bank:
+        trunk_lowered, bank_lowered = lower_split_programs(
+            trainer, state, images, labels, seeds, use_mine, update_gmm
+        )
+        t_peak, t_detail = _program_peak(trunk_lowered.compile())
+        b_peak, b_detail = _program_peak(bank_lowered.compile())
+        # both programs can be resident at once — that is the point of the
+        # pipeline — so their peaks add
+        program_peak = t_peak + b_peak
+        detail["trunk_peak_bytes"] = t_peak
+        detail["bank_peak_bytes"] = b_peak
+        detail.update({f"trunk_{k}": v for k, v in t_detail.items()})
+        detail.update({f"bank_{k}": v for k, v in b_detail.items()})
+    else:
+        program_peak, p_detail = _program_peak(
+            trainer._train_step.lower(
+                state, images, labels, seeds, use_mine, update_gmm,
+                warm=False,
+            ).compile()
+        )
+        detail.update(p_detail)
+
+    prefetch = cand.prefetch_depth * batch_bytes(
+        per_chip, m.img_size, trainer._device_augment
+    )
+    detail["program_peak_bytes"] = int(program_peak)
+    detail["prefetch_headroom_bytes"] = int(prefetch)
+    # analytic cross-check of the dominant bank buffer (one generation
+    # live under donation): visible in the detail so a memory_analysis
+    # regression on a new backend is a read, not a mystery
+    detail["bank_bytes_analytic"] = memory_nbytes(
+        m.num_classes, m.mem_capacity, m.proto_dim
+    )
+    return int(program_peak + prefetch), detail
+
+
+def make_cached_measure(base_cfg) -> Callable:
+    """The default `autotune` measure: `measure_candidate` memoized on the
+    program identity (batch, remat, augment, async). Candidates that differ
+    ONLY in prefetch_depth compile the same program — their peaks differ by
+    pure arithmetic (prefetch_depth x per-chip batch bytes) — so the
+    prefetch ladder in `candidate_plans` costs zero extra compiles."""
+    import dataclasses as _dc
+
+    cache: Dict[Tuple, Tuple[int, Dict]] = {}
+
+    def measure(cand: PlanCandidate) -> Tuple[int, Dict]:
+        key = (
+            cand.batch, tuple(cand.remat_stages),
+            cand.device_augment, cand.async_bank,
+        )
+        if key not in cache:
+            cache[key] = measure_candidate(
+                base_cfg, _dc.replace(cand, prefetch_depth=0)
+            )
+        peak0, det0 = cache[key]
+        if cand.prefetch_depth <= 0:
+            return peak0, det0
+        prefetch = cand.prefetch_depth * batch_bytes(
+            det0["per_chip_batch"], base_cfg.model.img_size,
+            cand.device_augment,
+        )
+        detail = dict(det0, prefetch_headroom_bytes=int(prefetch))
+        return int(det0["program_peak_bytes"] + prefetch), detail
+
+    return measure
+
+
+class HBMPlanner:
+    """Selects the largest candidate whose predicted peak fits
+    budget * (1 - margin).
+
+    Preference order: larger batch first (throughput — the measured sweep
+    climbs monotonically to the HBM cliff, PERF.md), then fewer remat
+    stages (less recompute), then deeper prefetch. A candidate whose
+    measurement RAISES is treated as over-budget (that is the compile-time
+    analogue of the DNF this planner exists to prevent) and reported with
+    the error string.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: Optional[int] = None,
+        margin: Optional[float] = None,
+        measure: Optional[Callable] = None,
+        log: Optional[Callable[[str], None]] = None,
+    ):
+        if budget_bytes is None:
+            budget_bytes, self.budget_source = default_budget_bytes()
+        else:
+            self.budget_source = "explicit"
+        self.budget_bytes = int(budget_bytes)
+        self.margin = resolve_margin(margin)
+        self._measure = measure
+        self._log = log or (lambda s: None)
+
+    @property
+    def effective_budget(self) -> int:
+        return int(self.budget_bytes * (1.0 - self.margin))
+
+    def plan(
+        self, base_cfg, candidates: Sequence[PlanCandidate]
+    ) -> PlanOutcome:
+        measure = self._measure or make_cached_measure(base_cfg)
+        reports: List[PlanReport] = []
+        for cand in candidates:
+            try:
+                measured = measure(cand)
+                peak, detail = (
+                    measured if isinstance(measured, tuple)
+                    else (int(measured), {})
+                )
+                err = ""
+            except Exception as e:  # compile/measure failure == does not fit
+                peak, detail, err = 0, {}, f"{type(e).__name__}: {e}"
+            fits = not err and peak <= self.effective_budget
+            reports.append(PlanReport(
+                candidate=cand, peak_bytes=int(peak), fits=fits,
+                detail=detail, error=err,
+            ))
+            self._log(
+                f"autotune: {cand.name} peak={peak / 1e9:.2f} GB "
+                f"{'fits' if fits else 'REJECTED'}"
+                + (f" ({err})" if err else "")
+            )
+        fitting = [r for r in reports if r.fits]
+        chosen = max(
+            fitting,
+            key=lambda r: (
+                r.candidate.batch,
+                -len(r.candidate.remat_stages),
+                r.candidate.prefetch_depth,
+            ),
+            default=None,
+        )
+        return PlanOutcome(
+            chosen=chosen,
+            reports=tuple(reports),
+            budget_bytes=self.budget_bytes,
+            margin=self.margin,
+        )
+
+
+def candidate_plans(
+    cfg,
+    batches: Optional[Sequence[int]] = None,
+    device_augment: Optional[bool] = None,
+    async_bank: Optional[bool] = None,
+) -> List[PlanCandidate]:
+    """The default candidate ladder for a base config: the configured batch
+    and its 2x/4x, each with the configured remat plus — for rematable
+    backbones — the layer1-only selective variant that resolved the
+    batch-512 DNF hypothesis (PERF.md lever 3), and each additionally at
+    prefetch_depth 0 (the no-headroom operating point device_prefetch
+    supports; FREE to evaluate — same compiled program, different
+    arithmetic, see make_cached_measure — and the tie-break prefers deeper
+    prefetch, so pf0 only wins when the headroom is what did not fit).
+    Augment/async default to the config's own resolution so the plan
+    measures what the run will actually execute."""
+    import jax
+
+    b0 = cfg.data.train_batch_size * jax.process_count()
+    batches = list(batches) if batches else [b0, 2 * b0, 4 * b0]
+    if device_augment is None:
+        from mgproto_tpu.ops.augment import resolve_device_augment
+
+        device_augment = resolve_device_augment(cfg.data.device_augment)
+    if async_bank is None:
+        from mgproto_tpu.engine.train import resolve_async_bank
+
+        async_bank = resolve_async_bank(cfg.em.async_bank)
+    remat_options: List[Tuple[str, ...]] = [tuple(cfg.model.remat_stages)]
+    if (
+        cfg.model.arch.startswith(_REMAT_ARCH_PREFIXES)
+        and not cfg.model.remat
+    ):
+        l1 = ("denseblock1",) if "densenet" in cfg.model.arch else ("layer1",)
+        if l1 not in remat_options:
+            remat_options.append(l1)
+    prefetch_options = sorted({int(cfg.data.prefetch_depth), 0},
+                              reverse=True)
+    out: List[PlanCandidate] = []
+    for b in sorted(set(batches)):
+        for stages in remat_options:
+            for pf in prefetch_options:
+                out.append(PlanCandidate(
+                    batch=int(b),
+                    remat_stages=stages,
+                    prefetch_depth=pf,
+                    device_augment=bool(device_augment),
+                    async_bank=bool(async_bank),
+                ))
+    return out
+
+
+def plan_serve_buckets(
+    engine,
+    budget_bytes: Optional[int] = None,
+    margin: Optional[float] = None,
+    measure: Optional[Callable] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> Tuple[List[int], PlanOutcome]:
+    """`mgproto-serve --auto_tune`: size the warmup bucket set from the
+    same memory model. Each requested bucket's serving program is lowered
+    and its compiled-module peak read; buckets over budget are dropped
+    BEFORE warmup would OOM compiling them. Returns (fitting bucket sizes,
+    outcome). No prefetch headroom — serving holds one batch.
+
+    Known cost: the planning compile is AOT and does not populate the
+    engine's jit dispatch cache, so warmup recompiles the fitting buckets
+    (~2x serve startup compile). That is the price of refusing to execute
+    a predicted OOM; skip --auto_tune on a device you know fits."""
+    import numpy as np
+
+    def bucket_measure(cand: PlanCandidate):
+        zeros = np.zeros(
+            (cand.batch, engine.img_size, engine.img_size, 3), np.float32
+        )
+        return _program_peak(engine._jit.lower(zeros).compile())
+
+    planner = HBMPlanner(
+        budget_bytes=budget_bytes, margin=margin,
+        measure=measure or bucket_measure, log=log,
+    )
+    cands = [
+        PlanCandidate(batch=int(b), prefetch_depth=0)
+        for b in sorted(engine.buckets)
+    ]
+    outcome = planner.plan(None, cands)
+    fitting = [r.candidate.batch for r in outcome.reports if r.fits]
+    return fitting, outcome
+
+
+def autotune(
+    cfg,
+    budget_bytes: Optional[int] = None,
+    margin: Optional[float] = None,
+    candidates: Optional[Sequence[PlanCandidate]] = None,
+    measure: Optional[Callable] = None,
+    log: Optional[Callable[[str], None]] = None,
+):
+    """One-call driver for `--auto_tune`: build candidates, plan, apply.
+    Returns (possibly-updated cfg, PlanOutcome). When no candidate fits
+    (a genuinely undersized device), the base config is returned unchanged
+    so the run proceeds exactly as hand-configured — with the rejection
+    trail in telemetry instead of an OOM at first step."""
+    planner = HBMPlanner(
+        budget_bytes=budget_bytes, margin=margin, measure=measure, log=log
+    )
+    cands = (
+        list(candidates) if candidates is not None else candidate_plans(cfg)
+    )
+    outcome = planner.plan(cfg, cands)
+    if outcome.chosen is None:
+        return cfg, outcome
+    chosen = outcome.chosen.candidate
+    import jax
+
+    # candidate batches are GLOBAL; DataConfig batch sizes are per-process
+    per_process = dataclasses.replace(
+        chosen, batch=max(chosen.batch // max(jax.process_count(), 1), 1)
+    )
+    return apply_plan(cfg, per_process), outcome

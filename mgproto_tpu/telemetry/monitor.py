@@ -122,6 +122,16 @@ class StepMonitor:
             "over the epoch)",
         )
         self._g_wait_frac.set(0.0, phase=phase)
+        # async bank pipeline (engine/train.py): fraction of epoch wall
+        # time a dispatched bank program was in flight concurrently with
+        # trunk work — 0.0 exactly when the pipeline is off (sync mode)
+        self._epoch_bank_overlap = 0.0
+        self._g_bank_overlap = r.gauge(
+            "bank_dispatch_overlap_fraction",
+            "fraction of epoch wall time the async bank program overlapped "
+            "trunk compute (host dispatch-clock estimate; 0 in sync mode)",
+        )
+        self._g_bank_overlap.set(0.0, phase=phase)
 
     # ------------------------------------------------------------- recompiles
     def watch(self, *targets: WatchTarget) -> "StepMonitor":
@@ -172,6 +182,7 @@ class StepMonitor:
         transfer_bytes: int = 0,
         check_recompiles: bool = True,
         wait_seconds: float = 0.0,
+        bank_overlap_seconds: float = 0.0,
     ) -> None:
         ph = self.phase
         self._h_step.observe(seconds, phase=ph)
@@ -193,6 +204,12 @@ class StepMonitor:
         if self._epoch_seconds > 0:
             self._g_wait_frac.set(
                 min(1.0, self._epoch_wait / self._epoch_seconds), phase=ph
+            )
+        self._epoch_bank_overlap += float(bank_overlap_seconds)
+        if self._epoch_seconds > 0:
+            self._g_bank_overlap.set(
+                min(1.0, self._epoch_bank_overlap / self._epoch_seconds),
+                phase=ph,
             )
         if check_recompiles:
             self.check_recompiles()
@@ -220,6 +237,7 @@ class StepMonitor:
         self._epoch_images = 0
         self._epoch_seconds = 0.0
         self._epoch_wait = 0.0
+        self._epoch_bank_overlap = 0.0
 
     @property
     def epoch_images(self) -> int:

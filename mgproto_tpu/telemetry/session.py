@@ -43,6 +43,13 @@ META_FILE = "meta.json"
 EM_ACTIVE_GAUGE = "em_active_classes"
 EM_FALLBACK_COUNTER = "em_compact_fallback_total"
 
+# async bank pipeline + HBM auto-tuner (engine/train.py, perf/planner.py):
+# the overlap gauge is created by the session's StepMonitor; the rejection
+# counter is pre-registered here so a run that never auto-tuned (or whose
+# every candidate fit) still reports an explicit zero
+BANK_OVERLAP_GAUGE = "bank_dispatch_overlap_fraction"
+AUTOTUNE_REJECTED_COUNTER = "autotune_plan_rejected_total"
+
 # input-pipeline metrics (data/loader.py + StepMonitor): pre-registered so
 # summarize always shows the data story — a run that never waited on its
 # loader (or never used shm slabs) reports explicit zeros
@@ -121,6 +128,13 @@ class TelemetrySession:
             DATA_SHM_SLABS_GAUGE,
             "shared-memory batch slabs currently held by in-flight batches",
         ).set(0.0)
+        # async bank + auto-tuner: the overlap gauge exists via StepMonitor;
+        # pin the planner's rejection counter at an explicit zero
+        self._c_autotune_rejected = self.registry.counter(
+            AUTOTUNE_REJECTED_COUNTER,
+            "auto-tuner candidate plans rejected as over the HBM budget",
+        )
+        self._c_autotune_rejected.inc(0.0)
 
     def observe_em(self, active_classes: float, compact_fallbacks: float = 0.0):
         """Record one epoch's EM fast-path outcome (host floats — callers
@@ -128,6 +142,14 @@ class TelemetrySession:
         self._g_em_active.set(float(active_classes))
         if compact_fallbacks:
             self._c_em_fallback.inc(float(compact_fallbacks))
+
+    def observe_autotune(self, outcome) -> None:
+        """Record an HBM auto-tuner run (perf/planner.py PlanOutcome): the
+        chosen plan + every candidate's predicted peak land in meta.json
+        ("autotune"), rejected candidates increment the counter."""
+        if outcome.rejected:
+            self._c_autotune_rejected.inc(float(outcome.rejected))
+        self.write_meta({"autotune": outcome.to_meta()})
 
     def write_meta(self, meta: Dict[str, Any]) -> None:
         """Persist run configuration context (e.g. prefetch depth, compute

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Lint: the host must never touch a donated bank buffer after dispatch.
+
+The async bank pipeline (engine/train.py) dispatches the bank program with
+`donate_argnums` on the bank state: once `self._bank_jit(bank, ...)` has
+been dispatched, the buffers behind `bank` (gmm / memory / EM moments)
+belong to the runtime and may be overwritten in place at any moment. A
+host-side read after that point is a use-after-donate — in the best case a
+loud JAX error, in the worst (a future runtime that recycles silently) a
+data race on the [C, cap, d] bank. The safe pattern is structural: the
+donated identifier is REBOUND at the dispatch line and never referenced
+again in that function.
+
+This grep-based check pins it (style of check_em_compact.py): in
+`mgproto_tpu/engine/train.py`, for EVERY function containing a
+`self._bank_jit(...)` call,
+
+  * the first argument of that call (the donated bank operand) must not be
+    referenced, as a whole word, on any line after the dispatch line;
+  * at least one such dispatch site must exist (the pipeline cannot have
+    quietly lost its donation).
+
+Run from anywhere:  python scripts/check_bank_donation.py [repo_root]
+Exit 0 when clean, 1 with one finding per line otherwise. Wired into
+tier-1 via tests/test_async_bank.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+DISPATCH_RE = re.compile(r"self\._bank_jit\(\s*(?:\*?)(\w+)")
+
+
+def _functions(source: str):
+    """Yield (name, body_lines) for every `def` in the file, body spanning
+    to the next def OR class at the same-or-lower indent (textual,
+    matching the grep-based contract; `class` must terminate a module-level
+    def or its body would swallow every method that follows)."""
+    lines = source.splitlines()
+    starts = []
+    for i, line in enumerate(lines):
+        m = re.match(r"(\s*)(def|class)\s+(\w+)", line)
+        if m:
+            starts.append((i, len(m.group(1)), m.group(2), m.group(3)))
+    for idx, (i, indent, kind, name) in enumerate(starts):
+        if kind != "def":
+            continue
+        end = len(lines)
+        for j, jindent, _, _ in starts[idx + 1:]:
+            if jindent <= indent:
+                end = j
+                break
+        yield name, lines[i:end]
+
+
+def findings(repo_root: str, source: str = None) -> List[str]:
+    path = os.path.join(repo_root, "mgproto_tpu", "engine", "train.py")
+    if source is None:
+        with open(path) as f:
+            source = f.read()
+    found: List[str] = []
+    dispatch_sites = 0
+    for name, body in _functions(source):
+        for k, line in enumerate(body):
+            m = DISPATCH_RE.search(line)
+            if not m:
+                continue
+            dispatch_sites += 1
+            donated = m.group(1)
+            # the dispatch line itself may rebind (new_bank, out = ...);
+            # every LATER line must not mention the donated name
+            tail = body[k + 1:]
+            word = re.compile(rf"\b{re.escape(donated)}\b")
+            for off, later in enumerate(tail):
+                code = later.split("#", 1)[0]  # comments may narrate freely
+                if word.search(code):
+                    found.append(
+                        f"engine/train.py: {name}() references donated bank "
+                        f"operand `{donated}` after the bank dispatch "
+                        f"(+{off + 1} lines below it) — use-after-donate"
+                    )
+    if dispatch_sites == 0:
+        found.append(
+            "engine/train.py: no `self._bank_jit(...)` dispatch site found "
+            "— the async bank pipeline lost its donation boundary"
+        )
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = findings(root)
+    for f in found:
+        print(f)
+    if found:
+        return 1
+    print("check_bank_donation: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -75,14 +75,22 @@ def main() -> None:
     # strict: the flop count IS this script's output — fail fast rather than
     # print a plausible-looking zero (bench.py uses the same helper lenient,
     # because for it MFU is a best-effort extra)
-    train_flops = flops_from_cost_analysis(
-        trainer._train_step.lower(
-            state, imgs, lbls, jnp.zeros((batch,), jnp.uint32),
-            jnp.asarray(1.0, jnp.float32), jnp.asarray(True, bool),
-            warm=False,
-        ).compile(),
-        strict=True,
-    )
+    train_compiled = trainer._train_step.lower(
+        state, imgs, lbls, jnp.zeros((batch,), jnp.uint32),
+        jnp.asarray(1.0, jnp.float32), jnp.asarray(True, bool),
+        warm=False,
+    ).compile()
+    train_flops = flops_from_cost_analysis(train_compiled, strict=True)
+    # compiled-module peak bytes — the quantity the HBM planner
+    # (perf/planner.py) budgets against; reported here so the analytic
+    # pre-registration and the auto-tuner can be cross-checked per batch.
+    # Best-effort: a PJRT plugin without memory analysis just omits it.
+    try:
+        from mgproto_tpu.perf.planner import _program_peak
+
+        train_peak_bytes, _ = _program_peak(train_compiled)
+    except Exception:
+        train_peak_bytes = None
     eval_flops = flops_from_cost_analysis(
         trainer._eval_step.lower(state, imgs, lbls).compile(), strict=True
     )
@@ -92,6 +100,7 @@ def main() -> None:
         "arch": cfg.model.arch,
         "batch": batch,
         "train_flops_per_step": train_flops,
+        "train_peak_bytes": train_peak_bytes,
         "train_gflops_per_image": round(per_img / 1e9, 2),
         "eval_gflops_per_image": round(eval_flops / batch / 1e9, 2),
         "v5e_imgs_per_sec_chip_at_mfu": {

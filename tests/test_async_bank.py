@@ -1,0 +1,744 @@
+"""Async bank pipeline + HBM-budget auto-tuner (ISSUE 6).
+
+Covers: flag OFF bit-exactness against a hand-rolled monolithic oracle,
+flag ON parity against a hand-rolled ONE-STEP-STALE oracle (first steps
+exact, short synthetic run convergent), the sharded dryrun-multichip case,
+zero steady-state recompiles with the pipeline on, train_epoch's pipeline
+flush, the planner against a simulated 16 GB budget, the `--auto_tune`
+e2e on the CPU backend, `bench.py --measure overlap`'s contract, the
+`--prefetch-depth 0` regression, the bank-donation lint, and the telemetry
+pre-registration/summarize wiring.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import prefill_full_memory
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.core.em import bank_update
+from mgproto_tpu.core.state import BankState, merge_state, split_state
+from mgproto_tpu.engine.train import Trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = 4
+
+
+def _cfg(async_bank=None):
+    cfg = tiny_test_config()
+    return cfg.replace(em=dataclasses.replace(cfg.em, async_bank=async_bank))
+
+
+def _batches(n, seed=0, img=32, classes=4):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(BATCH, img, img, 3), jnp.float32),
+            jnp.asarray(rng.randint(0, classes, size=(BATCH,)), jnp.int32),
+        )
+        for _ in range(n)
+    ]
+
+
+# ------------------------------------------------------------ OFF: bit-exact
+def test_async_off_bit_exact_to_monolithic_oracle():
+    """Flag OFF must be bit-exact to the pre-split step semantics: a
+    hand-rolled trunk-then-bank composition (the exact op sequence of the
+    old monolithic `_step`) reproduces train_step's outputs bit for bit."""
+    cfg = _cfg(async_bank=False)
+    tr = Trainer(cfg, steps_per_epoch=4)
+    assert tr.async_bank is False
+    state = prefill_full_memory(tr.init_state(jax.random.PRNGKey(0)))
+
+    @jax.jit
+    def oracle_step(st, imgs, lbls):
+        # hand-rolled: trunk phase then bank phase, fused into ONE program
+        # exactly like the pre-split monolithic step was
+        trunk0, bank0 = split_state(st)
+        seeds = jnp.zeros((BATCH,), jnp.uint32)
+        new_trunk, out = tr._trunk_step(
+            trunk0, bank0.gmm, imgs, lbls, seeds,
+            jnp.asarray(1.0, jnp.float32), warm=False,
+        )
+        g, mem, popt, _ = bank_update(
+            bank0.gmm, bank0.memory, bank0.proto_opt_state,
+            tr.proto_tx, tr._em_cfg,
+            out.enq_feats, out.enq_classes, out.enq_valid,
+            out.step0, jnp.asarray(True), out.finite,
+        )
+        return merge_state(new_trunk, BankState(g, mem, popt))
+
+    oracle_state = state
+    for imgs, lbls in _batches(3):
+        state, m = tr.train_step(
+            state, imgs, lbls, use_mine=True, update_gmm=True
+        )
+        oracle_state = oracle_step(oracle_state, imgs, lbls)
+
+        np.testing.assert_array_equal(
+            np.asarray(state.gmm.means), np.asarray(oracle_state.gmm.means)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state.memory.feats),
+            np.asarray(oracle_state.memory.feats),
+        )
+        assert np.isfinite(float(m.loss))
+    # params trained identically too
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    oleaf = jax.tree_util.tree_leaves(oracle_state.params)[0]
+    np.testing.assert_array_equal(np.asarray(leaf), np.asarray(oleaf))
+
+
+# ---------------------------------------------------- ON: one-step-stale
+def _oracle_one_step_stale(tr, state, batches, use_mine=True,
+                           update_gmm=True):
+    """Hand-rolled one-step-stale schedule, no pipeline code: trunk n
+    scores the gmm as of bank n-2; bank n-1 applies AFTER trunk n; the
+    final held bank flushes at the end. Returns (state, per-step TrunkOut
+    list)."""
+    trunk, bank = split_state(state)
+    stale_gmm = bank.gmm  # what the next trunk scores against
+    pending = None
+    outs = []
+    um = jnp.asarray(float(use_mine), jnp.float32)
+    ug = jnp.asarray(bool(update_gmm))
+    for imgs, lbls in batches:
+        seeds = jnp.zeros((imgs.shape[0],), jnp.uint32)
+        trunk, out = tr._trunk_step(
+            trunk, stale_gmm, imgs, lbls, seeds, um, warm=False
+        )
+        if pending is not None:
+            g, m, p, _ = bank_update(
+                bank.gmm, bank.memory, bank.proto_opt_state,
+                tr.proto_tx, tr._em_cfg, *pending,
+            )
+            bank = BankState(g, m, p)
+        stale_gmm = bank.gmm
+        pending = (out.enq_feats, out.enq_classes, out.enq_valid,
+                   out.step0, ug, out.finite)
+        outs.append(out)
+    if pending is not None:
+        g, m, p, _ = bank_update(
+            bank.gmm, bank.memory, bank.proto_opt_state,
+            tr.proto_tx, tr._em_cfg, *pending,
+        )
+        bank = BankState(g, m, p)
+    return merge_state(trunk, bank), outs
+
+
+def test_async_on_matches_one_step_stale_oracle_first_steps():
+    """First 3 pipelined steps match the hand-rolled one-step-stale oracle:
+    per-step trunk losses and the flushed final state."""
+    cfg = _cfg(async_bank=True)
+    tr = Trainer(cfg, steps_per_epoch=4, donate=True)
+    assert tr.async_bank is True
+    state0 = prefill_full_memory(tr.init_state(jax.random.PRNGKey(0)))
+
+    oracle_tr = Trainer(_cfg(async_bank=False), steps_per_epoch=4)
+    oracle0 = prefill_full_memory(oracle_tr.init_state(jax.random.PRNGKey(0)))
+    batches = _batches(3)
+    oracle_state, oracle_outs = _oracle_one_step_stale(
+        oracle_tr, oracle0, batches
+    )
+
+    state = state0
+    losses = []
+    for imgs, lbls in batches:
+        state, m = tr.train_step(
+            state, imgs, lbls, use_mine=True, update_gmm=True
+        )
+        losses.append(float(m.loss))
+    state, flushed = tr.flush_bank(state)
+    assert flushed is not None  # the last bank program really was held
+
+    for got, out in zip(losses, oracle_outs):
+        np.testing.assert_allclose(got, float(out.loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state.gmm.means), np.asarray(oracle_state.gmm.means),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(state.gmm.priors), np.asarray(oracle_state.gmm.priors),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.memory.length),
+        np.asarray(oracle_state.memory.length),
+    )
+    assert int(state.step) == 3
+
+
+def test_async_staleness_is_exactly_one_step():
+    """After step 2 (no flush), the pipelined state's gmm equals the SYNC
+    run's gmm after step 1 — the lag is exactly one bank application."""
+    sync_tr = Trainer(_cfg(async_bank=False), steps_per_epoch=4)
+    async_tr = Trainer(_cfg(async_bank=True), steps_per_epoch=4)
+    s_sync = prefill_full_memory(sync_tr.init_state(jax.random.PRNGKey(0)))
+    s_async = prefill_full_memory(async_tr.init_state(jax.random.PRNGKey(0)))
+    batches = _batches(2)
+    for imgs, lbls in batches[:1]:
+        s_sync1, _ = sync_tr.train_step(
+            s_sync, imgs, lbls, use_mine=True, update_gmm=True
+        )
+    for imgs, lbls in batches:
+        s_async, _ = async_tr.train_step(
+            s_async, imgs, lbls, use_mine=True, update_gmm=True
+        )
+    np.testing.assert_allclose(
+        np.asarray(s_async.gmm.means), np.asarray(s_sync1.gmm.means),
+        rtol=1e-6, atol=1e-7,
+    )
+
+
+def test_async_converges_on_short_synthetic_run():
+    """Over a short synthetic run the one-step-stale trajectory stays close
+    to the synchronous one: finite throughout, loss decreased, and the
+    final loss within a loose tolerance of the sync run's."""
+    batches = _batches(8, seed=3)
+
+    def run(async_bank):
+        tr = Trainer(_cfg(async_bank=async_bank), steps_per_epoch=8,
+                     donate=async_bank)
+        st = prefill_full_memory(tr.init_state(jax.random.PRNGKey(0)))
+        losses = []
+        for imgs, lbls in batches:
+            st, m = tr.train_step(
+                st, imgs, lbls, use_mine=True, update_gmm=True
+            )
+            losses.append(float(m.loss))
+        st, _ = tr.flush_bank(st)
+        return st, losses
+
+    _, sync_losses = run(False)
+    _, async_losses = run(True)
+    assert all(np.isfinite(v) for v in async_losses)
+    assert async_losses[-1] < async_losses[0]  # it is learning
+    np.testing.assert_allclose(
+        async_losses[-1], sync_losses[-1],
+        rtol=0.05, atol=0.05,
+    )
+
+
+def test_async_sharded_dryrun_multichip():
+    """ShardedTrainer splits the same way: the pipelined sharded run on the
+    virtual 8-device mesh (class axis sharded over 'model') matches the
+    single-device pipelined run — enqueue sees the global batch and the
+    psum'd EM statistics stay correct under one-step staleness."""
+    from mgproto_tpu.parallel import ShardedTrainer, make_mesh
+
+    cfg = _cfg(async_bank=True)
+    ref = Trainer(cfg, steps_per_epoch=4)
+    sh = ShardedTrainer(cfg, steps_per_epoch=4, mesh=make_mesh(model=2))
+    state0 = prefill_full_memory(ref.init_state(jax.random.PRNGKey(0)))
+    state_sh = sh.prepare(state0)
+
+    s1, s2 = state0, state_sh
+    for imgs, lbls in _batches(3, seed=5, classes=4):
+        s1, m1 = ref.train_step(s1, imgs, lbls, use_mine=True,
+                                update_gmm=True)
+        s2, m2 = sh.train_step(s2, np.asarray(imgs), np.asarray(lbls),
+                               use_mine=True, update_gmm=True)
+        np.testing.assert_allclose(
+            float(m1.loss), float(jax.device_get(m2.loss)), rtol=2e-5
+        )
+    s1, f1 = ref.flush_bank(s1)
+    s2, f2 = sh.flush_bank(s2)
+    assert f1 is not None and f2 is not None
+    np.testing.assert_array_equal(
+        jax.device_get(s1.memory.length), jax.device_get(s2.memory.length)
+    )
+    np.testing.assert_allclose(
+        jax.device_get(s1.gmm.means), jax.device_get(s2.gmm.means),
+        rtol=2e-5, atol=2e-6,
+    )
+
+
+def test_async_zero_steady_state_recompiles():
+    """With the pipeline on, steady state runs exactly two compiled
+    programs (trunk + bank): varied labels/gates never retrace."""
+    from mgproto_tpu.telemetry import MetricRegistry, StepMonitor
+
+    tr = Trainer(_cfg(async_bank=True), steps_per_epoch=4, donate=True)
+    state = prefill_full_memory(tr.init_state(jax.random.PRNGKey(0)))
+    reg = MetricRegistry()
+    mon = StepMonitor(registry=reg)
+    mon.watch(lambda: tr.jit_handles)
+
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.rand(BATCH, 32, 32, 3), jnp.float32)
+    # warmup: first call compiles the trunk, second the bank program
+    for labels in ([0, 1, 2, 3], [0, 0, 1, 1]):
+        state, _ = tr.train_step(
+            state, imgs, jnp.asarray(labels), use_mine=True, update_gmm=True
+        )
+    warm = mon.check_recompiles()
+    assert warm >= 2  # trunk + bank first compiles register as misses
+    for labels, gmm_on in (
+        ([3, 2, 1, 0], True), ([1, 1, 1, 1], False), ([0, 2, 0, 2], True)
+    ):
+        state, m = tr.train_step(
+            state, imgs, jnp.asarray(labels), use_mine=True,
+            update_gmm=gmm_on,
+        )
+        assert np.isfinite(float(m.loss))
+    state, _ = tr.flush_bank(state)
+    assert mon.check_recompiles() == 0
+
+
+def test_train_epoch_flushes_bank_and_matches_sync_lengths():
+    """train_epoch drains the pipeline on exit: after one epoch the async
+    run's memory contents match the sync run's (no enqueue lost), the
+    epoch metrics carry the flushed bank scalars, and the monitor's
+    overlap gauge exists (the single owner of that metric)."""
+    from mgproto_tpu.telemetry import MetricRegistry, StepMonitor
+
+    batches = _batches(4, seed=7)
+
+    def run_epoch(async_bank):
+        tr = Trainer(_cfg(async_bank=async_bank), steps_per_epoch=4)
+        st = prefill_full_memory(tr.init_state(jax.random.PRNGKey(0)))
+        reg = MetricRegistry()
+        mon = StepMonitor(registry=reg)
+        st, last = tr.train_epoch(st, iter(batches), epoch=0, monitor=mon)
+        return tr, st, last, reg
+
+    _, s_sync, last_sync, reg_sync = run_epoch(False)
+    tr_async, s_async, last_async, reg_async = run_epoch(True)
+    assert tr_async._held_enq is None  # drained
+    np.testing.assert_array_equal(
+        np.asarray(s_sync.memory.length), np.asarray(s_async.memory.length)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s_sync.memory.cursor), np.asarray(s_async.memory.cursor)
+    )
+    # epoch max of em_active includes the flushed final bank program
+    assert int(last_async.em_active) == int(last_sync.em_active)
+
+    def overlap(reg):
+        s = reg.snapshot()["bank_dispatch_overlap_fraction"]["series"]
+        return max(x["value"] for x in s)
+
+    assert overlap(reg_sync) == 0.0  # sync mode: nothing in flight, ever
+    assert overlap(reg_async) >= 0.0
+
+
+# ------------------------------------------------------------------ planner
+SIXTEEN_GB = 16 * 1024**3
+
+
+def _fake_measure(peaks):
+    def measure(cand):
+        return peaks[cand.name], {"simulated": True}
+    return measure
+
+
+def test_planner_simulated_16gb_budget():
+    """The ISSUE acceptance matrix: batch 256 fits, batch 512 without remat
+    is rejected, and a fused_b512_remat_l1-shaped plan is accepted (and
+    preferred, being the largest fitting batch)."""
+    from mgproto_tpu.perf.planner import HBMPlanner, PlanCandidate
+
+    b256 = PlanCandidate(batch=256)
+    b512 = PlanCandidate(batch=512)
+    b512_l1 = PlanCandidate(batch=512, remat_stages=("layer1",))
+    peaks = {
+        b256.name: int(10.0e9),
+        b512.name: int(20.0e9),  # the r4 DNF: over even the raw budget
+        b512_l1.name: int(13.0e9),
+    }
+    planner = HBMPlanner(
+        budget_bytes=SIXTEEN_GB, margin=0.08, measure=_fake_measure(peaks)
+    )
+
+    # without the remat variant: 512 is rejected, 256 is the plan
+    out = planner.plan(None, [b256, b512])
+    assert out.chosen.candidate == b256
+    assert out.rejected == 1
+    assert not [r for r in out.reports if r.candidate == b512][0].fits
+
+    # with the remat variant: the fused_b512_remat_l1 shape wins
+    out = planner.plan(None, [b256, b512, b512_l1])
+    assert out.chosen.candidate == b512_l1
+    assert out.rejected == 1
+    meta = out.to_meta()
+    assert meta["plan"]["batch"] == 512
+    assert meta["plan"]["remat_stages"] == ["layer1"]
+    assert len(meta["candidates"]) == 3
+
+
+def test_planner_margin_env_and_no_fit(monkeypatch):
+    """MGPROTO_HBM_MARGIN tightens the effective budget; when nothing fits
+    the outcome has no chosen plan (autotune then keeps the base config)."""
+    from mgproto_tpu.perf.planner import HBMPlanner, PlanCandidate, autotune
+
+    cand = PlanCandidate(batch=256)
+    peaks = {cand.name: int(15.0e9)}
+    monkeypatch.setenv("MGPROTO_HBM_MARGIN", "0.5")
+    planner = HBMPlanner(
+        budget_bytes=SIXTEEN_GB, measure=_fake_measure(peaks)
+    )
+    assert planner.margin == 0.5
+    out = planner.plan(None, [cand])
+    assert out.chosen is None and out.rejected == 1
+
+    # autotune falls back to the unchanged config
+    cfg = tiny_test_config()
+    cfg2, outcome = autotune(
+        cfg, budget_bytes=SIXTEEN_GB,
+        candidates=[cand], measure=_fake_measure(peaks),
+    )
+    assert outcome.chosen is None
+    assert cfg2 == cfg
+
+
+def test_planner_measure_failure_counts_as_rejection():
+    """A candidate whose measurement raises (the compile-time analogue of
+    the DNF) is reported as over budget with the error string."""
+    from mgproto_tpu.perf.planner import HBMPlanner, PlanCandidate
+
+    def measure(cand):
+        if cand.batch == 512:
+            raise RuntimeError("simulated compile blowup")
+        return int(1e9), {}
+
+    planner = HBMPlanner(budget_bytes=SIXTEEN_GB, margin=0.0,
+                         measure=measure)
+    out = planner.plan(
+        None, [PlanCandidate(batch=256), PlanCandidate(batch=512)]
+    )
+    assert out.chosen.candidate.batch == 256
+    bad = [r for r in out.reports if r.candidate.batch == 512][0]
+    assert not bad.fits and "simulated compile blowup" in bad.error
+
+
+def test_planner_prefetch_variants_rescue_tight_budget(monkeypatch):
+    """The candidate ladder includes prefetch-0 variants, and they cost no
+    extra compile: when only the prefetch headroom is over budget, the pf0
+    plan wins instead of 'nothing fits'."""
+    from mgproto_tpu.perf import planner as planner_mod
+    from mgproto_tpu.perf.planner import (
+        HBMPlanner, candidate_plans, make_cached_measure,
+    )
+
+    cfg = tiny_test_config()
+    cands = candidate_plans(cfg, batches=[8])
+    assert {c.prefetch_depth for c in cands} == {2, 0}
+    assert {c.batch for c in cands} == {8}
+
+    calls = []
+    real = planner_mod.measure_candidate
+
+    def counting(base_cfg, cand):
+        calls.append(cand)
+        return real(base_cfg, cand)
+
+    monkeypatch.setattr(planner_mod, "measure_candidate", counting)
+    measure = make_cached_measure(cfg)
+    b8 = [c for c in cands if c.batch == 8]
+    peaks = {c.prefetch_depth: measure(c)[0] for c in b8}
+    assert len(calls) == 1  # pf variants share one compiled measurement
+    headroom = peaks[2] - peaks[0]
+    assert headroom > 0
+
+    # budget between the pf0 and pf2 peaks: pf2 rejected, pf0 chosen
+    planner = HBMPlanner(
+        budget_bytes=peaks[0] + headroom // 2, margin=0.0, measure=measure
+    )
+    out = planner.plan(cfg, b8)
+    assert out.chosen.candidate.prefetch_depth == 0
+    assert out.rejected == 1
+
+
+def test_planner_real_measure_on_tiny_config():
+    """The default (compile-based) measure produces a positive peak with
+    the documented breakdown, async candidates sum trunk+bank programs,
+    and apply_plan projects the choice back onto the config."""
+    from mgproto_tpu.perf.planner import (
+        PlanCandidate, apply_plan, batch_bytes, measure_candidate,
+    )
+
+    cfg = tiny_test_config()
+    sync_peak, det = measure_candidate(cfg, PlanCandidate(batch=8))
+    assert sync_peak > 0 and det["program_peak_bytes"] > 0
+    # HBM is per-chip: the GLOBAL batch 8 is measured at its data-axis
+    # share (8 virtual devices -> per-chip batch 1), prefetch included
+    assert det["per_chip_batch"] == 1
+    assert det["prefetch_headroom_bytes"] == 2 * batch_bytes(1, 32, False)
+    assert det["bank_bytes_analytic"] > 0
+
+    async_peak, adet = measure_candidate(
+        cfg, PlanCandidate(batch=8, async_bank=True)
+    )
+    assert adet["trunk_peak_bytes"] > 0 and adet["bank_peak_bytes"] > 0
+    assert async_peak > 0
+
+    cand = PlanCandidate(batch=16, prefetch_depth=0, async_bank=True)
+    cfg2 = apply_plan(cfg, cand)
+    assert cfg2.data.train_batch_size == 16
+    assert cfg2.data.prefetch_depth == 0
+    assert cfg2.em.async_bank is True
+
+
+def test_autotune_cli_e2e_records_plan(tmp_path):
+    """`mgproto-train --auto_tune` on the CPU backend: selects a plan with
+    no trial-and-error OOM, trains under it, and records the plan + every
+    candidate's predicted peak in telemetry meta.json."""
+    from PIL import Image
+
+    from mgproto_tpu.cli.train import run_training
+    from mgproto_tpu.config import DataConfig
+
+    data_root = tmp_path / "data"
+    rng = np.random.RandomState(0)
+    for split, per_class in (("train", 12), ("test", 3)):
+        for c in range(4):
+            d = data_root / split / f"{c:03d}.class_{c}"
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(per_class):
+                arr = rng.randint(0, 255, size=(40, 40, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"img_{i}.jpg")
+
+    cfg = tiny_test_config()
+    cfg = cfg.replace(
+        schedule=dataclasses.replace(
+            cfg.schedule, num_train_epochs=1, push_start=5
+        ),
+        data=DataConfig(
+            train_dir=str(data_root / "train"),
+            test_dir=str(data_root / "test"),
+            train_push_dir=str(data_root / "train"),
+            train_batch_size=8,
+            test_batch_size=8,
+            train_push_batch_size=8,
+            num_workers=2,
+        ),
+        model_dir=str(tmp_path / "run"),
+    )
+    state, accu = run_training(cfg, auto_tune=True)
+    meta_path = tmp_path / "run" / "telemetry" / "meta.json"
+    assert meta_path.is_file()
+    meta = json.loads(meta_path.read_text())
+    plan = meta["autotune"]["plan"]
+    assert plan is not None and plan["fits"]
+    # the ladder is {8, 16, 32} x prefetch {2, 0} and everything fits the
+    # default budget: the largest batch wins at the DEEPER prefetch (pf0
+    # only wins when the headroom is what did not fit)
+    assert plan["batch"] == 32
+    assert plan["prefetch_depth"] == 2
+    assert len(meta["autotune"]["candidates"]) == 6
+    assert all(
+        c["peak_bytes"] > 0 for c in meta["autotune"]["candidates"]
+    )
+    assert "async_bank" in meta
+    assert int(state.step) >= 1
+
+    # summarize renders the autotune line in the meta section
+    from mgproto_tpu.cli.telemetry import render_table, summarize
+
+    summary = summarize(str(tmp_path / "run" / "telemetry"))
+    assert summary["meta"]["autotune"]["plan"]["batch"] == 32
+    table = render_table(summary)
+    assert "autotune" in table and "plan=b32" in table
+
+    # checkpoints carry the plan, and a resumed --auto_tune run ADOPTS it
+    # instead of re-planning (a budget change must not desync the resume)
+    from mgproto_tpu.utils.checkpoint import find_latest_checkpoint, load_metadata
+
+    ckpt = find_latest_checkpoint(str(tmp_path / "run"))
+    saved = (load_metadata(ckpt) or {}).get("autotune_plan")
+    assert saved and saved["batch"] == 32
+    run_training(cfg, resume="auto", auto_tune=True)
+    log_text = (tmp_path / "run" / "train.log").read_text()
+    assert "adopts checkpointed plan" in log_text
+
+
+def test_plan_serve_buckets(monkeypatch):
+    """`mgproto-serve --auto_tune`: buckets are sized by the same memory
+    model — everything fits the default budget, nothing fits a 1-byte one
+    (and the rejections are counted for telemetry)."""
+    from mgproto_tpu.perf.planner import plan_serve_buckets
+    from mgproto_tpu.serving.engine import ServingEngine
+
+    tr = Trainer(tiny_test_config(), steps_per_epoch=1)
+    state = tr.init_state(jax.random.PRNGKey(0))
+    eng = ServingEngine.from_live(tr, state, buckets=(1, 2, 4))
+    fitting, outcome = plan_serve_buckets(eng)
+    assert fitting == [1, 2, 4] and outcome.rejected == 0
+
+    monkeypatch.setenv("MGPROTO_HBM_BUDGET_BYTES", "1")
+    fitting, outcome = plan_serve_buckets(eng)
+    assert fitting == [] and outcome.rejected == 3
+
+
+# ------------------------------------------------------- bench + prefetch
+def test_bench_measure_overlap_contract():
+    """`bench.py --measure overlap` emits one JSON line showing the bank's
+    bytes off the trunk's critical path and the donation peak saving (the
+    ISSUE acceptance metrics), hermetically on CPU."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+        BENCH_OVERLAP_CLASSES="16", BENCH_OVERLAP_CAP="64",
+        BENCH_OVERLAP_BATCH="8", BENCH_OVERLAP_DIM="32",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--measure", "overlap"],
+        capture_output=True, text=True, env=env, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "trunk_bank_overlap_cost_analysis"
+    for key in ("monolithic", "trunk", "bank_donated", "bank_undonated"):
+        assert line[key]["bytes_accessed"] and line[key]["peak_bytes"]
+    # the bank phase's bytes left the critical path...
+    assert line["trunk_bytes_removed_from_critical_path"] > 0
+    assert (
+        line["trunk"]["bytes_accessed"]
+        < line["monolithic"]["bytes_accessed"]
+    )
+    # ...and donation shrinks the bank program's peak
+    assert (
+        line["bank_donated"]["peak_bytes"]
+        < line["bank_undonated"]["peak_bytes"]
+    )
+
+
+def test_prefetch_depth_zero_disables_cleanly():
+    """--prefetch-depth 0 regression: no queue, no lookahead — each batch
+    is placed exactly when the consumer asks and yielded immediately, and
+    the stream content matches the synchronous path."""
+    from mgproto_tpu.data.loader import device_prefetch
+
+    placed = []
+    gen = device_prefetch(iter(range(5)), lambda b: placed.append(b) or b,
+                          depth=0)
+    assert placed == []  # fully lazy: nothing placed before the first pull
+    out = []
+    for i in range(3):
+        out.append(next(gen))
+        # exactly one placement per yielded batch — depth 0 never holds a
+        # placed batch in flight (the old code queued through a deque)
+        assert placed == list(range(i + 1))
+    assert out == [0, 1, 2]
+    assert list(gen) == [3, 4]
+    assert placed == [0, 1, 2, 3, 4]
+
+
+def test_prefetch_depth_two_still_prefetches():
+    """The depth>0 path is unchanged: depth 2 holds one placed batch in
+    flight ahead of the consumer."""
+    from mgproto_tpu.data.loader import device_prefetch
+
+    placed = []
+    gen = device_prefetch(iter(range(4)), lambda b: placed.append(b) or b,
+                          depth=2)
+    assert next(gen) == 0
+    assert placed == [0, 1]  # one batch ahead
+    assert list(gen) == [1, 2, 3]
+
+
+def test_async_bank_cli_plumbing():
+    """--async_bank / --no_async_bank / --auto_tune reach the config."""
+    import argparse
+
+    from mgproto_tpu.cli.common import add_train_args, config_from_args
+
+    p = argparse.ArgumentParser()
+    add_train_args(p)
+    cfg = config_from_args(p.parse_args([]))
+    assert cfg.em.async_bank is None  # auto
+    cfg = config_from_args(p.parse_args(["--async_bank"]))
+    assert cfg.em.async_bank is True
+    cfg = config_from_args(p.parse_args(["--no_async_bank"]))
+    assert cfg.em.async_bank is False
+    args = p.parse_args(["--auto_tune"])
+    assert args.auto_tune is True
+
+
+# ------------------------------------------------------------- lint wiring
+def test_check_bank_donation_lint_is_clean():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_bank_donation.py"), REPO],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_bank_donation_detects_violation():
+    """The lint must fire on a host read of the donated operand after the
+    dispatch line (guards against the check rotting into a no-op)."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_bank_donation as lint
+    finally:
+        sys.path.pop(0)
+
+    bad = (
+        "def _dispatch_pending_bank(self, bank):\n"
+        "    new_bank, out = self._bank_jit(bank, *held)\n"
+        "    x = bank.memory  # use-after-donate!\n"
+        "    return new_bank, out\n"
+    )
+    found = lint.findings(REPO, source=bad)
+    assert any("use-after-donate" in f for f in found)
+
+    # and the structural guard: no dispatch site at all is also a finding
+    found = lint.findings(REPO, source="def f():\n    return 1\n")
+    assert any("no `self._bank_jit" in f for f in found)
+
+    # clean source passes
+    good = (
+        "def _dispatch_pending_bank(self, bank):\n"
+        "    new_bank, out = self._bank_jit(bank, *held)\n"
+        "    return new_bank, out\n"
+    )
+    assert lint.findings(REPO, source=good) == []
+
+
+# --------------------------------------------------------------- telemetry
+def test_session_preregisters_bank_and_autotune_metrics(tmp_path):
+    """bank_dispatch_overlap_fraction / autotune_plan_rejected_total exist
+    from session birth; observe_autotune lands the plan in meta.json and
+    counts rejections; summarize shows them in the "em" section."""
+    from mgproto_tpu.cli.telemetry import render_table, summarize
+    from mgproto_tpu.perf.planner import HBMPlanner, PlanCandidate
+    from mgproto_tpu.telemetry.session import TelemetrySession
+
+    sess = TelemetrySession(str(tmp_path), primary=True)
+    snap = sess.registry.snapshot()
+    assert "bank_dispatch_overlap_fraction" in snap
+    assert "autotune_plan_rejected_total" in snap
+
+    planner = HBMPlanner(
+        budget_bytes=SIXTEEN_GB, margin=0.0,
+        measure=_fake_measure({
+            PlanCandidate(batch=256).name: int(1e9),
+            PlanCandidate(batch=512).name: int(99e9),
+        }),
+    )
+    outcome = planner.plan(
+        None, [PlanCandidate(batch=256), PlanCandidate(batch=512)]
+    )
+    sess.observe_autotune(outcome)
+    sess.monitor.observe_step(4, 0.1, bank_overlap_seconds=0.05)
+    sess.flush(step=1)
+    sess.close()
+
+    summary = summarize(str(tmp_path))
+    assert summary["em"]["autotune_plan_rejected_total"] == 1
+    assert summary["em"]["bank_dispatch_overlap_fraction"] == 0.5
+    assert summary["meta"]["autotune"]["plan"]["batch"] == 256
+    table = render_table(summary)
+    assert "bank_dispatch_overlap_fraction" in table
+    assert "plan=b256" in table
