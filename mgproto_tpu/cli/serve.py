@@ -1,9 +1,23 @@
 """Serving driver: trust-gated inference over an artifact or checkpoint.
 
-`mgproto-serve` is the batch/stdin face of `serving.ServingEngine` — the
-same engine a network frontend would embed, with zero network dependency
-(tier-1 testable). One JSON line per request response, plus one final
-summary line (counts by outcome, abstain rate, breaker/health state).
+`mgproto-serve` has two faces over the same `serving` subsystem:
+
+  * BATCH/STDIN (default) — answer --images npy batches and/or --stdin
+    JSONL requests, one JSON response line each plus a final summary line.
+    `--replicas N` serves the batch through the replica-supervised plane;
+    `--swap NEW.mgproto` performs a mid-batch blue/green hot swap drill
+    (fail-closed: an unverifiable artifact is refused and the old model
+    keeps serving; the report is printed as its own JSON line).
+  * NETWORK (`--listen HOST:PORT`) — the asyncio HTTP frontend
+    (serving/frontend.py): continuous micro-batching into the warmed
+    buckets, `--replicas N` supervised workers, POST /v1/predict,
+    /healthz, /readyz, /metrics, and POST /admin/swap for blue/green
+    promotion. Stdlib only.
+
+Both faces drain gracefully: SIGTERM/SIGINT (resilience/preemption.py's
+`install_handlers`, the one permitted signal-handler site) stops admission
+and answers or sheds EVERY queued request with a typed response before the
+process exits — no silently dropped requests.
 
     # exported artifact (calibration embedded by `mgproto-export --calibrate`)
     mgproto-serve --artifact model.mgproto --images batch.npy
@@ -13,6 +27,9 @@ summary line (counts by outcome, abstain rate, breaker/health state).
 
     # stdin JSONL: {"id": "...", "image": [[[...]]]} per line
     mgproto-serve --artifact model.mgproto --stdin < requests.jsonl
+
+    # network serving plane: 2 replicas behind an HTTP frontend
+    mgproto-serve --artifact model.mgproto --listen 0.0.0.0:8000 --replicas 2
 
 An artifact without calibration.json refuses to serve unless
 `--allow-uncalibrated`, which drops to DEGRADED mode: classification
@@ -25,7 +42,8 @@ import argparse
 import json
 import os
 import sys
-from typing import Optional
+import time
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -66,11 +84,8 @@ def _load_payloads(args):
     return payloads, ids
 
 
-def build_engine(args, monitor: Optional[StepMonitor] = None):
-    """Engine from --artifact, or from a checkpoint via the train flags."""
-    from mgproto_tpu.serving.engine import ServingEngine
-
-    kw = dict(
+def _engine_kw(args, monitor: Optional[StepMonitor] = None):
+    return dict(
         buckets=_parse_buckets(args.buckets),
         percentile=args.percentile,
         queue_capacity=args.queue_capacity,
@@ -79,10 +94,38 @@ def build_engine(args, monitor: Optional[StepMonitor] = None):
         ),
         monitor=monitor,
     )
+
+
+def make_engine_factory(
+    args, monitor_factory: Optional[Callable[[], StepMonitor]] = None
+) -> Callable:
+    """An engine factory for the replica supervisor: each call builds an
+    independent engine (own jit cache, queue, breaker) over SHARED heavy
+    state — the artifact path, or the restored checkpoint + calibration
+    loaded exactly once here."""
+    from mgproto_tpu.serving.engine import ServingEngine
+
+    def _kw():
+        # read the serve knobs at CALL time, not factory-creation time:
+        # --auto_tune shrinks args.buckets after the factory exists, and
+        # every engine the factory builds (probe, fleet, restart) must
+        # agree on the warmed bucket set
+        kw = _engine_kw(args)
+        kw.pop("monitor")
+        return kw
+
+    def _monitor():
+        return monitor_factory() if monitor_factory is not None else None
+
     if args.artifact:
-        return ServingEngine.from_artifact(
-            args.artifact, allow_uncalibrated=args.allow_uncalibrated, **kw
-        )
+        path, allow = args.artifact, args.allow_uncalibrated
+
+        def factory():
+            return ServingEngine.from_artifact(
+                path, allow_uncalibrated=allow, monitor=_monitor(), **_kw()
+            )
+
+        return factory
 
     import jax
 
@@ -118,7 +161,75 @@ def build_engine(args, monitor: Optional[StepMonitor] = None):
             "thresholds from --test_dir) or --allow-uncalibrated "
             "(degraded mode, no OoD abstention)"
         )
-    return ServingEngine.from_live(trainer, state, calibration=calib, **kw)
+
+    def factory():
+        return ServingEngine.from_live(
+            trainer, state, calibration=calib, monitor=_monitor(), **_kw()
+        )
+
+    return factory
+
+
+def build_engine(args, monitor: Optional[StepMonitor] = None):
+    """One engine from --artifact, or from a checkpoint via the train
+    flags (the single-engine batch path and the auto-tune probe)."""
+    return make_engine_factory(
+        args, monitor_factory=(lambda: monitor) if monitor else None
+    )()
+
+
+# --------------------------------------------------------------- batch faces
+def drive_batch_engine(engine, payloads, ids, handler) -> List:
+    """Single-engine batch driver with graceful drain: `serve_all` owns
+    the submit/pump/order invariant, the preemption flag turns its exit
+    graceful (queued work shed typed, unsubmitted payloads answered too)."""
+    return engine.serve_all(
+        payloads,
+        request_ids=ids,
+        should_stop=handler.requested if handler is not None else None,
+    )
+
+
+def drive_batch_plane(
+    replica_set, payloads, ids, handler,
+    swap_at: Optional[int] = None, swap_factory: Optional[Callable] = None,
+    require_calibrated: bool = True,
+) -> Tuple[List, List]:
+    """Replica-plane batch driver: (responses, swap_reports). The swap
+    drill fires before request `swap_at` is submitted — queued requests
+    transfer old->new with zero drops, or the swap is refused and the old
+    fleet keeps answering."""
+    from mgproto_tpu.serving.response import shed_response
+    from mgproto_tpu.serving.swap import hot_swap
+
+    order = {rid: i for i, rid in enumerate(ids)}
+    responses = []
+    reports = []
+    unsubmitted: List[str] = []
+    for i, (payload, rid) in enumerate(zip(payloads, ids)):
+        if handler is not None and handler.requested():
+            unsubmitted = list(ids[i:])
+            break
+        if swap_at is not None and i == swap_at and swap_factory is not None:
+            reports.append(hot_swap(
+                replica_set, swap_factory,
+                require_calibrated=require_calibrated,
+            ))
+        responses.extend(replica_set.submit(payload, request_id=rid))
+        responses.extend(replica_set.poll())
+    if handler is not None and handler.requested():
+        responses.extend(replica_set.drain())
+    else:
+        responses.extend(replica_set.flush())
+        # a replica killed/wedged by chaos mid-batch may still hold queued
+        # requests that heartbeat detection never got to reroute (the batch
+        # can finish inside the timeout): answer them typed, never drop
+        responses.extend(replica_set.shed_stranded())
+    responses.extend(shed_response(rid, "shutdown") for rid in unsubmitted)
+    return (
+        sorted(responses, key=lambda r: order.get(r.request_id, len(order))),
+        reports,
+    )
 
 
 CHAOS_SERVE_ENV_HELP = """\
@@ -133,6 +244,15 @@ serving chaos-injection env knobs (fault drills; all off by default):
   MGPROTO_CHAOS_SERVE_STORM_AT        first request index of a deadline
                                       storm (arrives already expired)
   MGPROTO_CHAOS_SERVE_STORM_LEN       number of storm requests
+  MGPROTO_CHAOS_SERVE_REPLICA_KILL_AT admitted-request index at which the
+                                      target replica dies (supervisor
+                                      reroutes + restarts on backoff)
+  MGPROTO_CHAOS_SERVE_WEDGE_AT        same, but the replica wedges
+                                      (present yet unresponsive)
+  MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT
+                                      poison the first N hot-swap attempts
+                                      with a trust-stripped artifact (the
+                                      swap must fail CLOSED)
 """
 
 
@@ -173,6 +293,22 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--calibrate", action="store_true",
                    help="live mode: derive calibration from the --test_dir "
                         "loader before serving")
+    p.add_argument("--listen", default="",
+                   help="HOST:PORT for the asyncio HTTP frontend (network "
+                        "serving plane); empty = batch/stdin mode")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="supervised serving workers behind the frontend "
+                        "(or the batch plane)")
+    p.add_argument("--swap", default="",
+                   help="batch mode: blue/green hot-swap to this .mgproto "
+                        "artifact midway through the batch (fail-closed "
+                        "drill; network mode swaps via POST /admin/swap)")
+    p.add_argument("--linger_ms", type=float, default=20.0,
+                   help="micro-batcher: max wait before a deadline-less "
+                        "request dispatches in a partial batch")
+    p.add_argument("--heartbeat_timeout_s", type=float, default=2.0,
+                   help="replica heartbeat staleness before the supervisor "
+                        "drains + restarts it")
     # NB: add_train_args already contributes --auto_tune; here it sizes the
     # warmup bucket set instead of the train plan (perf/planner.py
     # plan_serve_buckets): over-budget buckets are dropped before warmup
@@ -185,6 +321,14 @@ def main(argv: Optional[list] = None) -> None:
     if chaos_plan is not None:
         chaos_mod.install(chaos_plan)
 
+    # graceful drain (both faces): first SIGTERM/SIGINT sets the flag, the
+    # drivers answer/shed everything typed and exit; a second one kills
+    from mgproto_tpu.resilience.preemption import get_handler, install_handlers
+
+    uninstall = install_handlers()
+    handler = get_handler()
+    handler.reset()
+
     # unlike mgproto-train there is no default telemetry dir (a serve run
     # has no model_dir of its own): telemetry is on when --telemetry-dir is
     telem = make_session(args.telemetry_dir or "", not args.no_telemetry)
@@ -193,59 +337,205 @@ def main(argv: Optional[list] = None) -> None:
         register_serving_metrics(telem.registry)
         monitor = StepMonitor(registry=telem.registry, phase="serve")
 
-    engine = build_engine(args, monitor=monitor)
     try:
-        if args.auto_tune:
-            from mgproto_tpu.perf.planner import plan_serve_buckets
-
-            fitting, outcome = plan_serve_buckets(engine)
-            print(json.dumps({
-                "autotune": True,
-                "buckets": list(fitting),
-                "rejected": outcome.rejected,
-                "budget_bytes": outcome.budget_bytes,
-            }))
-            if telem:
-                telem.observe_autotune(outcome)
-            if not fitting:
-                # fail CLOSED: warming the rejected set would execute the
-                # exact OOM the planner just predicted. Rerun without
-                # --auto_tune (or raise the budget) to override.
-                raise SystemExit(
-                    "auto_tune: no warmup bucket fits the HBM budget "
-                    f"({outcome.budget_bytes} bytes, margin "
-                    f"{outcome.margin}); refusing to warm an over-budget "
-                    "bucket set"
-                )
-            if tuple(fitting) != engine.buckets:
-                engine.buckets = tuple(fitting)
-        compiled = engine.warmup()
-        payloads, ids = _load_payloads(args)
-        responses = engine.serve_all(payloads, request_ids=ids)
-        for r in responses:
-            print(json.dumps(r.to_dict()))
-        from mgproto_tpu.serving.health import HealthProbe
-
-        counts = {}
-        for r in responses:
-            counts[r.outcome] = counts.get(r.outcome, 0) + 1
-        print(json.dumps({
-            "summary": True,
-            "requests": len(responses),
-            "outcomes": counts,
-            "abstain_rate": engine.gate.abstain_rate,
-            "degraded": engine.gate.degraded,
-            "fingerprint_mismatch": engine.gate.fingerprint_mismatch,
-            "warmup_compiles": compiled,
-            "steady_state_recompiles": engine.monitor.recompile_count
-            - compiled,
-            "readiness": HealthProbe(engine).readiness(),
-        }))
+        if args.listen:
+            _main_listen(args, handler, telem)
+        elif args.replicas > 1 or args.swap:
+            _main_batch_plane(args, handler, telem)
+        else:
+            _main_batch_engine(args, handler, telem, monitor)
         if telem:
             telem.flush()
     finally:
+        uninstall()  # leave the embedding process's signal dispositions alone
         if telem:
             telem.close()
+
+
+def _apply_auto_tune(args, engine, telem) -> None:
+    """Shared --auto_tune step: shrink the warmup bucket set to the HBM
+    budget (fail closed on an empty fit) before any bucket compiles."""
+    from mgproto_tpu.perf.planner import plan_serve_buckets
+
+    fitting, outcome = plan_serve_buckets(engine)
+    print(json.dumps({
+        "autotune": True,
+        "buckets": list(fitting),
+        "rejected": outcome.rejected,
+        "budget_bytes": outcome.budget_bytes,
+    }))
+    if telem:
+        telem.observe_autotune(outcome)
+    if not fitting:
+        # fail CLOSED: warming the rejected set would execute the
+        # exact OOM the planner just predicted. Rerun without
+        # --auto_tune (or raise the budget) to override.
+        raise SystemExit(
+            "auto_tune: no warmup bucket fits the HBM budget "
+            f"({outcome.budget_bytes} bytes, margin "
+            f"{outcome.margin}); refusing to warm an over-budget "
+            "bucket set"
+        )
+    if tuple(fitting) != engine.buckets:
+        engine.buckets = tuple(fitting)
+    args.buckets = ",".join(str(b) for b in fitting)
+
+
+def _swap_factory(args, path: str) -> Callable:
+    """Engine factory for a swap target artifact, sharing the serve knobs
+    (buckets/deadline/queue) with the running fleet."""
+    from mgproto_tpu.serving.engine import ServingEngine
+
+    kw = _engine_kw(args)
+    kw.pop("monitor")
+
+    def factory():
+        return ServingEngine.from_artifact(
+            path, allow_uncalibrated=args.allow_uncalibrated, **kw
+        )
+
+    return factory
+
+
+def _summary_line(responses, compiled, steady, gate, readiness, extra=None):
+    counts = {}
+    for r in responses:
+        counts[r.outcome] = counts.get(r.outcome, 0) + 1
+    line = {
+        "summary": True,
+        "requests": len(responses),
+        "outcomes": counts,
+        "abstain_rate": gate.abstain_rate if gate is not None else None,
+        "degraded": gate.degraded if gate is not None else None,
+        "fingerprint_mismatch": (
+            gate.fingerprint_mismatch if gate is not None else None
+        ),
+        "warmup_compiles": compiled,
+        "steady_state_recompiles": steady,
+        "readiness": readiness,
+    }
+    if extra:
+        line.update(extra)
+    print(json.dumps(line))
+
+
+def _main_batch_engine(args, handler, telem, monitor) -> None:
+    """The original single-engine batch face (plus graceful drain)."""
+    from mgproto_tpu.serving.health import HealthProbe
+
+    engine = build_engine(args, monitor=monitor)
+    if args.auto_tune:
+        _apply_auto_tune(args, engine, telem)
+    compiled = engine.warmup()
+    payloads, ids = _load_payloads(args)
+    responses = drive_batch_engine(engine, payloads, ids, handler)
+    for r in responses:
+        print(json.dumps(r.to_dict()))
+    _summary_line(
+        responses, compiled,
+        engine.monitor.recompile_count - compiled,
+        engine.gate, HealthProbe(engine).readiness(),
+        extra={"drained": handler.requested()},
+    )
+
+
+def _build_plane(args, telem):
+    """The one ReplicaSet construction both plane faces share (auto-tune
+    probe first, so warmup never compiles an over-budget bucket)."""
+    from mgproto_tpu.serving.batcher import BatcherConfig
+    from mgproto_tpu.serving.replica import ReplicaSet
+
+    # ONE factory (the heavy state — artifact path or restored checkpoint +
+    # calibration — loads exactly once); the auto-tune probe is its first
+    # engine, and the factory reads the tuned bucket set late, so the fleet
+    # and every restart agree with the plan
+    factory = make_engine_factory(args)
+    if args.auto_tune:
+        probe = factory()
+        _apply_auto_tune(args, probe, telem)
+        del probe
+    return ReplicaSet(
+        factory,
+        replicas=args.replicas,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        batcher_config=BatcherConfig(max_linger_s=args.linger_ms / 1000.0),
+    )
+
+
+def _main_batch_plane(args, handler, telem) -> None:
+    """Batch face through the replica plane (--replicas > 1 or --swap)."""
+    rs = _build_plane(args, telem)
+    compiled = rs.start()
+    payloads, ids = _load_payloads(args)
+    swap_at = len(payloads) // 2 if args.swap else None
+    responses, reports = drive_batch_plane(
+        rs, payloads, ids, handler,
+        swap_at=swap_at,
+        swap_factory=_swap_factory(args, args.swap) if args.swap else None,
+        require_calibrated=not args.allow_uncalibrated,
+    )
+    for r in responses:
+        print(json.dumps(r.to_dict()))
+    for rep in reports:
+        print(json.dumps({"swap": True, **rep.to_dict()}))
+    first = next((r for r in rs.replicas if r.engine is not None), None)
+    _summary_line(
+        responses, compiled, rs.steady_recompiles,
+        first.engine.gate if first else None,
+        first.probe.readiness() if first and first.probe else None,
+        extra={
+            "replicas": len(rs.replicas),
+            "replicas_ready": len(rs.ready_replicas()),
+            "swaps": [rep.to_dict() for rep in reports],
+            "drained": handler.requested(),
+        },
+    )
+
+
+def _main_listen(args, handler, telem) -> None:
+    """The network face: replica plane behind the asyncio HTTP frontend."""
+    import asyncio
+
+    from mgproto_tpu.serving.frontend import Frontend
+
+    host, _, port = args.listen.rpartition(":")
+    if not host or not port:
+        raise SystemExit(f"--listen must be HOST:PORT, got {args.listen!r}")
+    rs = _build_plane(args, telem)
+    compiled = rs.start()
+    frontend = Frontend(
+        rs,
+        host=host,
+        port=int(port),
+        preemption_handler=handler,
+        swap_factory_builder=lambda path: _swap_factory(args, path),
+        require_calibrated_swap=not args.allow_uncalibrated,
+    )
+
+    async def _run():
+        await frontend.start()
+        print(json.dumps({
+            "listening": True,
+            "host": host,
+            "port": frontend.port,
+            "replicas": args.replicas,
+            "buckets": _parse_buckets(args.buckets),
+            "warmup_compiles": compiled,
+        }), flush=True)
+        await frontend.run_until_drained()
+
+    started = time.monotonic()
+    asyncio.run(_run())
+    first = next((r for r in rs.replicas if r.engine is not None), None)
+    print(json.dumps({
+        "summary": True,
+        "outcomes": frontend.outcomes,
+        "requests": sum(frontend.outcomes.values()),
+        "steady_state_recompiles": rs.steady_recompiles,
+        "uptime_s": time.monotonic() - started,
+        "degraded": first.engine.gate.degraded if first else None,
+        "drained": True,
+    }))
 
 
 if __name__ == "__main__":

@@ -156,9 +156,30 @@ def _series_by_label(
     return out
 
 
+def _unlabeled_value(snapshot: Dict, name: str, default=None):
+    """The explicitly-unlabeled series of a gauge that ALSO carries labeled
+    series (e.g. serving_queue_depth: per-replica labels + the fleet total
+    unlabeled) — _series_value's last-series pick would return whichever
+    replica happened to flush last. Falls back to the labeled sum."""
+    m = snapshot.get(name)
+    if not m or not m.get("series"):
+        return default
+    labeled = []
+    for s in m["series"]:
+        if s.get("value") is None:
+            continue
+        if not s.get("labels"):
+            return s["value"]
+        labeled.append(s["value"])
+    return sum(labeled) if labeled else default
+
+
 def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
-    """Serving story: outcomes, latency percentiles, trust + breaker state
-    (None when this run never served — training-only telemetry)."""
+    """Serving story: outcomes, PER-REASON shed counts (queue_full vs
+    deadline vs shutdown...), latency percentiles, trust + breaker state
+    including the open-time fraction, micro-batch fill histogram, replica
+    supervision and hot-swap counters (None when this run never served —
+    training-only telemetry)."""
     from mgproto_tpu.serving import metrics as sm  # jax-free
 
     if not any(name in last for name in sm.ALL_COUNTERS):
@@ -178,6 +199,9 @@ def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
         "breaker_transitions": _series_by_label(
             last, sm.BREAKER_TRANSITIONS, "edge"
         ),
+        "breaker_open_time_fraction": _series_value(
+            last, sm.BREAKER_OPEN_FRACTION
+        ),
     }
     hist = _hist_series(last, sm.REQUEST_SECONDS)
     if hist and hist["count"]:
@@ -187,6 +211,32 @@ def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
                 hist, p
             )
         section["request_max_seconds"] = hist["max"]
+    fill = _hist_series(last, sm.BATCH_FILL_HIST)
+    if fill and fill["count"]:
+        section["batch_fill"] = {
+            "dispatches": fill["count"],
+            "mean": fill["sum"] / fill["count"],
+            "p50": percentile_from_buckets(fill, 50.0),
+            "p90": percentile_from_buckets(fill, 90.0),
+            "min": fill["min"],
+        }
+    # network-plane story, present only when the plane ran
+    plane = {
+        "dispatches_by_trigger": _series_by_label(
+            last, sm.DISPATCHES, "trigger"
+        ),
+        "replica_restarts": _series_by_label(
+            last, sm.REPLICA_RESTARTS, "reason"
+        ),
+        "replicas_ready": _series_value(last, sm.REPLICAS_READY),
+        "replicas_total": _series_value(last, sm.REPLICAS_TOTAL),
+        "queue_depth": _unlabeled_value(last, sm.QUEUE_DEPTH),
+        "swaps_by_result": _series_by_label(last, sm.SWAPS, "result"),
+        "swap_transferred": _series_value(last, sm.SWAP_TRANSFERRED),
+    }
+    for key, value in plane.items():
+        if value not in (None, {}):
+            section[key] = value
     return section
 
 

@@ -42,7 +42,12 @@ MGPROTO_CHAOS_LOADER_IO_FAILS, MGPROTO_CHAOS_NAN_AT_STEP,
 MGPROTO_CHAOS_PREEMPT_AT_STEP, MGPROTO_CHAOS_CKPT_FAILS, and for serving
 MGPROTO_CHAOS_SERVE_MALFORMED_RATE, MGPROTO_CHAOS_SERVE_NAN_RATE,
 MGPROTO_CHAOS_SERVE_DEVICE_ERRORS (comma-separated dispatch indices),
-MGPROTO_CHAOS_SERVE_STORM_AT, MGPROTO_CHAOS_SERVE_STORM_LEN.
+MGPROTO_CHAOS_SERVE_STORM_AT, MGPROTO_CHAOS_SERVE_STORM_LEN, and for the
+network serving plane (ISSUE 7) MGPROTO_CHAOS_SERVE_REPLICA_KILL_AT,
+MGPROTO_CHAOS_SERVE_WEDGE_AT (admitted-request indices that kill/wedge the
+replica the request routes to, one-shot each) and
+MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT (poison the first N hot-swap
+attempts with a trust-stripped artifact; the swap must fail closed).
 """
 
 from __future__ import annotations
@@ -86,6 +91,18 @@ class ChaosPlan:
     # deadline already expired (a deadline storm for admission control)
     serve_storm_at: Optional[int] = None
     serve_storm_len: int = 0
+    # serving plane (ISSUE 7): when admitted request index >= kill_at, the
+    # replica that request would route to dies (simulated process death —
+    # stops heartbeating AND dispatching; the supervisor detects the stale
+    # heartbeat, reroutes its queue, restarts it on backoff). One-shot.
+    serve_replica_kill_at: Optional[int] = None
+    # same, but the replica WEDGES: present yet unresponsive (a stuck
+    # device call). Identical detection path, distinct restart reason.
+    serve_wedge_at: Optional[int] = None
+    # the first N blue/green swap attempts stage an artifact whose trust
+    # data is stripped (an operator pushing an uncalibrated artifact); the
+    # swap MUST reject it fail-closed while the old model keeps serving
+    serve_swap_bad_artifact: int = 0
 
     def any_active(self) -> bool:
         return (
@@ -97,6 +114,9 @@ class ChaosPlan:
             or self.serve_nan_rate > 0.0
             or bool(self.serve_device_errors)
             or (self.serve_storm_at is not None and self.serve_storm_len > 0)
+            or self.serve_replica_kill_at is not None
+            or self.serve_wedge_at is not None
+            or self.serve_swap_bad_artifact > 0
         )
 
 
@@ -117,6 +137,9 @@ class ChaosState:
         self._serve_errors_left = set(
             int(i) for i in plan.serve_device_errors
         )
+        self._replica_kill_fired = False
+        self._wedge_fired = False
+        self._bad_swaps_left = int(plan.serve_swap_bad_artifact)
 
     def _count(self, kind: str) -> None:
         from mgproto_tpu.resilience import metrics as _m
@@ -218,6 +241,47 @@ class ChaosState:
             self._count("serve_deadline_storm")
         return due
 
+    def serve_replica_kill_due(self, request_index: int) -> bool:
+        """True exactly once, when the admitted-request index reaches
+        `serve_replica_kill_at`: the supervisor kills the replica this
+        request would have routed to (the request itself reroutes)."""
+        with self._lock:
+            due = (
+                self.plan.serve_replica_kill_at is not None
+                and not self._replica_kill_fired
+                and int(request_index) >= int(self.plan.serve_replica_kill_at)
+            )
+            if due:
+                self._replica_kill_fired = True
+        if due:
+            self._count("serve_replica_kill")
+        return due
+
+    def serve_replica_wedge_due(self, request_index: int) -> bool:
+        """True exactly once, when the admitted-request index reaches
+        `serve_wedge_at` (replica present but unresponsive)."""
+        with self._lock:
+            due = (
+                self.plan.serve_wedge_at is not None
+                and not self._wedge_fired
+                and int(request_index) >= int(self.plan.serve_wedge_at)
+            )
+            if due:
+                self._wedge_fired = True
+        if due:
+            self._count("serve_replica_wedge")
+        return due
+
+    def serve_swap_bad_artifact_due(self) -> bool:
+        """True for the first `serve_swap_bad_artifact` swap attempts: the
+        staged standby loses its trust data and the swap must fail closed."""
+        with self._lock:
+            if self._bad_swaps_left <= 0:
+                return False
+            self._bad_swaps_left -= 1
+        self._count("serve_swap_bad_artifact")
+        return True
+
     def serve_device_error_due(self, dispatch_index: int) -> bool:
         """True exactly once per listed dispatch index (a breaker-paced
         retry of later work must be able to heal)."""
@@ -298,5 +362,12 @@ def plan_from_env(environ=None) -> Optional[ChaosPlan]:
         ),
         serve_storm_at=_get("MGPROTO_CHAOS_SERVE_STORM_AT", int, None),
         serve_storm_len=_get("MGPROTO_CHAOS_SERVE_STORM_LEN", int, 0),
+        serve_replica_kill_at=_get(
+            "MGPROTO_CHAOS_SERVE_REPLICA_KILL_AT", int, None
+        ),
+        serve_wedge_at=_get("MGPROTO_CHAOS_SERVE_WEDGE_AT", int, None),
+        serve_swap_bad_artifact=_get(
+            "MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT", int, 0
+        ),
     )
     return plan if plan.any_active() else None

@@ -14,10 +14,21 @@ jax — calibration files must be readable on a bare operator host):
   gate        — TrustGate: in_dist / abstain / ungated decisions (numpy).
   admission   — AdmissionQueue + CircuitBreaker (jax-free).
   health      — liveness/readiness probes over an engine (jax-free).
+  response    — the typed ServeResponse shape + its one metrics account
+                (jax-free; shared by the engine and the network plane).
   engine      — ServingEngine (imports jax; loaded lazily through
                 `__getattr__` so the package import stays jax-free).
 
-See README "Serving & trust gating" for the operator-facing story.
+The network serving plane (ISSUE 7) sits on top — all jax-free themselves
+(engines arrive via factories):
+
+  batcher     — continuous micro-batching with a latency-deadline cutoff.
+  replica     — ReplicaSet: heartbeat supervision, reroute, backoff restart.
+  swap        — blue/green hot swap, fail-closed on trust verification.
+  frontend    — stdlib asyncio HTTP frontend + graceful drain.
+
+See README "Serving & trust gating" + "Serving plane" for the operator
+story.
 """
 
 from mgproto_tpu.serving import metrics
@@ -32,8 +43,17 @@ from mgproto_tpu.serving.calibration import (
     calibrate,
     gmm_fingerprint,
 )
+from mgproto_tpu.serving.batcher import BatcherConfig, MicroBatcher
 from mgproto_tpu.serving.gate import TrustGate
 from mgproto_tpu.serving.health import HealthProbe
+from mgproto_tpu.serving.replica import Replica, ReplicaSet
+from mgproto_tpu.serving.response import ServeResponse
+from mgproto_tpu.serving.swap import (
+    SwapReport,
+    flip_fleet,
+    hot_swap,
+    stage_fleet,
+)
 from mgproto_tpu.serving.validate import (
     ValidationFailure,
     ValidationSpec,
@@ -41,14 +61,23 @@ from mgproto_tpu.serving.validate import (
     validate_image,
 )
 
-_LAZY = ("ServingEngine", "ServeResponse", "UncalibratedArtifactError")
+# engine imports jax, frontend imports asyncio machinery the batch drivers
+# never need: both stay lazy so the package import is light
+_LAZY = {
+    "ServingEngine": "engine",
+    "UncalibratedArtifactError": "engine",
+    "Frontend": "frontend",
+}
 
 
 def __getattr__(name):
-    if name in _LAZY:  # engine imports jax; keep the package import light
-        from mgproto_tpu.serving import engine
+    if name in _LAZY:
+        import importlib
 
-        return getattr(engine, name)
+        mod = importlib.import_module(
+            f"mgproto_tpu.serving.{_LAZY[name]}"
+        )
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -70,4 +99,13 @@ __all__ = [
     "ServingEngine",
     "ServeResponse",
     "UncalibratedArtifactError",
+    "BatcherConfig",
+    "MicroBatcher",
+    "Replica",
+    "ReplicaSet",
+    "SwapReport",
+    "flip_fleet",
+    "hot_swap",
+    "stage_fleet",
+    "Frontend",
 ]

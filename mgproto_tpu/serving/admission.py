@@ -122,6 +122,32 @@ class AdmissionQueue:
         self._q.append(req)
         return req, None
 
+    def peek_oldest(self) -> Optional[ServeRequest]:
+        """The request that has waited longest (None when empty). The
+        micro-batcher's deadline-slack trigger reads its latency contract."""
+        return self._q[0] if self._q else None
+
+    def drain_all(self) -> List[ServeRequest]:
+        """Remove and return EVERYTHING queued, unanswered and unaccounted —
+        for transfer, not for shedding: the replica supervisor reroutes a
+        dead replica's queue to survivors, and a blue/green swap moves the
+        old engine's queue into the new one. The caller owns answering every
+        drained request (typed) or restoring it somewhere."""
+        out = list(self._q)
+        self._q.clear()
+        return out
+
+    def restore(self, req: ServeRequest) -> bool:
+        """Re-admit a transferred request PRESERVING its identity, deadline
+        and original `enqueued_at` (latency accounting stays honest across a
+        reroute/swap). Returns False at capacity — the caller must answer
+        the request typed itself (it knows whether this is a reroute or a
+        swap, and therefore the honest shed reason)."""
+        if len(self._q) >= self.capacity:
+            return False
+        self._q.append(req)
+        return True
+
     def pop_batch(self, max_size: int) -> List[ServeRequest]:
         """Up to `max_size` still-viable requests, FIFO; entries whose
         deadline passed while queued are shed here, not served late."""
@@ -163,16 +189,32 @@ class CircuitBreaker:
         self.consecutive_failures = 0
         self._open_until = 0.0
         self._reopen_count = 0
+        self._state_since = clock()
+        self._open_seconds_total = 0.0
         _m.gauge(_m.BREAKER_STATE).set(_STATE_GAUGE[self.state])
 
     def _transition(self, new_state: str) -> None:
         if new_state == self.state:
             return
+        now = self.clock()
+        if self.state == BREAKER_OPEN:
+            self._open_seconds_total += max(now - self._state_since, 0.0)
+        self._state_since = now
         _m.counter(_m.BREAKER_TRANSITIONS).inc(
             edge=f"{self.state}->{new_state}"
         )
         self.state = new_state
         _m.gauge(_m.BREAKER_STATE).set(_STATE_GAUGE[new_state])
+
+    def open_seconds(self, now: Optional[float] = None) -> float:
+        """Cumulative seconds spent OPEN (the outage time a fleet dashboard
+        divides by uptime for the breaker open-time fraction). Includes the
+        in-progress open period when the breaker is open right now."""
+        total = self._open_seconds_total
+        if self.state == BREAKER_OPEN:
+            total += max((self.clock() if now is None else now)
+                         - self._state_since, 0.0)
+        return total
 
     def _cooldown(self) -> float:
         """The k-th open period's length: the retry module's backoff
@@ -196,6 +238,16 @@ class CircuitBreaker:
             self._transition(BREAKER_HALF_OPEN)
             return True
         return self.state == BREAKER_HALF_OPEN
+
+    def tick(self) -> None:
+        """Advance the lazy OPEN -> HALF_OPEN transition without asking to
+        dispatch. Readiness-gated routing starves an OPEN replica of
+        traffic, so with an empty queue nothing ever calls `allow()` and
+        the open state would outlive its cooldown forever; the supervisor
+        ticks instead, letting readiness report half-open and the next
+        routed batch serve as the probe."""
+        if self.state == BREAKER_OPEN and self.clock() >= self._open_until:
+            self._transition(BREAKER_HALF_OPEN)
 
     def record_success(self) -> None:
         self.consecutive_failures = 0

@@ -1,0 +1,140 @@
+"""Continuous micro-batching over a ServingEngine's admission queue.
+
+The compile-once substrate (bucketed warmup, PR 3/PR 6) makes batch
+coalescing free of recompiles: any queue depth pads to the nearest WARMED
+bucket. What is left is the scheduling question — when is waiting for a
+fuller batch worth it? The batcher dispatches when either:
+
+  * `bucket_full` — the LARGEST warmed bucket can be filled. More waiting
+    cannot improve throughput (the program has no bigger shape), so go.
+  * `deadline`    — the oldest queued request's latency-deadline slack has
+    dropped to the measured dispatch cost (an EMA of recent dispatch wall
+    time, seeded by `cost_prior_s`). Waiting any longer converts that
+    request from served to shed; a partial batch padded up beats a typed
+    shed.
+  * `linger`      — the oldest request (deadline-less traffic) has waited
+    `max_linger_s`. Bounded staleness for callers with no contract.
+  * `drain`       — `flush()` was called (shutdown / blue-green flip):
+    everything queued dispatches now, regardless of fill.
+
+Host-side and jax-free: the engine's `process_pending` owns the device.
+The clock is injectable (defaults to the engine's), so the chaos load test
+drives deadline pressure deterministically — no real sleeps, matching
+admission.py's discipline (enforced by scripts/check_no_blocking_sleep.py).
+
+`pre_dispatch` is a test/bench-only hook that runs at the top of every
+dispatch; the virtual-clock load harness (scripts/load_test.py) advances
+its fake clock there to model device service time, which also feeds the
+cost EMA the deadline trigger reads. Production leaves it None.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+from mgproto_tpu.serving import metrics as _m
+from mgproto_tpu.serving.response import ServeResponse
+
+TRIGGER_BUCKET_FULL = "bucket_full"
+TRIGGER_DEADLINE = "deadline"
+TRIGGER_LINGER = "linger"
+TRIGGER_DRAIN = "drain"
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    """Scheduling knobs (see module docstring for each trigger)."""
+
+    cost_prior_s: float = 0.002  # dispatch-cost estimate before any sample
+    cost_ema_alpha: float = 0.2  # weight of the newest measured dispatch
+    slack_safety: float = 1.0  # dispatch when slack <= cost * safety
+    max_linger_s: float = 0.02  # deadline-less requests wait at most this
+
+
+class MicroBatcher:
+    """One batcher per engine; `poll()` is the only entry point the serving
+    loop needs — it dispatches zero or more due batches and returns every
+    typed response they produced."""
+
+    def __init__(
+        self,
+        engine,
+        config: Optional[BatcherConfig] = None,
+        clock: Optional[Callable[[], float]] = None,
+        name: Optional[str] = None,
+        pre_dispatch: Optional[Callable[[], None]] = None,
+    ):
+        self.engine = engine
+        self.config = config if config is not None else BatcherConfig()
+        self.clock = clock if clock is not None else engine.clock
+        self.name = name
+        self.pre_dispatch = pre_dispatch
+        self.dispatch_cost_s = float(self.config.cost_prior_s)
+        self.dispatches = 0
+
+    # ---------------------------------------------------------------- triggers
+    def dispatch_due(self) -> Optional[str]:
+        """The trigger that makes dispatching NOW the right call, or None to
+        keep coalescing."""
+        q = self.engine.queue
+        depth = len(q)
+        if depth == 0:
+            return None
+        if depth >= self.engine.buckets[-1]:
+            return TRIGGER_BUCKET_FULL
+        oldest = q.peek_oldest()
+        now = self.clock()
+        if oldest.deadline is not None:
+            slack = oldest.deadline - now
+            if slack <= self.dispatch_cost_s * self.config.slack_safety:
+                return TRIGGER_DEADLINE
+        if now - oldest.enqueued_at >= self.config.max_linger_s:
+            return TRIGGER_LINGER
+        return None
+
+    # ---------------------------------------------------------------- serving
+    def poll(self) -> List[ServeResponse]:
+        """Dispatch every due batch (the queue strictly shrinks per
+        dispatch, so this terminates) and update the queue-depth gauge."""
+        out: List[ServeResponse] = []
+        # bound by the entry depth: each dispatch pops >= 1 queued request,
+        # so this can never loop past the work that existed when poll began
+        for _ in range(len(self.engine.queue) + 1):
+            trigger = self.dispatch_due()
+            if trigger is None:
+                break
+            out.extend(self._dispatch(trigger))
+        self._observe_depth()
+        return out
+
+    def flush(self) -> List[ServeResponse]:
+        """Dispatch until the queue is empty (graceful drain: every queued
+        request is ANSWERED, through the device, not shed)."""
+        out: List[ServeResponse] = []
+        while len(self.engine.queue):
+            out.extend(self._dispatch(TRIGGER_DRAIN))
+        self._observe_depth()
+        return out
+
+    # -------------------------------------------------------------- internals
+    def _dispatch(self, trigger: str) -> List[ServeResponse]:
+        _m.counter(_m.DISPATCHES).inc(trigger=trigger)
+        self.dispatches += 1
+        t0 = self.clock()  # before the hook: its virtual service time is
+        # exactly what the cost EMA must measure
+        if self.pre_dispatch is not None:
+            self.pre_dispatch()
+        responses = self.engine.process_pending()
+        dt = self.clock() - t0
+        if dt > 0:  # a virtual clock that did not move leaves the prior
+            a = self.config.cost_ema_alpha
+            self.dispatch_cost_s = (1 - a) * self.dispatch_cost_s + a * dt
+        return responses
+
+    def _observe_depth(self) -> None:
+        depth = float(len(self.engine.queue))
+        if self.name is not None:
+            _m.gauge(_m.QUEUE_DEPTH).set(depth, replica=self.name)
+        else:
+            _m.gauge(_m.QUEUE_DEPTH).set(depth)

@@ -33,7 +33,6 @@ Two sources of truth for the model:
 
 from __future__ import annotations
 
-import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -52,6 +51,17 @@ from mgproto_tpu.serving.gate import (
     TRUST_UNGATED,
     TrustGate,
 )
+from mgproto_tpu.serving.response import (
+    OUTCOME_ABSTAIN,
+    OUTCOME_PREDICT,
+    OUTCOME_REJECT,
+    OUTCOME_SHED,
+    REASON_CIRCUIT_OPEN,
+    REASON_DEVICE_ERROR,
+    REASON_SHUTDOWN,
+    ServeResponse,
+    record as _record_response,
+)
 from mgproto_tpu.serving.validate import (
     ValidationFailure,
     ValidationSpec,
@@ -61,36 +71,9 @@ from mgproto_tpu.telemetry.monitor import StepMonitor
 
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8)
 
-OUTCOME_PREDICT = "predict"
-OUTCOME_ABSTAIN = "abstain"
-OUTCOME_REJECT = "reject"
-OUTCOME_SHED = "shed"
-
-REASON_CIRCUIT_OPEN = "circuit_open"
-REASON_DEVICE_ERROR = "device_error"
-
 
 class UncalibratedArtifactError(RuntimeError):
     """Artifact has no embedded calibration and --allow-uncalibrated is off."""
-
-
-@dataclasses.dataclass(frozen=True)
-class ServeResponse:
-    """The one shape every request is answered with — no other exit path."""
-
-    request_id: str
-    outcome: str  # predict | abstain | reject | shed
-    prediction: Optional[int] = None
-    log_px: Optional[float] = None
-    trust: Optional[str] = None  # in_dist | abstain | ungated
-    trust_score: Optional[float] = None  # calibrated ID-quantile of log_px
-    confidence: Optional[float] = None  # temperature-calibrated max softmax
-    degraded: bool = False
-    reason: Optional[str] = None  # reject/shed cause
-    latency_s: float = 0.0
-
-    def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
 
 
 class ServingEngine:
@@ -134,12 +117,21 @@ class ServingEngine:
             default_deadline_s=default_deadline_s,
             clock=clock,
         )
-        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # the default breaker must share the engine's (possibly virtual)
+        # clock: cooldowns and open-seconds accounting on a different clock
+        # would make chaos drills nondeterministic and the open-fraction
+        # gauge meaningless
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            clock=clock
+        )
         self.monitor = monitor if monitor is not None else StepMonitor(
             phase="serve"
         )
         self.monitor.watch(self._jit)
         self.warmed_up = False
+        # readiness veto during a graceful drain or a blue/green flip: the
+        # engine still ANSWERS (drains) but must not be routed new traffic
+        self.draining = False
         self._request_seq = 0  # chaos injection index over admitted order
         self._dispatch_seq = 0  # chaos injection index over device dispatches
 
@@ -339,24 +331,60 @@ class ServingEngine:
         responses.extend(self._gated_responses(batch, logits, log_px))
         return responses
 
+    def drain(self, reason: str = REASON_SHUTDOWN) -> List[ServeResponse]:
+        """Answer EVERYTHING still queued with a typed shed (plus any
+        already-shed stragglers) — the no-silent-drops half of graceful
+        shutdown and of replica teardown. Does not dispatch: a draining
+        engine may be draining precisely because dispatching stopped being
+        possible."""
+        self.draining = True
+        responses = []
+        for req in self.queue.drain_all():
+            _m.counter(_m.SHED).inc(reason=reason)
+            responses.append(self._respond(self._shed_response(req, reason)))
+        for req in self.queue.drain_shed():
+            responses.append(
+                self._respond(self._shed_response(req, "deadline"))
+            )
+        return responses
+
     def serve_all(self, payloads: Sequence[Any],
                   deadline_s: Optional[float] = None,
-                  request_ids: Optional[Sequence[str]] = None
+                  request_ids: Optional[Sequence[str]] = None,
+                  should_stop: Optional[Callable[[], bool]] = None
                   ) -> List[ServeResponse]:
         """Batch driver (CLI / tests): submit everything, drain to
-        completion, return responses in submission order."""
-        order: Dict[str, int] = {}
+        completion, return responses in submission order. `should_stop`
+        (e.g. the preemption handler's flag) turns the exit graceful:
+        queued work is shed typed via `drain()` and never-submitted
+        payloads answer typed too — every id gets exactly one response
+        either way."""
+        from mgproto_tpu.serving.response import shed_response
+
+        ids = [
+            request_ids[i] if request_ids is not None else f"req{i}"
+            for i in range(len(payloads))
+        ]
+        order = {rid: i for i, rid in enumerate(ids)}
         responses: List[ServeResponse] = []
+        unsubmitted: List[str] = []
         for i, payload in enumerate(payloads):
-            rid = request_ids[i] if request_ids is not None else f"req{i}"
-            order[rid] = i
+            if should_stop is not None and should_stop():
+                unsubmitted = ids[i:]
+                break
             responses.extend(
-                self.submit(payload, request_id=rid, deadline_s=deadline_s)
+                self.submit(payload, request_id=ids[i], deadline_s=deadline_s)
             )
         # every pop either answers or sheds-with-answer, so this terminates
         # with zero requests left unanswered
         while len(self.queue):
+            if should_stop is not None and should_stop():
+                responses.extend(self.drain())
+                break
             responses.extend(self.process_pending())
+        responses.extend(
+            shed_response(rid, REASON_SHUTDOWN) for rid in unsubmitted
+        )
         return sorted(
             responses, key=lambda r: order.get(r.request_id, len(order))
         )
@@ -381,6 +409,7 @@ class ServingEngine:
             )
             padded[:n] = images
         _m.gauge(_m.BATCH_FILL).set(n / bucket)
+        _m.histogram(_m.BATCH_FILL_HIST).observe(n / bucket)
         t0 = time.perf_counter()
         with trace_span("serve_dispatch", bucket=bucket, fill=n):
             if chaos is not None and chaos.serve_device_error_due(seq):
@@ -434,10 +463,4 @@ class ServingEngine:
         return out
 
     def _respond(self, resp: ServeResponse) -> ServeResponse:
-        _m.counter(_m.REQUESTS).inc(outcome=resp.outcome)
-        _m.histogram(_m.REQUEST_SECONDS).observe(
-            max(resp.latency_s, 0.0), outcome=resp.outcome
-        )
-        if resp.degraded and resp.outcome == OUTCOME_PREDICT:
-            _m.counter(_m.DEGRADED_REQUESTS).inc()
-        return resp
+        return _record_response(resp)

@@ -1,0 +1,435 @@
+"""Asyncio HTTP frontend: the network boundary of the serving plane.
+
+Stdlib only (asyncio + json) — no new dependencies. The frontend owns three
+things and nothing else:
+
+  * the SOCKETS — a minimal HTTP/1.1 server (`asyncio.start_server`), one
+    JSON request/response per connection;
+  * the PUMP — a single background task that feeds submitted requests into
+    the `ReplicaSet`, drives its `poll()` (continuous micro-batching,
+    replica supervision), and resolves each request's future when its typed
+    response surfaces. ALL ReplicaSet access happens on the pump — one
+    submission/poll/swap at a time, in order — so the plane needs no locks
+    and chaos schedules stay deterministic. Engine work runs in the default
+    executor, keeping the event loop responsive while XLA dispatches;
+  * the DRAIN — on stop (explicit, or the preemption handler's
+    SIGTERM/SIGINT flag), the pump stops admitting, answers or sheds every
+    queued request typed (`ReplicaSet.drain`), resolves every outstanding
+    future, and only then lets the process exit. No silently dropped
+    requests — the same contract the batch driver honors.
+
+Endpoints:
+
+  POST /v1/predict   {"id"?, "image": nested lists, "deadline_ms"?}
+                     -> one ServeResponse JSON. Status: 200 predict/abstain,
+                     400 reject (503 when the cause is circuit_open/
+                     device_error — retryable), 429 shed (503 on shutdown).
+  GET  /healthz      liveness: 200 {"alive": true} while the pump runs.
+  GET  /readyz       readiness: 200 when >= 1 replica is ready, else 503;
+                     body carries per-replica probe detail.
+  GET  /metrics      Prometheus text of the process-current registry.
+  POST /admin/swap   {"artifact": path} -> blue/green hot swap (fail-closed;
+                     see serving/swap.py). 200 committed, 409 rejected.
+
+`await asyncio.sleep` is the only waiting primitive here; `time.sleep` and
+friends are banned from the serving path (scripts/check_no_blocking_sleep).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from mgproto_tpu.serving.replica import ReplicaSet
+from mgproto_tpu.serving.response import (
+    OUTCOME_ABSTAIN,
+    OUTCOME_PREDICT,
+    OUTCOME_REJECT,
+    OUTCOME_SHED,
+    REASON_CIRCUIT_OPEN,
+    REASON_DEVICE_ERROR,
+    REASON_SHUTDOWN,
+    ServeResponse,
+    shed_response,
+)
+
+_RETRYABLE_REJECTS = (REASON_CIRCUIT_OPEN, REASON_DEVICE_ERROR)
+_MAX_BODY_BYTES = 64 * 1024 * 1024  # a padded f32 518x518x3 is ~13MB of JSON
+_MAX_HEAD_BYTES = 64 * 1024  # request line + headers, cumulative
+
+
+def http_status_for(resp: ServeResponse) -> int:
+    """The one outcome->status map (documented in the README runbook)."""
+    if resp.outcome in (OUTCOME_PREDICT, OUTCOME_ABSTAIN):
+        return 200
+    if resp.outcome == OUTCOME_REJECT:
+        return 503 if resp.reason in _RETRYABLE_REJECTS else 400
+    # shed: overload backpressure, except shutdown which is going-away
+    return 503 if resp.reason == REASON_SHUTDOWN else 429
+
+
+class Frontend:
+    def __init__(
+        self,
+        replicas: ReplicaSet,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        poll_interval_s: float = 0.002,
+        request_timeout_s: float = 30.0,
+        io_timeout_s: float = 10.0,
+        max_head_bytes: int = _MAX_HEAD_BYTES,
+        preemption_handler=None,
+        swap_factory_builder: Optional[Callable[[str], Callable]] = None,
+        require_calibrated_swap: bool = True,
+    ):
+        """`swap_factory_builder(path)` returns an engine factory for the
+        artifact at `path` (the CLI wires the serve flags in); without it
+        /admin/swap answers 501. `require_calibrated_swap=False` (the CLI
+        sets it from --allow-uncalibrated) lets an operator who explicitly
+        opted into degraded serving promote an uncalibrated artifact — the
+        same policy the batch-face swap drill applies."""
+        self.replicas = replicas
+        self.host = host
+        self.port = int(port)  # 0 = ephemeral; real port known after start
+        self.poll_interval_s = float(poll_interval_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.io_timeout_s = float(io_timeout_s)
+        self.max_head_bytes = int(max_head_bytes)
+        self.preemption_handler = preemption_handler
+        self.swap_factory_builder = swap_factory_builder
+        self.require_calibrated_swap = bool(require_calibrated_swap)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._pump_task: Optional[asyncio.Task] = None
+        self._inbox: Deque[Tuple[Any, str, Optional[float]]] = deque()
+        self._admin: Deque[Tuple[Callable[[], Any], asyncio.Future]] = deque()
+        self._pending: Dict[str, asyncio.Future] = {}
+        self._kick: Optional[asyncio.Event] = None
+        self._swap_lock: Optional[asyncio.Lock] = None
+        self._stop = False
+        self._drained = False
+        self._seq = 0
+        self.outcomes: Dict[str, int] = {}  # resolved responses by outcome
+
+    # ---------------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        self._kick = asyncio.Event()
+        self._swap_lock = asyncio.Lock()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._pump_task = asyncio.create_task(self._pump())
+
+    def request_stop(self) -> None:
+        self._stop = True
+        if self._kick is not None:
+            self._kick.set()
+
+    async def run_until_drained(self) -> None:
+        """Serve until stopped (request_stop() or the preemption flag),
+        then finish the graceful drain before returning."""
+        if self._server is None:
+            await self.start()
+        await self._pump_task
+        self._server.close()
+        await self._server.wait_closed()
+
+    # --------------------------------------------------------------------- pump
+    def _stopping(self) -> bool:
+        return self._stop or (
+            self.preemption_handler is not None
+            and self.preemption_handler.requested()
+        )
+
+    async def _pump(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stopping():
+            work = list(self._inbox)
+            self._inbox.clear()
+            admin = list(self._admin)
+            self._admin.clear()
+
+            def step():
+                out: List[ServeResponse] = []
+                admin_results = [fn() for fn, _fut in admin]
+                for payload, rid, deadline_s in work:
+                    out.extend(
+                        self.replicas.submit(
+                            payload, request_id=rid, deadline_s=deadline_s
+                        )
+                    )
+                out.extend(self.replicas.poll())
+                return out, admin_results
+
+            responses, admin_results = await loop.run_in_executor(None, step)
+            for (_fn, fut), result in zip(admin, admin_results):
+                if not fut.done():
+                    fut.set_result(result)
+            self._resolve(responses)
+            if not work and not admin and not responses:
+                self._kick.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._kick.wait(), timeout=self.poll_interval_s
+                    )
+                except asyncio.TimeoutError:
+                    pass
+        await self._graceful_drain(loop)
+
+    async def _graceful_drain(self, loop) -> None:
+        """Stop admitting; answer or shed EVERYTHING typed, then resolve
+        any future the drain somehow missed (belt and braces: a pending
+        future without a response would hang its connection)."""
+        work = list(self._inbox)
+        self._inbox.clear()
+        admin = list(self._admin)
+        self._admin.clear()
+
+        def final():
+            out: List[ServeResponse] = [
+                shed_response(rid, REASON_SHUTDOWN)
+                for _payload, rid, _deadline in work
+            ]
+            out.extend(self.replicas.drain(REASON_SHUTDOWN))
+            return out
+
+        self._resolve(await loop.run_in_executor(None, final))
+        for _fn, fut in admin:
+            if not fut.done():
+                fut.set_result(
+                    {"ok": False, "reason": REASON_SHUTDOWN}
+                )
+        for rid in list(self._pending):
+            self._resolve([shed_response(rid, REASON_SHUTDOWN)])
+        self._drained = True
+
+    def _resolve(self, responses: List[ServeResponse]) -> None:
+        for resp in responses:
+            self.outcomes[resp.outcome] = (
+                self.outcomes.get(resp.outcome, 0) + 1
+            )
+            fut = self._pending.pop(resp.request_id, None)
+            if fut is not None and not fut.done():
+                fut.set_result(resp)
+
+    # ------------------------------------------------------------------ routing
+    async def _handle(self, reader, writer) -> None:
+        status, body, ctype = 400, b'{"error": "bad_request"}', None
+        try:
+            method, target, headers = await self._read_head(reader)
+            length = int(headers.get("content-length", "0"))
+            if length > _MAX_BODY_BYTES:
+                raise ValueError("body too large")
+            # same timeout as the head reads: a client that announces a
+            # Content-Length and then stalls must not hold the handler
+            # task and its socket open forever (slowloris)
+            raw = (
+                await asyncio.wait_for(
+                    reader.readexactly(length), timeout=self.io_timeout_s
+                )
+                if length
+                else b""
+            )
+            status, body, ctype = await self._route(method, target, raw)
+        except (asyncio.IncompleteReadError, ValueError, UnicodeDecodeError):
+            pass  # malformed HTTP: the 400 default answers
+        except asyncio.TimeoutError:
+            status, body = 408, b'{"error": "timeout"}'
+        try:
+            writer.write(
+                b"HTTP/1.1 %d %s\r\n"
+                b"Content-Type: %s\r\n"
+                b"Content-Length: %d\r\n"
+                b"Connection: close\r\n\r\n"
+                % (
+                    status,
+                    {200: b"OK", 400: b"Bad Request", 404: b"Not Found",
+                     408: b"Request Timeout", 409: b"Conflict",
+                     429: b"Too Many Requests", 501: b"Not Implemented",
+                     503: b"Service Unavailable"}.get(status, b"Status"),
+                    ctype or b"application/json",
+                    len(body),
+                )
+            )
+            writer.write(body)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass  # client went away; its request still got accounted
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_head(self, reader):
+        line = await asyncio.wait_for(
+            reader.readline(), timeout=self.io_timeout_s
+        )
+        parts = line.decode("ascii").split()
+        if len(parts) < 2:
+            raise ValueError("bad request line")
+        method, target = parts[0].upper(), parts[1]
+        headers = {}
+        head_bytes = 0
+        while True:
+            h = await asyncio.wait_for(
+                reader.readline(), timeout=self.io_timeout_s
+            )
+            if h in (b"\r\n", b"\n", b""):
+                break
+            # cap the cumulative head size: a client drip-feeding headers
+            # (each within io_timeout_s) must not hold the connection and
+            # grow this dict forever
+            head_bytes += len(h)
+            if head_bytes > self.max_head_bytes:
+                raise ValueError("request head too large")
+            key, _, value = h.decode("latin-1").partition(":")
+            headers[key.strip().lower()] = value.strip()
+        return method, target, headers
+
+    async def _route(self, method, target, raw):
+        target = target.split("?", 1)[0]
+        if method == "POST" and target == "/v1/predict":
+            return await self._predict(raw)
+        if method == "GET" and target == "/healthz":
+            return 200, json.dumps(
+                {"alive": True, "draining": self._stopping()}
+            ).encode(), None
+        if method == "GET" and target == "/readyz":
+            return self._readyz()
+        if method == "GET" and target == "/metrics":
+            from mgproto_tpu.telemetry.registry import default_registry
+
+            return 200, default_registry().to_prometheus().encode(), (
+                b"text/plain; version=0.0.4"
+            )
+        if method == "POST" and target == "/admin/swap":
+            return await self._swap(raw)
+        return 404, b'{"error": "not_found"}', None
+
+    # ----------------------------------------------------------------- handlers
+    async def _predict(self, raw: bytes):
+        try:
+            rec = json.loads(raw)
+            payload = rec["image"]
+            deadline_ms = rec.get("deadline_ms")
+            # parsed inside the guard: a non-numeric deadline_ms is a
+            # malformed request (typed 400), not an unhandled handler crash
+            deadline_s = (
+                float(deadline_ms) / 1000.0
+                if deadline_ms is not None
+                else None
+            )
+        except (ValueError, KeyError, TypeError):
+            return 400, json.dumps(
+                {"outcome": OUTCOME_REJECT, "reason": "malformed"}
+            ).encode(), None
+        self._seq += 1
+        rid = str(rec.get("id", f"h{self._seq}"))
+        if rid in self._pending:  # duplicate in flight: keep ids unique
+            rid = f"{rid}#{self._seq}"
+        if self._stopping():
+            resp = shed_response(rid, REASON_SHUTDOWN)
+            return http_status_for(resp), json.dumps(
+                resp.to_dict()
+            ).encode(), None
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending[rid] = fut
+        self._inbox.append((payload, rid, deadline_s))
+        self._kick.set()
+        try:
+            resp = await asyncio.wait_for(
+                fut, timeout=self.request_timeout_s
+            )
+        except asyncio.TimeoutError:
+            # contract backstop only: every admitted request is answered by
+            # poll/drain; a lost one still must not hang the connection.
+            # Deliberately NOT record()ed: the request may still be queued,
+            # and its eventual real response is the one metrics account —
+            # recording here would double-count the request
+            self._pending.pop(rid, None)
+            resp = ServeResponse(
+                request_id=rid, outcome=OUTCOME_SHED, reason="timeout"
+            )
+        return http_status_for(resp), json.dumps(resp.to_dict()).encode(), None
+
+    def _readyz(self):
+        detail = []
+        for rep in self.replicas.replicas:
+            detail.append({
+                "name": rep.name,
+                "state": rep.state,
+                "readiness": (
+                    rep.probe.readiness() if rep.probe is not None else None
+                ),
+            })
+        ready = bool(self.replicas.ready_replicas()) and not self._stopping()
+        return (200 if ready else 503), json.dumps(
+            {"ready": ready, "replicas": detail}
+        ).encode(), None
+
+    async def _swap(self, raw: bytes):
+        if self.swap_factory_builder is None:
+            return 501, json.dumps(
+                {"ok": False, "reason": "swap_not_configured"}
+            ).encode(), None
+        try:
+            rec = json.loads(raw)
+            artifact = str(rec["artifact"])
+        except (ValueError, KeyError, TypeError):
+            return 400, json.dumps(
+                {"ok": False, "reason": "malformed"}
+            ).encode(), None
+        from mgproto_tpu.serving.swap import flip_fleet, stage_fleet
+
+        factory = self.swap_factory_builder(artifact)
+        loop = asyncio.get_running_loop()
+        async with self._swap_lock:  # one swap stages at a time
+            if self._stopping():  # don't stage a fleet we cannot flip
+                return 503, json.dumps(
+                    {"ok": False, "reason": REASON_SHUTDOWN}
+                ).encode(), None
+            # STAGING (artifact loads + warmup compiles, the slow half)
+            # runs OFF the pump in its own executor thread: it touches no
+            # live state, so predict traffic keeps flowing while the green
+            # fleet warms. One standby per replica SLOT (not per currently
+            # live engine) so a replica that restarts mid-staging still
+            # has a green engine waiting at flip time.
+            slots = len(self.replicas.replicas)
+            standbys, rejection = await loop.run_in_executor(
+                None,
+                lambda: stage_fleet(
+                    slots, factory,
+                    require_calibrated=self.require_calibrated_swap,
+                ),
+            )
+            if rejection is not None:
+                return 409, json.dumps(rejection.to_dict()).encode(), None
+            if self._stopping():
+                # stop arrived while the green fleet staged: the pump may
+                # already have drained its admin inbox, so an append now
+                # would never be consumed and this handler would hang on
+                # its future. No await separates this check from the
+                # append below, so the pump cannot drain in between; a
+                # stop requested AFTER the append is resolved typed by
+                # _graceful_drain's admin sweep.
+                return 503, json.dumps(
+                    {"ok": False, "reason": REASON_SHUTDOWN}
+                ).encode(), None
+            # only the FLIP (cheap: queue transfer + adopt) runs on the
+            # pump, between traffic steps — atomic with respect to
+            # submissions and polls by construction
+            fut: asyncio.Future = loop.create_future()
+            self._admin.append(
+                (lambda: flip_fleet(self.replicas, factory, standbys), fut)
+            )
+            self._kick.set()
+            report = await asyncio.wait_for(fut, timeout=600.0)
+        if isinstance(report, dict):  # shutdown raced the swap
+            return 503, json.dumps(report).encode(), None
+        return (200 if report.ok else 409), json.dumps(
+            report.to_dict()
+        ).encode(), None

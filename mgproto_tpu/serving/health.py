@@ -6,8 +6,11 @@ Two distinct questions, per the usual orchestration contract:
     object is intact; an orchestrator restarts on false/timeout.
   * readiness — "should traffic be routed here?" False until bucket warmup
     has compiled every serving shape (first-request compiles would blow the
-    latency SLO) and while the circuit breaker is OPEN (the backend is
-    failing; routing more traffic in makes the outage worse).
+    latency SLO), while the circuit breaker is OPEN (the backend is
+    failing; routing more traffic in makes the outage worse), and while the
+    engine is DRAINING (graceful shutdown or a blue/green flip in flight:
+    queued work still answers, new work must go elsewhere). Half-open is
+    READY: the breaker is probing its way back and the probe IS traffic.
 
 Degraded mode is READY (classification still serves) but reported, so a
 fleet can alert on trust-gating coverage without failing over.
@@ -33,10 +36,12 @@ class HealthProbe:
     def readiness(self) -> Dict[str, Any]:
         e = self.engine
         breaker_open = e.breaker.state == BREAKER_OPEN
-        ready = e.warmed_up and not breaker_open
+        draining = bool(getattr(e, "draining", False))
+        ready = e.warmed_up and not breaker_open and not draining
         return {
             "ready": ready,
             "warmed_up": e.warmed_up,
+            "draining": draining,
             "buckets": list(e.buckets),
             "breaker_state": e.breaker.state,
             "degraded": e.gate.degraded,

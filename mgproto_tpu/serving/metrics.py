@@ -31,6 +31,17 @@ FINGERPRINT_MISMATCHES = "serving_fingerprint_mismatch_total"
 DEGRADED_REQUESTS = "serving_degraded_requests_total"
 DEVICE_ERRORS = "serving_device_errors_total"
 BATCH_FILL = "serving_batch_fill_ratio"
+# --- network serving plane (ISSUE 7) ---
+BATCH_FILL_HIST = "serving_batch_fill_fraction"
+DISPATCHES = "serving_batch_dispatch_total"
+QUEUE_DEPTH = "serving_queue_depth"
+REPLICA_RESTARTS = "serving_replica_restarts_total"
+REPLICAS_READY = "serving_replicas_ready"
+REPLICAS_TOTAL = "serving_replicas_total"
+BREAKER_OPEN_FRACTION = "serving_breaker_open_fraction"
+UPTIME_SECONDS = "serving_uptime_seconds"
+SWAPS = "serving_swap_total"
+SWAP_TRANSFERRED = "serving_swap_transferred_total"
 
 COUNTER_HELP = {
     REQUESTS: "requests by outcome (predict/abstain/reject/shed)",
@@ -41,16 +52,41 @@ COUNTER_HELP = {
         "fingerprint the thresholds were derived from",
     DEGRADED_REQUESTS: "requests answered WITHOUT OoD gating (degraded mode)",
     DEVICE_ERRORS: "inference dispatches that raised a device error",
+    DISPATCHES:
+        "micro-batch dispatches by trigger "
+        "(bucket_full/deadline/linger/drain)",
+    REPLICA_RESTARTS:
+        "replica drain+restart cycles by detected failure (dead/wedged)",
+    SWAPS: "blue/green hot-swap attempts by result (committed/rejected)",
+    SWAP_TRANSFERRED:
+        "queued requests transferred old->new engine during a hot swap "
+        "(the zero-dropped-requests guarantee, made countable)",
 }
 
 GAUGE_HELP = {
     ABSTAIN_RATE: "abstain fraction over the trailing decision window",
     BREAKER_STATE: "circuit breaker state (0=closed, 0.5=half-open, 1=open)",
     BATCH_FILL: "occupied fraction of the last padded serving batch",
+    QUEUE_DEPTH: "admission queue depth (per replica, and unlabeled total)",
+    REPLICAS_READY: "replicas currently passing the readiness probe",
+    REPLICAS_TOTAL: "replicas the supervisor is responsible for",
+    BREAKER_OPEN_FRACTION:
+        "fraction of replica-seconds spent with the breaker OPEN",
+    UPTIME_SECONDS: "seconds since the replica supervisor started",
 }
+
+# batch fill is a fraction in (0, 1]; the default time buckets would dump
+# every observation into one bin
+FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 
 HIST_HELP = {
     REQUEST_SECONDS: "per-request latency (admission to response), by outcome",
+    BATCH_FILL_HIST:
+        "occupied fraction of each padded serving batch (per dispatch)",
+}
+
+HIST_BUCKETS = {
+    BATCH_FILL_HIST: FILL_BUCKETS,
 }
 
 ALL_COUNTERS = tuple(COUNTER_HELP)
@@ -69,7 +105,10 @@ def gauge(name: str) -> Gauge:
 
 def histogram(name: str) -> Histogram:
     """The named serving histogram in the process-current registry."""
-    return default_registry().histogram(name, HIST_HELP.get(name, ""))
+    kw = {}
+    if name in HIST_BUCKETS:
+        kw["buckets"] = HIST_BUCKETS[name]
+    return default_registry().histogram(name, HIST_HELP.get(name, ""), **kw)
 
 
 def register_serving_metrics(registry) -> None:
@@ -81,4 +120,7 @@ def register_serving_metrics(registry) -> None:
     for name in ALL_GAUGES:
         registry.gauge(name, GAUGE_HELP[name]).set(0.0)
     for name in HIST_HELP:
-        registry.histogram(name, HIST_HELP[name])
+        kw = {}
+        if name in HIST_BUCKETS:
+            kw["buckets"] = HIST_BUCKETS[name]
+        registry.histogram(name, HIST_HELP[name], **kw)

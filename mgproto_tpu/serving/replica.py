@@ -1,0 +1,401 @@
+"""Replica supervision: N serving workers behind one admission boundary.
+
+The fault-tolerance layer between the HTTP frontend and the engines, in the
+production-serving spirit of the TensorFlow system paper (PAPERS.md): the
+model is replicated, replicas fail, and the fleet's job is to keep every
+request typed while survivors absorb the load.
+
+  * ROUTING — round-robin over replicas whose `HealthProbe.readiness()` is
+    true. A replica with an OPEN breaker, mid-warmup, or draining gets no
+    new traffic. With zero ready replicas the request is answered with a
+    typed shed (`no_replica`) — overload and total outage degrade to
+    shed-rate telemetry, never to silence.
+  * HEARTBEATS — every successful supervisor pass over a live replica beats
+    it. A replica that stops beating (worker wedged on a device call, or
+    the simulated process death the chaos harness injects) is detected when
+    its heartbeat goes stale, DRAINED (its queued requests reroute to
+    survivors, preserving each request's original deadline and enqueue
+    time), and scheduled for restart on `resilience.retry`'s backoff
+    schedule — the same pacing policy every other recovery path uses.
+  * RESTARTS — a due replica rebuilds its engine from the factory and
+    re-warms every bucket before rejoining the rotation (readiness stays
+    false throughout, so the warmup compiles are never on a request's
+    critical path). A failing factory re-enters backoff at the next longer
+    delay.
+
+Clock injectable throughout; nothing here sleeps or blocks (enforced by
+scripts/check_no_blocking_sleep.py) — the supervisor is a `poll()` pump the
+frontend (or the load harness) drives.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from mgproto_tpu.resilience import chaos as _chaos
+from mgproto_tpu.resilience.retry import backoff_delays
+from mgproto_tpu.serving import metrics as _m
+from mgproto_tpu.serving.batcher import BatcherConfig, MicroBatcher
+from mgproto_tpu.serving.health import HealthProbe
+from mgproto_tpu.serving.response import (
+    REASON_NO_REPLICA,
+    REASON_REPLICA_LOST,
+    REASON_SHUTDOWN,
+    ServeResponse,
+    shed_response,
+)
+
+STATE_READY = "ready"
+STATE_BACKOFF = "backoff"  # failed; waiting for its scheduled restart
+
+FAILURE_DEAD = "dead"  # stopped beating, process presumed gone
+FAILURE_WEDGED = "wedged"  # stopped beating, process present but stuck
+
+
+class Replica:
+    """One supervised worker: engine + batcher + probe + heartbeat."""
+
+    def __init__(
+        self,
+        name: str,
+        factory: Callable[[], "object"],
+        clock: Callable[[], float],
+        batcher_config: Optional[BatcherConfig] = None,
+        pre_dispatch: Optional[Callable[[], None]] = None,
+    ):
+        self.name = name
+        self.factory = factory
+        self.clock = clock
+        self.batcher_config = batcher_config
+        self.pre_dispatch = pre_dispatch
+        self.engine = None
+        self.batcher: Optional[MicroBatcher] = None
+        self.probe: Optional[HealthProbe] = None
+        self.state = STATE_BACKOFF
+        self.alive = True  # False = simulated process death (chaos kill)
+        self.wedged = False  # True = present but unresponsive (chaos wedge)
+        self.last_beat = 0.0
+        self.restarts = 0  # restart ATTEMPTS performed (paces the backoff)
+        self.restart_at = 0.0  # clock() time the next attempt is due
+
+    # ----------------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        """Build + warm the engine; returns warmup compile count. Raises on
+        factory/warmup failure (the supervisor converts that into backoff)."""
+        self.engine = self.factory()
+        compiled = self.engine.warmup()
+        self.batcher = MicroBatcher(
+            self.engine,
+            config=self.batcher_config,
+            clock=self.clock,
+            name=self.name,
+            pre_dispatch=self.pre_dispatch,
+        )
+        self.probe = HealthProbe(self.engine)
+        self.state = STATE_READY
+        self.alive = True
+        self.wedged = False
+        self.last_beat = self.clock()
+        return compiled
+
+    def adopt(self, engine) -> None:
+        """Install an already-warmed engine (the blue/green flip target);
+        the replica keeps its identity, heartbeat history restarts."""
+        self.engine = engine
+        self.batcher = MicroBatcher(
+            engine,
+            config=self.batcher_config,
+            clock=self.clock,
+            name=self.name,
+            pre_dispatch=self.pre_dispatch,
+        )
+        self.probe = HealthProbe(engine)
+        self.state = STATE_READY
+        self.alive = True
+        self.wedged = False
+        self.last_beat = self.clock()
+
+    # ------------------------------------------------------------------- status
+    def responsive(self) -> bool:
+        """Can this replica do work RIGHT NOW (beat + dispatch)?"""
+        return (
+            self.state == STATE_READY
+            and self.alive
+            and not self.wedged
+            and self.engine is not None
+        )
+
+    def routable(self) -> bool:
+        """Should NEW traffic land here? Responsive + readiness contract."""
+        return bool(
+            self.responsive() and self.probe.readiness()["ready"]
+        )
+
+    def beat_stale(self, now: float, timeout_s: float) -> bool:
+        return now - self.last_beat > timeout_s
+
+
+class ReplicaSet:
+    """The supervisor (see module docstring)."""
+
+    def __init__(
+        self,
+        engine_factory: Callable[[], "object"],
+        replicas: int = 2,
+        clock: Callable[[], float] = time.monotonic,
+        heartbeat_timeout_s: float = 1.0,
+        restart_base_delay_s: float = 0.1,
+        restart_max_delay_s: float = 5.0,
+        batcher_config: Optional[BatcherConfig] = None,
+        pre_dispatch: Optional[Callable[[], None]] = None,
+    ):
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self.engine_factory = engine_factory
+        self.clock = clock
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.restart_base_delay_s = float(restart_base_delay_s)
+        self.restart_max_delay_s = float(restart_max_delay_s)
+        self.batcher_config = batcher_config
+        self.pre_dispatch = pre_dispatch
+        self.replicas: List[Replica] = [
+            self._make_replica(f"r{i}") for i in range(int(replicas))
+        ]
+        self._rr = 0  # round-robin cursor
+        self._admit_seq = 0  # global admitted-request index (chaos identity)
+        self._started_at: Optional[float] = None
+        self.steady_recompiles = 0  # accumulated post-warmup recompiles
+        _m.gauge(_m.REPLICAS_TOTAL).set(float(len(self.replicas)))
+
+    def _make_replica(self, name: str) -> Replica:
+        return Replica(
+            name,
+            lambda: self.engine_factory(),  # late-bound: hot swap retargets
+            self.clock,
+            batcher_config=self.batcher_config,
+            pre_dispatch=self.pre_dispatch,
+        )
+
+    # ----------------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        """Build + warm every replica; returns total warmup compiles."""
+        self._started_at = self.clock()
+        compiled = 0
+        for rep in self.replicas:
+            compiled += rep.start()
+        self._observe()
+        return compiled
+
+    # ------------------------------------------------------------------ routing
+    def ready_replicas(self) -> List[Replica]:
+        return [rep for rep in self.replicas if rep.routable()]
+
+    def _pick(self) -> Optional[Replica]:
+        ready = self.ready_replicas()
+        if not ready:
+            return None
+        rep = ready[self._rr % len(ready)]
+        self._rr += 1
+        return rep
+
+    def submit(
+        self,
+        payload,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> List[ServeResponse]:
+        """Route one request to a ready replica. Same contract as
+        `ServingEngine.submit`: the returned list holds any IMMEDIATE typed
+        responses (reject/shed, for this or evicted requests); empty means
+        queued, `poll()` will answer it."""
+        seq = self._admit_seq
+        self._admit_seq += 1
+        rid = request_id or f"g{seq}"
+        target = self._pick()
+        chaos = _chaos.get_active()
+        if chaos is not None and target is not None:
+            # simulated process death / wedge of the replica this request
+            # would have landed on; the request itself reroutes
+            if chaos.serve_replica_kill_due(seq):
+                target.alive = False
+                target = self._pick()
+            elif chaos.serve_replica_wedge_due(seq):
+                target.wedged = True
+                target = self._pick()
+        if target is None:
+            return [shed_response(rid, REASON_NO_REPLICA)]
+        return target.engine.submit(
+            payload, request_id=rid, deadline_s=deadline_s
+        )
+
+    # ------------------------------------------------------------------- pumping
+    def poll(self) -> List[ServeResponse]:
+        """One supervisor pass: restart due replicas, detect stale
+        heartbeats (drain + reroute + schedule restart), pump every
+        responsive replica's batcher, refresh fleet gauges."""
+        out: List[ServeResponse] = []
+        now = self.clock()
+        for rep in self.replicas:
+            if rep.state == STATE_BACKOFF:
+                if now >= rep.restart_at:
+                    self._try_restart(rep)
+                continue
+            if rep.responsive():
+                # an OPEN breaker takes the replica out of rotation, so no
+                # traffic arrives to call allow() and perform the lazy
+                # half-open transition; tick it here or the replica could
+                # never rejoin after the cooldown
+                rep.engine.breaker.tick()
+                # a responsive worker beats by doing work; staleness is
+                # only meaningful for one that CANNOT beat — so the check
+                # stays independent of the supervisor's own pass cadence
+                out.extend(rep.batcher.poll())
+                rep.last_beat = self.clock()
+                self.steady_recompiles += rep.engine.monitor.check_recompiles()
+            elif rep.beat_stale(now, self.heartbeat_timeout_s):
+                out.extend(self._fail(rep, now))
+        self._observe()
+        return out
+
+    def flush(self) -> List[ServeResponse]:
+        """Answer everything queued through the device WITHOUT leaving the
+        rotation (batch drivers use this between submission waves; `drain`
+        is the terminal, readiness-dropping variant)."""
+        out: List[ServeResponse] = []
+        for rep in self.replicas:
+            if rep.responsive():
+                out.extend(rep.batcher.flush())
+                self.steady_recompiles += rep.engine.monitor.check_recompiles()
+        self._observe()
+        return out
+
+    def shed_stranded(
+        self, reason: str = REASON_REPLICA_LOST
+    ) -> List[ServeResponse]:
+        """Typed sheds for requests queued on replicas that cannot dispatch
+        (killed/wedged but not yet heartbeat-detected). Batch drivers call
+        this at exit so a fast batch cannot end with work stranded on a
+        downed replica — the long-running faces let `poll()`'s detection
+        reroute instead."""
+        out: List[ServeResponse] = []
+        now = self.clock()
+        for rep in self.replicas:
+            if rep.engine is None or rep.responsive():
+                continue
+            stranded = rep.engine.queue.drain_all()
+            stranded.extend(rep.engine.queue.drain_shed())
+            for req in stranded:
+                out.append(
+                    shed_response(
+                        req.request_id, reason,
+                        latency_s=now - req.enqueued_at,
+                    )
+                )
+        self._observe()
+        return out
+
+    def drain(self, reason: str = REASON_SHUTDOWN) -> List[ServeResponse]:
+        """Graceful shutdown: every queued request is ANSWERED (responsive
+        replicas flush through the device) or SHED typed (unresponsive
+        replicas' queues). Nothing is dropped; readiness goes false."""
+        out: List[ServeResponse] = []
+        for rep in self.replicas:
+            if rep.engine is None:
+                continue
+            rep.engine.draining = True
+            if rep.responsive():
+                out.extend(rep.batcher.flush())
+                self.steady_recompiles += rep.engine.monitor.check_recompiles()
+            else:
+                out.extend(rep.engine.drain(reason))
+        self._observe()
+        return out
+
+    # ------------------------------------------------------------------ failure
+    def _fail(self, rep: Replica, now: float) -> List[ServeResponse]:
+        """Heartbeat-stale replica: account it, reroute its queue to
+        survivors (original deadlines and enqueue times intact), schedule
+        the restart on the retry-backoff schedule."""
+        reason = FAILURE_WEDGED if rep.alive else FAILURE_DEAD
+        _m.counter(_m.REPLICA_RESTARTS).inc(reason=reason)
+        out: List[ServeResponse] = []
+        stranded = rep.engine.queue.drain_all() if rep.engine else []
+        stranded.extend(rep.engine.queue.drain_shed() if rep.engine else [])
+        survivors = [
+            s for s in self.replicas if s is not rep and s.responsive()
+        ]
+        i = 0
+        for req in stranded:
+            placed = False
+            for _ in range(len(survivors)):
+                target = survivors[i % len(survivors)] if survivors else None
+                i += 1
+                if target is not None and target.engine.queue.restore(req):
+                    placed = True
+                    break
+            if not placed:
+                out.append(
+                    shed_response(
+                        req.request_id,
+                        REASON_REPLICA_LOST,
+                        latency_s=now - req.enqueued_at,
+                    )
+                )
+        rep.engine = None
+        rep.batcher = None
+        rep.probe = None
+        rep.state = STATE_BACKOFF
+        rep.restart_at = now + self._restart_delay(rep.restarts)
+        return out
+
+    def _restart_delay(self, attempts: int) -> float:
+        """The (attempts+1)-th backoff delay from the shared retry
+        schedule, jitter-free (deterministic recovery pacing — the same
+        discipline CircuitBreaker._cooldown uses)."""
+        delays = list(
+            backoff_delays(
+                attempts + 1,
+                base_delay=self.restart_base_delay_s,
+                max_delay=self.restart_max_delay_s,
+                jitter=0.0,
+            )
+        )
+        return delays[-1]
+
+    def _try_restart(self, rep: Replica) -> None:
+        rep.restarts += 1
+        try:
+            rep.start()
+        except Exception:
+            # the factory/warmup failed (artifact gone, device sick): stay
+            # in backoff at the next longer delay; the fleet keeps serving
+            rep.engine = None
+            rep.batcher = None
+            rep.probe = None
+            rep.state = STATE_BACKOFF
+            rep.restart_at = self.clock() + self._restart_delay(rep.restarts)
+
+    # ------------------------------------------------------------------- gauges
+    def _observe(self) -> None:
+        now = self.clock()
+        ready = len(self.ready_replicas())
+        _m.gauge(_m.REPLICAS_READY).set(float(ready))
+        depth = sum(
+            len(rep.engine.queue)
+            for rep in self.replicas
+            if rep.engine is not None
+        )
+        _m.gauge(_m.QUEUE_DEPTH).set(float(depth))
+        if self._started_at is not None:
+            uptime = max(now - self._started_at, 0.0)
+            _m.gauge(_m.UPTIME_SECONDS).set(uptime)
+            open_s = sum(
+                rep.engine.breaker.open_seconds(now)
+                for rep in self.replicas
+                if rep.engine is not None
+            )
+            denom = uptime * max(len(self.replicas), 1)
+            _m.gauge(_m.BREAKER_OPEN_FRACTION).set(
+                open_s / denom if denom > 0 else 0.0
+            )

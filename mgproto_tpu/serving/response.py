@@ -1,0 +1,83 @@
+"""The one typed response shape every serving request is answered with.
+
+Extracted from `serving/engine.py` (ISSUE 7) so the network plane —
+batcher, replica supervisor, swap, HTTP frontend — can construct and
+account typed responses without importing the engine (which pulls jax in):
+a frontend host must be able to shed typed during an outage even if the
+device stack is the thing that is down.
+
+`record()` is the ONE metrics account for a response leaving the system
+(requests-by-outcome counter, latency histogram, degraded counter); the
+engine and every plane component route through it so a response can never
+be double- or un-counted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from mgproto_tpu.serving import metrics as _m
+
+OUTCOME_PREDICT = "predict"
+OUTCOME_ABSTAIN = "abstain"
+OUTCOME_REJECT = "reject"
+OUTCOME_SHED = "shed"
+
+# reject/shed reasons minted by the plane (validation reasons come from
+# serving/validate.py, admission reasons from serving/admission.py)
+REASON_CIRCUIT_OPEN = "circuit_open"
+REASON_DEVICE_ERROR = "device_error"
+REASON_SHUTDOWN = "shutdown"  # graceful drain: answered typed, never dropped
+REASON_NO_REPLICA = "no_replica"  # every replica dead/unready: typed shed
+REASON_REPLICA_LOST = "replica_lost"  # rerouted off a dead replica, no room
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResponse:
+    """The one shape every request is answered with — no other exit path."""
+
+    request_id: str
+    outcome: str  # predict | abstain | reject | shed
+    prediction: Optional[int] = None
+    log_px: Optional[float] = None
+    trust: Optional[str] = None  # in_dist | abstain | ungated
+    trust_score: Optional[float] = None  # calibrated ID-quantile of log_px
+    confidence: Optional[float] = None  # temperature-calibrated max softmax
+    degraded: bool = False
+    reason: Optional[str] = None  # reject/shed cause
+    latency_s: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def record(resp: ServeResponse) -> ServeResponse:
+    """Account a response leaving the system (see module docstring)."""
+    _m.counter(_m.REQUESTS).inc(outcome=resp.outcome)
+    _m.histogram(_m.REQUEST_SECONDS).observe(
+        max(resp.latency_s, 0.0), outcome=resp.outcome
+    )
+    if resp.degraded and resp.outcome == OUTCOME_PREDICT:
+        _m.counter(_m.DEGRADED_REQUESTS).inc()
+    return resp
+
+
+def shed_response(
+    request_id: str,
+    reason: str,
+    latency_s: float = 0.0,
+    degraded: bool = False,
+) -> ServeResponse:
+    """A recorded typed shed — the plane's answer when no engine can serve
+    (dead replica with no survivors, graceful shutdown, lost reroute)."""
+    _m.counter(_m.SHED).inc(reason=reason)
+    return record(
+        ServeResponse(
+            request_id=request_id,
+            outcome=OUTCOME_SHED,
+            reason=reason,
+            degraded=degraded,
+            latency_s=latency_s,
+        )
+    )

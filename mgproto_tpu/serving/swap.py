@@ -1,0 +1,187 @@
+"""Blue/green hot swap: promote a new artifact with zero dropped requests.
+
+The serve-time half of the model lifecycle: a new `.mgproto` artifact (or
+any engine factory) is staged into STANDBY engines, fully warmed, and
+verified against the trust contract BEFORE any traffic moves. Verification
+fails CLOSED — an artifact that cannot be trust-gated keeps the old model
+serving:
+
+  * `uncalibrated`          — no embedded calibration (and the caller did
+    not explicitly allow degraded serving). The factory's own
+    `UncalibratedArtifactError` is caught into this rejection too.
+  * `fingerprint_mismatch`  — the calibration was measured under a
+    different GMM than the artifact serves (the prune-then-serve regression
+    the TrustGate exists to catch). Promoting it would silently misgate.
+  * `stage_failed`          — the factory or bucket warmup raised: the
+    artifact cannot even serve, let alone be promoted.
+
+Only after EVERY standby verifies does traffic flip, one replica at a time:
+the old engine is marked draining (readiness false — no new routing), its
+queued requests transfer into the standby's queue with their original
+deadlines and enqueue times intact (`AdmissionQueue.restore`), and the
+replica adopts the standby. Queued work is never dropped and never shed by
+the flip itself: the standby's queue starts empty and has the same
+capacity, so every transfer fits by construction. The set's factory is
+retargeted so later restarts build the NEW model.
+
+The chaos knob MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT simulates an operator
+pushing an uncalibrated artifact (the staged engine's gate is stripped),
+which must surface as a typed `uncalibrated` rejection — drilled by
+scripts/load_test.py and the tier-1 chaos test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from mgproto_tpu.resilience import chaos as _chaos
+from mgproto_tpu.serving import metrics as _m
+from mgproto_tpu.serving.replica import ReplicaSet
+
+SWAP_COMMITTED = "committed"
+SWAP_REJECTED = "rejected"
+
+REJECT_UNCALIBRATED = "uncalibrated"
+REJECT_FINGERPRINT = "fingerprint_mismatch"
+REJECT_STAGE_FAILED = "stage_failed"
+REJECT_NOT_WARMED = "not_warmed"
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapReport:
+    """What a swap attempt did — one record per attempt, always returned,
+    never raised (a refused promotion is an outcome, not an error)."""
+
+    ok: bool
+    reason: str  # SWAP_COMMITTED or a REJECT_* cause
+    replicas_swapped: int = 0
+    transferred: int = 0  # queued requests moved old -> new
+    detail: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def verify_standby(engine, require_calibrated: bool = True) -> Optional[str]:
+    """The promotion gate: None when the standby may take traffic, else the
+    REJECT_* reason. Fingerprint mismatch outranks uncalibrated: the gate
+    degrades itself on mismatch, and reporting that as 'uncalibrated' would
+    hide the actual operator error (stale calibration, not missing one)."""
+    if not getattr(engine, "warmed_up", False):
+        return REJECT_NOT_WARMED
+    if engine.gate.fingerprint_mismatch:
+        return REJECT_FINGERPRINT
+    if engine.gate.degraded and require_calibrated:
+        return REJECT_UNCALIBRATED
+    return None
+
+
+def stage_standby(
+    factory: Callable[[], Any], require_calibrated: bool = True
+) -> Tuple[Optional[Any], Optional[str], str]:
+    """Build + warm + verify one standby engine. Returns
+    (engine, None, "") on success or (None, reject_reason, detail)."""
+    from mgproto_tpu.serving.engine import UncalibratedArtifactError
+
+    try:
+        engine = factory()
+        engine.warmup()
+    except UncalibratedArtifactError as e:
+        return None, REJECT_UNCALIBRATED, str(e)
+    except Exception as e:  # artifact unreadable, warmup OOM, ...
+        return None, REJECT_STAGE_FAILED, f"{type(e).__name__}: {e}"
+    chaos = _chaos.get_active()
+    if chaos is not None and chaos.serve_swap_bad_artifact_due():
+        # drill: the operator pushed an artifact with no trust data; the
+        # verification below must refuse it exactly like the real thing
+        from mgproto_tpu.serving.gate import TrustGate
+
+        engine.gate = TrustGate(None)
+    reason = verify_standby(engine, require_calibrated=require_calibrated)
+    if reason is not None:
+        return None, reason, ""
+    return engine, None, ""
+
+
+def stage_fleet(
+    count: int,
+    standby_factory: Callable[[], Any],
+    require_calibrated: bool = True,
+) -> Tuple[List[Any], Optional[SwapReport]]:
+    """Stage + verify `count` standby engines — the EXPENSIVE, trafficless
+    half of a swap (artifact loads + bucket warmup compiles). It touches no
+    live state, so callers that serialize ReplicaSet access through a pump
+    (the HTTP frontend) may run it off-pump while traffic keeps flowing.
+    Returns (standbys, None) or ([], rejection) — the whole green fleet
+    stages BEFORE any traffic moves: a mid-flip stage failure would leave a
+    mixed fleet, which is exactly the non-atomicity blue/green prevents."""
+    standbys: List[Any] = []
+    for _ in range(max(int(count), 1)):
+        engine, reason, detail = stage_standby(
+            standby_factory, require_calibrated=require_calibrated
+        )
+        if engine is None:
+            _m.counter(_m.SWAPS).inc(result=SWAP_REJECTED, reason=reason)
+            return [], SwapReport(ok=False, reason=reason, detail=detail)
+        standbys.append(engine)
+    return standbys, None
+
+
+def flip_fleet(
+    replica_set: ReplicaSet,
+    standby_factory: Callable[[], Any],
+    standbys: List[Any],
+) -> SwapReport:
+    """The CHEAP, atomic half: flip traffic replica-by-replica with queued
+    work transferred, then retarget the set's factory. Must run where
+    ReplicaSet access is serialized (the frontend's pump, or the single
+    batch-driver thread). The live list is taken NOW — a replica that
+    failed or restarted while standbys staged is handled, provided
+    `standbys` covers every replica that might be live (callers stage one
+    per replica slot)."""
+    live = [rep for rep in replica_set.replicas if rep.engine is not None]
+    transferred = 0
+    swapped = 0
+    for rep, standby in zip(live, standbys):
+        old = rep.engine
+        old.draining = True  # readiness false: no new routing to blue
+        moved = old.queue.drain_all()
+        for req in moved:
+            # same capacity, empty target: restore cannot fail, but a
+            # False here must still never lose the request
+            if not standby.queue.restore(req):  # pragma: no cover
+                raise RuntimeError(
+                    "swap transfer overflowed the standby queue"
+                )
+        transferred += len(moved)
+        rep.adopt(standby)
+        swapped += 1
+    replica_set.engine_factory = standby_factory
+    _m.counter(_m.SWAPS).inc(result=SWAP_COMMITTED)
+    _m.counter(_m.SWAP_TRANSFERRED).inc(float(transferred))
+    return SwapReport(
+        ok=True,
+        reason=SWAP_COMMITTED,
+        replicas_swapped=swapped,
+        transferred=transferred,
+    )
+
+
+def hot_swap(
+    replica_set: ReplicaSet,
+    standby_factory: Callable[[], Any],
+    require_calibrated: bool = True,
+) -> SwapReport:
+    """Stage a full green fleet, verify every engine, then flip traffic
+    replica-by-replica with queued work transferred (see module docstring).
+    Counts `serving_swap_total{result=...}`."""
+    live = sum(
+        1 for rep in replica_set.replicas if rep.engine is not None
+    )
+    standbys, rejection = stage_fleet(
+        live, standby_factory, require_calibrated=require_calibrated
+    )
+    if rejection is not None:
+        return rejection
+    return flip_fleet(replica_set, standby_factory, standbys)

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Lint: no blocking sleeps/waits in the serving request path.
+
+The serving plane (mgproto_tpu/serving/) is a poll-driven pump over
+injectable clocks: the admission queue, circuit breaker, micro-batcher,
+replica supervisor and hot swap all take `clock=` so chaos/load tests drive
+deadline pressure and recovery pacing deterministically, and the asyncio
+frontend must never stall its event loop. A `time.sleep` (or an un-injected
+blocking retry) anywhere in serving/ breaks both properties at once — it
+stalls real traffic AND makes the fault drills timing-dependent.
+
+AST-based (companion to check_no_print.py / check_no_signal_handlers.py).
+Flags, in every module under mgproto_tpu/serving/:
+
+  * any call to `time.sleep` — through any alias of the `time` module
+    (`import time as t; t.sleep(...)`) or a bare name bound from it
+    (`from time import sleep`). `await asyncio.sleep(...)` is fine (it
+    yields the event loop; nothing blocks).
+  * any call to `retry_call`/`retryable` (resilience/retry) WITHOUT an
+    explicit `sleep=` keyword: the default sleeps `time.sleep` internally,
+    which is the same blocking wait wearing a policy hat. Serving code must
+    pace recovery through schedules (`backoff_delays`) checked against the
+    injected clock — see CircuitBreaker._cooldown / ReplicaSet._restart_delay.
+
+Run from anywhere:
+
+    python scripts/check_no_blocking_sleep.py [repo_root]
+
+Exit 0 when clean, 1 with one `path:line` per offender otherwise. Wired
+into tier-1 via tests/test_serving_plane.py (with violation-detection
+coverage, like the other lint scripts).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+_RETRY_NAMES = ("retry_call", "retryable")
+
+
+def _imports(tree: ast.AST) -> Tuple[set, set]:
+    """(aliases of the time module, names bound to time.sleep)."""
+    aliases, bare = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    bare.add(a.asname or "sleep")
+    return aliases, bare
+
+
+def _offending_calls(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+    aliases, bare = _imports(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "sleep"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in aliases
+        ):
+            yield node.lineno, "time.sleep in the serving path"
+        elif isinstance(f, ast.Name) and f.id in bare:
+            yield node.lineno, "time.sleep (from-import) in the serving path"
+        elif (
+            isinstance(f, ast.Name)
+            and f.id in _RETRY_NAMES
+            and not any(kw.arg == "sleep" for kw in node.keywords)
+        ):
+            yield (
+                node.lineno,
+                f"{f.id}() without an injected sleep= "
+                "(its default blocks on time.sleep)",
+            )
+
+
+def offenders(repo_root: str) -> List[Tuple[str, int, str]]:
+    pkg = os.path.join(repo_root, "mgproto_tpu", "serving")
+    found = []
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    found.append((
+                        os.path.relpath(path, repo_root), e.lineno or 0,
+                        "unparseable module",
+                    ))
+                    continue
+            for lineno, why in _offending_calls(tree):
+                found.append(
+                    (os.path.relpath(path, repo_root), lineno, why)
+                )
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = offenders(root)
+    for path, lineno, why in found:
+        print(f"{path}:{lineno}: {why} (use the injectable clock=/schedule "
+              "pattern; see serving/batcher.py)")
+    if found:
+        return 1
+    print("check_no_blocking_sleep: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

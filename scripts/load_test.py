@@ -1,0 +1,387 @@
+#!/usr/bin/env python
+"""Seeded sustained-RPS load test for the serving plane (ISSUE 7).
+
+The chaos-storm drill generalized into a load harness: a deterministic
+arrival schedule (phases of `DURxRPS` with ramps), a VIRTUAL clock (no real
+sleeps — the same injectable-clock discipline the admission queue and chaos
+tests use), and a synthetic per-dispatch service time advanced through the
+micro-batcher's `pre_dispatch` hook, so queueing, deadline pressure,
+shedding and breaker behavior all emerge from the actual serving-plane code
+paths under a reproducible storm.
+
+Chaos knobs (all optional) drive the fault story mid-run:
+
+  --kill-at N        replica serving request N dies (heartbeat-detected,
+                     queue rerouted, backoff restart)
+  --wedge-at N       replica wedges instead (same detection, distinct label)
+  --swap-bad-at N    a blue/green swap attempt of an UNCALIBRATED artifact
+                     fires before request N — must be rejected fail-closed
+  --swap-good-at N   a calibrated swap fires before request N — must commit
+                     with zero dropped requests
+
+Output is ONE JSON line (stdout, and --out FILE): per-phase p50/p99 latency
++ shed-rate curves, shed-by-reason, breaker open-time fraction, batch-fill
+stats, dispatch-trigger counts, swap reports, restart counts, steady-state
+recompile count, and the zero-dropped accounting. The committed baseline
+lives at evidence/load_test_baseline.json (schema: evidence/README.md);
+tier-1 asserts the drill's invariants in tests/test_load_plane.py.
+
+    python scripts/load_test.py --out evidence/load_test_baseline.json
+
+Hermetic: tiny model, CPU, seeded — no dataset, no network, no TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_PHASES = "2x60,2x300,2x60"
+
+
+class VirtualClock:
+    """Monotonic fake time the whole plane runs on."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def parse_phases(raw: str) -> List[Tuple[float, float]]:
+    """"2x40,4x80" -> [(2.0 s, 40 rps), (4.0 s, 80 rps)]."""
+    phases = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dur, _, rps = part.partition("x")
+        phases.append((float(dur), float(rps)))
+    if not phases:
+        raise ValueError(f"no phases in {raw!r}")
+    return phases
+
+
+def _label_counts(snapshot: Dict, name: str, key: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for s in snapshot.get(name, {}).get("series", []):
+        label = s.get("labels", {}).get(key)
+        if label is not None and s.get("value"):
+            out[label] = out.get(label, 0.0) + s["value"]
+    return out
+
+
+def _pcts(latencies_ms: Sequence[float]) -> Dict[str, Optional[float]]:
+    if not latencies_ms:
+        return {"p50_ms": None, "p99_ms": None, "max_ms": None}
+    arr = np.asarray(latencies_ms, np.float64)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "max_ms": round(float(arr.max()), 3),
+    }
+
+
+def run_load_test(
+    seed: int = 0,
+    phases: Sequence[Tuple[float, float]] = ((2.0, 60.0), (2.0, 300.0),
+                                             (2.0, 60.0)),
+    replicas: int = 2,
+    buckets: Sequence[int] = (1, 2, 4, 8),
+    deadline_ms: float = 100.0,
+    queue_capacity: int = 32,
+    service_ms: float = 4.0,
+    linger_ms: float = 30.0,
+    heartbeat_timeout_s: float = 0.3,
+    kill_at: Optional[int] = None,
+    wedge_at: Optional[int] = None,
+    swap_bad_at: Optional[int] = None,
+    swap_good_at: Optional[int] = None,
+    malformed_rate: float = 0.0,
+    nan_rate: float = 0.0,
+    device_errors: Sequence[int] = (),
+) -> Dict:
+    """Drive the storm; returns the result record (see module docstring).
+    Importable — tests/test_load_plane.py runs the acceptance drill through
+    this exact function."""
+    import jax
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.resilience import chaos as chaos_mod
+    from mgproto_tpu.serving import metrics as sm
+    from mgproto_tpu.serving.batcher import BatcherConfig
+    from mgproto_tpu.serving.calibration import calibrate
+    from mgproto_tpu.serving.engine import ServingEngine
+    from mgproto_tpu.serving.replica import ReplicaSet
+    from mgproto_tpu.serving.swap import hot_swap
+    from mgproto_tpu.telemetry.registry import (
+        MetricRegistry,
+        percentile_from_buckets,
+        set_current_registry,
+    )
+
+    registry = MetricRegistry()
+    prev_registry = set_current_registry(registry)
+    sm.register_serving_metrics(registry)
+    bad_swaps = 1 if swap_bad_at is not None else 0
+    plan = chaos_mod.ChaosPlan(
+        seed=seed,
+        serve_malformed_rate=malformed_rate,
+        serve_nan_rate=nan_rate,
+        serve_device_errors=tuple(device_errors),
+        serve_replica_kill_at=kill_at,
+        serve_wedge_at=wedge_at,
+        serve_swap_bad_artifact=bad_swaps,
+    )
+    prev_chaos = chaos_mod.set_active(
+        chaos_mod.ChaosState(plan) if plan.any_active() else None
+    )
+    try:
+        cfg = tiny_test_config()
+        trainer = Trainer(cfg, steps_per_epoch=1)
+        state = trainer.init_state(jax.random.PRNGKey(seed))
+        rng = np.random.RandomState(seed)
+        id_batches = [
+            (
+                rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3)
+                .astype(np.float32),
+                rng.randint(0, cfg.model.num_classes, (4,)).astype(np.int32),
+            )
+            for _ in range(2)
+        ]
+        calib = calibrate(trainer, state, id_batches)
+        clock = VirtualClock()
+        service_s = service_ms / 1000.0
+
+        def factory():
+            return ServingEngine.from_live(
+                trainer, state,
+                calibration=calib,
+                buckets=tuple(buckets),
+                clock=clock,
+                queue_capacity=queue_capacity,
+                default_deadline_s=deadline_ms / 1000.0,
+            )
+
+        rs = ReplicaSet(
+            factory,
+            replicas=replicas,
+            clock=clock,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            batcher_config=BatcherConfig(
+                cost_prior_s=service_s,
+                max_linger_s=linger_ms / 1000.0,
+            ),
+            # the synthetic device: every dispatch consumes service_ms of
+            # virtual time BEFORE responses are stamped, so latencies and
+            # the batcher's measured-cost EMA both see it
+            pre_dispatch=lambda: clock.advance(service_s),
+        )
+        warmup_compiles = rs.start()
+
+        responses = []
+        swap_reports = []
+        submitted: List[str] = []
+        phase_of: Dict[str, int] = {}
+        payload_rng = np.random.RandomState(seed + 1)
+        img = cfg.model.img_size
+        i = 0
+        for phase_idx, (duration_s, rps) in enumerate(phases):
+            n = max(int(round(duration_s * rps)), 1)
+            spacing = 1.0 / rps
+            for _ in range(n):
+                if swap_bad_at is not None and i == swap_bad_at:
+                    swap_reports.append(
+                        hot_swap(rs, factory).to_dict()
+                    )
+                if swap_good_at is not None and i == swap_good_at:
+                    swap_reports.append(
+                        hot_swap(rs, factory).to_dict()
+                    )
+                rid = f"q{i}"
+                submitted.append(rid)
+                phase_of[rid] = phase_idx
+                payload = payload_rng.rand(img, img, 3).astype(np.float32)
+                responses.extend(rs.submit(payload, request_id=rid))
+                responses.extend(rs.poll())
+                clock.advance(spacing)
+                i += 1
+        # drain: keep pumping virtual time until every request is answered
+        # (restarting replicas come back, stragglers hit their deadlines)
+        answered = {r.request_id for r in responses}
+        drain_dt = max(linger_ms, service_ms) / 1000.0
+        for _ in range(10_000):
+            if len(answered) >= len(submitted):
+                break
+            responses.extend(rs.poll())
+            answered = {r.request_id for r in responses}
+            clock.advance(drain_dt)
+        responses.extend(rs.drain())
+        answered = {r.request_id for r in responses}
+
+        # ----------------------------------------------------------- analysis
+        snapshot = registry.snapshot()
+        by_outcome: Dict[str, int] = {}
+        for r in responses:
+            by_outcome[r.outcome] = by_outcome.get(r.outcome, 0) + 1
+        served_lat = [
+            r.latency_s * 1000.0
+            for r in responses
+            if r.outcome in ("predict", "abstain")
+        ]
+        phase_rows = []
+        for phase_idx, (duration_s, rps) in enumerate(phases):
+            rows = [
+                r for r in responses if phase_of.get(r.request_id) == phase_idx
+            ]
+            lat = [
+                r.latency_s * 1000.0
+                for r in rows
+                if r.outcome in ("predict", "abstain")
+            ]
+            shed = sum(r.outcome == "shed" for r in rows)
+            outcomes: Dict[str, int] = {}
+            for r in rows:
+                outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+            phase_rows.append({
+                "duration_s": duration_s,
+                "rps": rps,
+                "requests": len(rows),
+                "outcomes": outcomes,
+                "shed_rate": round(shed / len(rows), 4) if rows else None,
+                **_pcts(lat),
+            })
+        fill = snapshot.get(sm.BATCH_FILL_HIST, {}).get("series", [])
+        fill_stats = None
+        if fill and fill[0].get("count"):
+            s = fill[0]
+            fill_stats = {
+                "dispatches": s["count"],
+                "mean": round(s["sum"] / s["count"], 4),
+                "p50": round(percentile_from_buckets(s, 50.0), 4),
+            }
+        open_fraction = None
+        for s in snapshot.get(sm.BREAKER_OPEN_FRACTION, {}).get("series", []):
+            open_fraction = s.get("value")
+        result = {
+            "load_test": True,
+            "seed": seed,
+            "virtual_clock": True,
+            "config": {
+                "phases": [list(p) for p in phases],
+                "replicas": replicas,
+                "buckets": list(buckets),
+                "deadline_ms": deadline_ms,
+                "queue_capacity": queue_capacity,
+                "service_ms": service_ms,
+                "linger_ms": linger_ms,
+                "heartbeat_timeout_s": heartbeat_timeout_s,
+            },
+            "chaos": {
+                "kill_at": kill_at,
+                "wedge_at": wedge_at,
+                "swap_bad_at": swap_bad_at,
+                "swap_good_at": swap_good_at,
+                "malformed_rate": malformed_rate,
+                "nan_rate": nan_rate,
+                "device_errors": list(device_errors),
+            },
+            "phases": phase_rows,
+            "overall": {
+                "submitted": len(submitted),
+                "answered": len(answered & set(submitted)),
+                "responses": len(responses),
+                "zero_dropped": answered >= set(submitted)
+                and len(responses) == len(set(submitted)),
+                "outcomes": by_outcome,
+                "shed_by_reason": _label_counts(snapshot, sm.SHED, "reason"),
+                **_pcts(served_lat),
+            },
+            "dispatch_triggers": _label_counts(
+                snapshot, sm.DISPATCHES, "trigger"
+            ),
+            "batch_fill": fill_stats,
+            "breaker_open_fraction": open_fraction,
+            "replica_restarts": _label_counts(
+                snapshot, sm.REPLICA_RESTARTS, "reason"
+            ),
+            "swaps": swap_reports,
+            "swap_transferred": registry.counter(sm.SWAP_TRANSFERRED).value(),
+            "swaps_by_result": _label_counts(snapshot, sm.SWAPS, "result"),
+            "warmup_compiles": warmup_compiles,
+            "steady_state_recompiles": rs.steady_recompiles,
+            "virtual_duration_s": round(clock(), 3),
+        }
+        return result
+    finally:
+        chaos_mod.set_active(prev_chaos)
+        set_current_registry(prev_registry)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Seeded virtual-clock load test of the serving plane"
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--phases", default=DEFAULT_PHASES,
+                   help="comma list of DURxRPS ramp phases "
+                        f"(default {DEFAULT_PHASES})")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--buckets", default="1,2,4,8")
+    p.add_argument("--deadline-ms", type=float, default=100.0)
+    p.add_argument("--queue-capacity", type=int, default=32)
+    p.add_argument("--service-ms", type=float, default=4.0,
+                   help="synthetic per-dispatch device time (virtual)")
+    p.add_argument("--linger-ms", type=float, default=30.0)
+    p.add_argument("--heartbeat-timeout-s", type=float, default=0.3)
+    p.add_argument("--kill-at", type=int, default=None)
+    p.add_argument("--wedge-at", type=int, default=None)
+    p.add_argument("--swap-bad-at", type=int, default=None)
+    p.add_argument("--swap-good-at", type=int, default=None)
+    p.add_argument("--malformed-rate", type=float, default=0.0)
+    p.add_argument("--nan-rate", type=float, default=0.0)
+    p.add_argument("--out", default="",
+                   help="write the JSON line here (e.g. "
+                        "evidence/load_test_baseline.json)")
+    args = p.parse_args(argv)
+
+    result = run_load_test(
+        seed=args.seed,
+        phases=parse_phases(args.phases),
+        replicas=args.replicas,
+        buckets=tuple(int(b) for b in args.buckets.split(",") if b.strip()),
+        deadline_ms=args.deadline_ms,
+        queue_capacity=args.queue_capacity,
+        service_ms=args.service_ms,
+        linger_ms=args.linger_ms,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        kill_at=args.kill_at,
+        wedge_at=args.wedge_at,
+        swap_bad_at=args.swap_bad_at,
+        swap_good_at=args.swap_good_at,
+        malformed_rate=args.malformed_rate,
+        nan_rate=args.nan_rate,
+    )
+    line = json.dumps(result, sort_keys=True)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

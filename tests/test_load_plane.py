@@ -1,0 +1,297 @@
+"""ISSUE 7 acceptance: the chaos load storm (tier-1, CPU, seeded, virtual
+clock — no real sleeps) and the CLI faces of the serving plane.
+
+The storm drives scripts/load_test.py's `run_load_test` — sustained-RPS
+ramp phases with a mid-run replica kill, a mid-run swap attempt of an
+uncalibrated artifact, and a later calibrated swap — and asserts:
+
+  * typed-responses-only: every submitted request gets exactly ONE typed
+    response (zero dropped, zero duplicates);
+  * zero steady-state recompiles (StepMonitor assertion through the
+    supervisor's accounting);
+  * the uncalibrated swap is rejected FAIL-CLOSED, the calibrated one
+    commits with zero dropped requests;
+  * p50/p99 + shed-rate curves land in evidence/ (per phase).
+
+Also here: the committed baseline's schema guard, determinism of the
+seeded storm, and the `mgproto-serve` plane flags (--replicas/--swap batch
+drill; --listen network smoke with a real SIGTERM graceful drain).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serving]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from load_test import parse_phases, run_load_test  # noqa: E402
+
+OUTCOMES = {"predict", "abstain", "reject", "shed"}
+
+STORM = dict(
+    seed=3,
+    phases=((0.5, 40.0), (0.5, 160.0), (0.5, 40.0)),
+    replicas=2,
+    buckets=(1, 2, 4),
+    deadline_ms=100.0,
+    service_ms=4.0,
+    linger_ms=20.0,
+    heartbeat_timeout_s=0.25,
+    kill_at=30,
+    swap_bad_at=50,
+    swap_good_at=90,
+    malformed_rate=0.05,
+    nan_rate=0.03,
+)
+
+
+@pytest.fixture(scope="module")
+def storm_result(tmp_path_factory):
+    return run_load_test(**STORM)
+
+
+class TestChaosLoadStorm:
+    def test_every_request_answered_exactly_once_typed(self, storm_result):
+        overall = storm_result["overall"]
+        assert overall["zero_dropped"] is True
+        assert overall["answered"] == overall["submitted"]
+        assert overall["responses"] == overall["submitted"]
+        assert set(overall["outcomes"]) <= OUTCOMES
+        # the chaos injections produced typed rejects, not crashes
+        assert overall["outcomes"].get("reject", 0) > 0
+
+    def test_zero_steady_state_recompiles(self, storm_result):
+        assert storm_result["steady_state_recompiles"] == 0
+        assert storm_result["warmup_compiles"] >= len(STORM["buckets"])
+
+    def test_replica_kill_detected_and_restarted(self, storm_result):
+        assert storm_result["replica_restarts"].get("dead") == 1.0
+
+    def test_uncalibrated_swap_fails_closed_calibrated_commits(
+        self, storm_result
+    ):
+        swaps = storm_result["swaps"]
+        assert len(swaps) == 2
+        assert swaps[0]["ok"] is False
+        assert swaps[0]["reason"] == "uncalibrated"
+        assert swaps[1]["ok"] is True
+        assert swaps[1]["reason"] == "committed"
+        assert storm_result["swaps_by_result"] == {
+            "rejected": 1.0, "committed": 1.0,
+        }
+        # ... and the commit dropped nothing (overall accounting is the
+        # proof: every id answered once, across both swaps and the kill)
+        assert storm_result["overall"]["zero_dropped"] is True
+
+    def test_latency_and_shed_curves_per_phase(self, storm_result, tmp_path):
+        phases = storm_result["phases"]
+        assert len(phases) == 3
+        for row in phases:
+            assert row["requests"] > 0
+            assert row["shed_rate"] is not None
+            if row["p50_ms"] is not None:
+                assert row["p50_ms"] <= row["p99_ms"] <= row["max_ms"]
+        # the curves serialize to the one evidence JSON line
+        out = tmp_path / "load.json"
+        with open(out, "w") as f:
+            f.write(json.dumps(storm_result, sort_keys=True) + "\n")
+        back = json.loads(out.read_text())
+        assert back["phases"] == phases
+
+    def test_batching_actually_coalesced(self, storm_result):
+        fill = storm_result["batch_fill"]
+        assert fill is not None and fill["dispatches"] > 0
+        # fewer dispatches than requests = real coalescing
+        assert fill["dispatches"] < storm_result["overall"]["submitted"]
+        assert storm_result["dispatch_triggers"]  # trigger mix recorded
+
+    def test_storm_is_deterministic(self):
+        small = dict(STORM)
+        small.update(phases=((0.3, 60.0),), kill_at=5,
+                     swap_bad_at=None, swap_good_at=None)
+        a = run_load_test(**small)
+        b = run_load_test(**small)
+        assert a == b
+
+
+class TestBaselineEvidence:
+    PATH = os.path.join(REPO, "evidence", "load_test_baseline.json")
+
+    def test_committed_baseline_schema(self):
+        with open(self.PATH) as f:
+            rec = json.loads(f.readline())
+        assert rec["load_test"] is True and rec["virtual_clock"] is True
+        for key in ("phases", "overall", "swaps", "replica_restarts",
+                    "dispatch_triggers", "batch_fill", "config", "chaos",
+                    "steady_state_recompiles"):
+            assert key in rec, key
+        assert rec["overall"]["zero_dropped"] is True
+        assert rec["steady_state_recompiles"] == 0
+        for row in rec["phases"]:
+            assert {"rps", "p50_ms", "p99_ms", "shed_rate"} <= set(row)
+
+    def test_parse_phases(self):
+        assert parse_phases("2x40,4x80") == [(2.0, 40.0), (4.0, 80.0)]
+        with pytest.raises(ValueError):
+            parse_phases("")
+
+
+# ------------------------------------------------------------- CLI plane faces
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """A calibrated and an uncalibrated export of the tiny model."""
+    import jax
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.export import (
+        artifact_meta,
+        export_eval,
+        save_artifact,
+    )
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.serving.calibration import calibrate, gmm_fingerprint
+
+    tmp = tmp_path_factory.mktemp("plane_artifacts")
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    id_batches = [
+        (
+            rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3).astype(
+                np.float32
+            ),
+            rng.randint(0, cfg.model.num_classes, (4,)).astype(np.int32),
+        )
+    ]
+    calib = calibrate(trainer, state, id_batches)
+    exported = export_eval(trainer, state)
+    meta = artifact_meta(
+        cfg, None, True, gmm_fingerprint=gmm_fingerprint(state.gmm)
+    )
+    good = str(tmp / "good.mgproto")
+    save_artifact(good, exported, meta, calibration=calib)
+    bad = str(tmp / "uncalibrated.mgproto")
+    save_artifact(bad, exported, meta)
+    npy = str(tmp / "batch.npy")
+    np.save(npy, np.stack([
+        rng.rand(cfg.model.img_size, cfg.model.img_size, 3).astype(
+            np.float32
+        )
+        for _ in range(6)
+    ]))
+    return {"good": good, "bad": bad, "npy": npy}
+
+
+class TestServeCliPlane:
+    def _run(self, argv, capsys):
+        from mgproto_tpu.cli.serve import main as serve_main
+
+        serve_main(argv)
+        return [
+            json.loads(l)
+            for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")
+        ]
+
+    def test_replicas_with_midbatch_swap_drill(self, artifacts, capsys):
+        lines = self._run([
+            "--arch", "tiny", "--artifact", artifacts["good"],
+            "--images", artifacts["npy"], "--buckets", "1,2,4",
+            "--replicas", "2", "--swap", artifacts["good"],
+        ], capsys)
+        summary = lines[-1]
+        swaps = [l for l in lines if l.get("swap")]
+        responses = [
+            l for l in lines if "outcome" in l and not l.get("swap")
+        ]
+        assert len(responses) == 6
+        assert all(r["outcome"] in OUTCOMES for r in responses)
+        assert len(swaps) == 1 and swaps[0]["ok"] is True
+        assert summary["requests"] == 6
+        assert summary["steady_state_recompiles"] == 0
+        assert summary["replicas"] == 2
+        assert summary["readiness"]["ready"]
+        assert summary["swaps"][0]["reason"] == "committed"
+
+    def test_swap_to_uncalibrated_artifact_fails_closed(
+        self, artifacts, capsys
+    ):
+        lines = self._run([
+            "--arch", "tiny", "--artifact", artifacts["good"],
+            "--images", artifacts["npy"], "--buckets", "1,2",
+            "--swap", artifacts["bad"],
+        ], capsys)
+        summary = lines[-1]
+        swaps = [l for l in lines if l.get("swap")]
+        assert len(swaps) == 1
+        assert swaps[0]["ok"] is False
+        assert swaps[0]["reason"] == "uncalibrated"
+        # fail-closed: the old calibrated model answered everything
+        responses = [
+            l for l in lines if "outcome" in l and not l.get("swap")
+        ]
+        assert len(responses) == 6
+        assert not summary["degraded"]
+        assert summary["swaps"][0]["reason"] == "uncalibrated"
+
+
+@pytest.mark.slow
+class TestListenMode:
+    """Real-socket, real-SIGTERM end-to-end of the network face (slow: a
+    full subprocess jax import). The in-process frontend coverage lives in
+    tests/test_serving_plane.py."""
+
+    def test_listen_serves_http_and_drains_on_sigterm(self, artifacts):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "mgproto_tpu.cli.serve",
+             "--arch", "tiny", "--artifact", artifacts["good"],
+             "--buckets", "1,2", "--replicas", "1",
+             "--listen", "127.0.0.1:0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            line = proc.stdout.readline()
+            head = json.loads(line)
+            assert head["listening"] is True
+            port = head["port"]
+            img = np.random.RandomState(0).rand(32, 32, 3).tolist()
+            body = json.dumps({"id": "net0", "image": img}).encode()
+            with socket.create_connection(("127.0.0.1", port), 10) as s:
+                s.sendall(
+                    b"POST /v1/predict HTTP/1.1\r\nHost: t\r\n"
+                    b"Content-Length: %d\r\n\r\n" % len(body) + body
+                )
+                raw = b""
+                s.settimeout(30)
+                while b"\r\n\r\n" not in raw or not raw.split(
+                    b"\r\n\r\n", 1
+                )[1]:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    raw += chunk
+            status = int(raw.split()[1])
+            rec = json.loads(raw.partition(b"\r\n\r\n")[2])
+            assert status == 200
+            assert rec["outcome"] in ("predict", "abstain")
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=60)
+            assert proc.returncode == 0, err
+            summary = json.loads(out.strip().splitlines()[-1])
+            assert summary["summary"] is True and summary["drained"] is True
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()

@@ -1,0 +1,1043 @@
+"""Network serving plane tests (ISSUE 7): continuous micro-batching,
+replica supervision (heartbeat death/wedge detection, reroute, backoff
+restart), blue/green hot swap (fail-closed verification, zero-dropped
+transfer), the asyncio HTTP frontend, graceful drain, the readiness
+contract across breaker/warmup/drain transitions, the summarize serving
+section, and the no-blocking-sleep lint.
+
+Engines here are REAL ServingEngines over a trivial jit (constant logits /
+log p(x)) — the full admission/gate/bucket machinery at near-zero compile
+cost; the end-to-end model path is covered by tests/test_load_plane.py and
+the CLI tests.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mgproto_tpu.resilience import chaos as chaos_mod
+from mgproto_tpu.serving import metrics as sm
+from mgproto_tpu.serving.admission import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    AdmissionQueue,
+    CircuitBreaker,
+)
+from mgproto_tpu.serving.batcher import (
+    TRIGGER_BUCKET_FULL,
+    TRIGGER_DEADLINE,
+    TRIGGER_LINGER,
+    BatcherConfig,
+    MicroBatcher,
+)
+from mgproto_tpu.serving.calibration import Calibration
+from mgproto_tpu.serving.health import HealthProbe
+from mgproto_tpu.serving.replica import (
+    STATE_BACKOFF,
+    STATE_READY,
+    ReplicaSet,
+)
+from mgproto_tpu.serving.response import (
+    OUTCOME_ABSTAIN,
+    OUTCOME_PREDICT,
+    OUTCOME_REJECT,
+    OUTCOME_SHED,
+    REASON_NO_REPLICA,
+    REASON_SHUTDOWN,
+)
+from mgproto_tpu.serving.swap import (
+    REJECT_FINGERPRINT,
+    REJECT_STAGE_FAILED,
+    REJECT_UNCALIBRATED,
+    SWAP_COMMITTED,
+    flip_fleet,
+    hot_swap,
+    stage_fleet,
+    verify_standby,
+)
+from mgproto_tpu.telemetry.registry import (
+    MetricRegistry,
+    set_current_registry,
+)
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUTCOMES = {OUTCOME_PREDICT, OUTCOME_ABSTAIN, OUTCOME_REJECT, OUTCOME_SHED}
+
+IMG = 8
+NUM_CLASSES = 4
+FINGERPRINT = "fp-test"
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry_and_no_chaos():
+    prev_reg = set_current_registry(MetricRegistry())
+    prev_chaos = chaos_mod.set_active(None)
+    yield
+    chaos_mod.set_active(prev_chaos)
+    set_current_registry(prev_reg)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_calibration(fingerprint=FINGERPRINT):
+    rng = np.random.RandomState(0)
+    return Calibration.from_scores(
+        rng.randn(64) * 2.0 + 3.0,
+        rng.rand(64, NUM_CLASSES),
+        fingerprint=fingerprint,
+    )
+
+
+def make_engine(clock, buckets=(1, 2, 4), capacity=8, calibrated=True,
+                expected=FINGERPRINT, warm=True, **kw):
+    """A real ServingEngine over a constant jit: log p(x)=5.0 sits above
+    the calibration's 5th percentile, so clean payloads PREDICT."""
+    import jax.numpy as jnp
+
+    from mgproto_tpu.serving.engine import ServingEngine
+
+    def infer(images):
+        b = images.shape[0]
+        return {
+            "logits": jnp.tile(
+                jnp.arange(NUM_CLASSES, dtype=jnp.float32), (b, 1)
+            ),
+            "log_px": jnp.full((b,), 5.0, jnp.float32),
+        }
+
+    eng = ServingEngine(
+        infer,
+        img_size=IMG,
+        num_classes=NUM_CLASSES,
+        calibration=make_calibration() if calibrated else None,
+        expected_fingerprint=expected,
+        buckets=buckets,
+        queue_capacity=capacity,
+        clock=clock,
+        **kw,
+    )
+    if warm:
+        eng.warmup()
+    return eng
+
+
+def payload(seed=0):
+    return np.random.RandomState(seed).rand(IMG, IMG, 3).astype(np.float32)
+
+
+class FlipHandler:
+    """Preemption-handler stand-in whose flag raises after N checks."""
+
+    def __init__(self, after):
+        self.checks = 0
+        self.after = after
+
+    def requested(self):
+        self.checks += 1
+        return self.checks > self.after
+
+
+# -------------------------------------------------------------- micro-batcher
+class TestMicroBatcher:
+    def test_bucket_full_dispatches_immediately(self):
+        clock = FakeClock()
+        eng = make_engine(clock, buckets=(1, 2, 4))
+        b = MicroBatcher(eng, clock=clock)
+        for i in range(3):
+            eng.submit(payload(i), request_id=f"a{i}")
+            assert b.dispatch_due() is None or i == 3
+        eng.submit(payload(3), request_id="a3")
+        assert b.dispatch_due() == TRIGGER_BUCKET_FULL
+        out = b.poll()
+        assert len(out) == 4
+        assert all(r.outcome == OUTCOME_PREDICT for r in out)
+        # the largest bucket was exactly filled: fill fraction 1.0
+        assert sm.gauge(sm.BATCH_FILL).value() == 1.0
+
+    def test_deadline_slack_triggers_partial_batch(self):
+        clock = FakeClock()
+        eng = make_engine(clock, buckets=(1, 2, 4))
+        cfg = BatcherConfig(cost_prior_s=0.010, max_linger_s=10.0)
+        b = MicroBatcher(eng, config=cfg, clock=clock)
+        eng.submit(payload(), request_id="d0", deadline_s=0.100)
+        assert b.dispatch_due() is None  # slack 100ms > cost 10ms
+        clock.advance(0.085)
+        assert b.dispatch_due() is None  # slack 15ms > 10ms
+        clock.advance(0.006)
+        assert b.dispatch_due() == TRIGGER_DEADLINE  # slack 9ms <= 10ms
+        out = b.poll()
+        assert [r.outcome for r in out] == [OUTCOME_PREDICT]
+
+    def test_linger_bounds_deadline_less_requests(self):
+        clock = FakeClock()
+        eng = make_engine(clock, buckets=(1, 2, 4))
+        b = MicroBatcher(
+            eng, config=BatcherConfig(max_linger_s=0.02), clock=clock
+        )
+        eng.submit(payload(), request_id="l0")
+        assert b.dispatch_due() is None
+        clock.advance(0.021)
+        assert b.dispatch_due() == TRIGGER_LINGER
+        assert len(b.poll()) == 1
+
+    def test_cost_ema_updates_only_when_clock_moves(self):
+        clock = FakeClock()
+        eng = make_engine(clock, buckets=(1,))
+        cfg = BatcherConfig(cost_prior_s=0.005, cost_ema_alpha=0.5,
+                            max_linger_s=0.0)
+        b = MicroBatcher(
+            eng, config=cfg, clock=clock,
+            pre_dispatch=lambda: clock.advance(0.001),
+        )
+        eng.submit(payload(), request_id="e0")
+        b.poll()
+        assert b.dispatch_cost_s == pytest.approx(0.003)  # 0.5*5ms + 0.5*1ms
+        b2 = MicroBatcher(eng, config=cfg, clock=clock)  # no pre_dispatch
+        eng.submit(payload(), request_id="e1")
+        b2.poll()
+        assert b2.dispatch_cost_s == pytest.approx(0.005)  # prior kept
+
+    def test_flush_answers_everything(self):
+        clock = FakeClock()
+        eng = make_engine(clock, buckets=(1, 2, 4))
+        b = MicroBatcher(
+            eng, config=BatcherConfig(max_linger_s=99.0), clock=clock
+        )
+        for i in range(3):
+            eng.submit(payload(i), request_id=f"f{i}")
+        assert b.dispatch_due() is None
+        out = b.flush()
+        assert sorted(r.request_id for r in out) == ["f0", "f1", "f2"]
+        assert len(eng.queue) == 0
+
+    def test_dispatch_trigger_counter(self):
+        clock = FakeClock()
+        eng = make_engine(clock, buckets=(1, 2))
+        b = MicroBatcher(eng, clock=clock)
+        eng.submit(payload(0), request_id="t0")
+        eng.submit(payload(1), request_id="t1")
+        b.poll()
+        assert sm.counter(sm.DISPATCHES).value(
+            trigger=TRIGGER_BUCKET_FULL) == 1
+
+
+# -------------------------------------------------- queue transfer + breaker
+class TestAdmissionPlaneOps:
+    def test_peek_drain_all_restore_preserve_identity(self):
+        clock = FakeClock()
+        q = AdmissionQueue(capacity=4, clock=clock)
+        q.submit("p0", request_id="x0", deadline_s=1.0)
+        clock.advance(0.5)
+        q.submit("p1", request_id="x1", deadline_s=1.0)
+        assert q.peek_oldest().request_id == "x0"
+        moved = q.drain_all()
+        assert [r.request_id for r in moved] == ["x0", "x1"]
+        assert len(q) == 0 and q.peek_oldest() is None
+        q2 = AdmissionQueue(capacity=2, clock=clock)
+        assert q2.restore(moved[0]) and q2.restore(moved[1])
+        # identity intact: deadline and enqueue time are the ORIGINALS
+        assert q2.peek_oldest().enqueued_at == 0.0
+        assert q2.peek_oldest().deadline == 1.0
+        assert not q2.restore(moved[0])  # at capacity: caller sheds typed
+
+    def test_breaker_open_seconds_accounting(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, base_delay=4.0, clock=clock)
+        assert br.open_seconds() == 0.0
+        br.record_failure()  # opens at t=0
+        clock.advance(3.0)
+        assert br.open_seconds() == pytest.approx(3.0)
+        assert br.state == BREAKER_OPEN
+        clock.advance(2.0)  # cooldown (4s) elapsed at t=5
+        assert br.allow()  # -> half-open; open period was 5s
+        br.record_success()
+        assert br.state == BREAKER_CLOSED
+        clock.advance(10.0)
+        assert br.open_seconds() == pytest.approx(5.0)  # frozen while closed
+
+
+# ------------------------------------------------- readiness contract (sat 3)
+class TestReadinessContract:
+    def test_readiness_flaps_with_breaker_liveness_never(self):
+        clock = FakeClock()
+        eng = make_engine(clock, warm=False,
+                          breaker=CircuitBreaker(
+                              failure_threshold=2, base_delay=5.0,
+                              clock=clock))
+        probe = HealthProbe(eng)
+
+        def snap():
+            r = probe.readiness()
+            assert probe.liveness() == {"alive": True}  # liveness NEVER flaps
+            return r["ready"], r["breaker_state"]
+
+        # warmup: not ready until every bucket compiled
+        assert snap() == (False, BREAKER_CLOSED)
+        eng.warmup()
+        assert snap() == (True, BREAKER_CLOSED)
+        # closed -> open: readiness drops the moment the breaker opens
+        eng.breaker.record_failure()
+        assert snap() == (True, BREAKER_CLOSED)  # below threshold: still on
+        eng.breaker.record_failure()
+        assert snap() == (False, BREAKER_OPEN)
+        # open -> half-open: the probe IS traffic, readiness returns
+        clock.advance(6.0)
+        assert eng.breaker.allow()
+        assert snap() == (True, BREAKER_HALF_OPEN)
+        # half-open -> closed on the probe's success
+        eng.breaker.record_success()
+        assert snap() == (True, BREAKER_CLOSED)
+        # half-open -> open on a failed probe: readiness drops again
+        eng.breaker.record_failure()
+        eng.breaker.record_failure()
+        clock.advance(6.0)
+        eng.breaker.allow()
+        eng.breaker.record_failure()
+        assert snap() == (False, BREAKER_OPEN)
+
+    def test_readiness_false_while_draining(self):
+        clock = FakeClock()
+        eng = make_engine(clock)
+        probe = HealthProbe(eng)
+        assert probe.readiness()["ready"]
+        eng.submit(payload(), request_id="d0")
+        drained = eng.drain()
+        r = probe.readiness()
+        assert not r["ready"] and r["draining"]
+        assert probe.liveness() == {"alive": True}
+        assert [x.outcome for x in drained] == [OUTCOME_SHED]
+        assert drained[0].reason == REASON_SHUTDOWN
+
+
+# ----------------------------------------------------------- replica superv.
+def make_set(clock, replicas=2, factory=None, **kw):
+    factory = factory or (lambda: make_engine(clock, capacity=8))
+    kw.setdefault("heartbeat_timeout_s", 0.5)
+    kw.setdefault("restart_base_delay_s", 0.2)
+    kw.setdefault("batcher_config", BatcherConfig(max_linger_s=0.01))
+    return ReplicaSet(factory, replicas=replicas, clock=clock, **kw)
+
+
+class TestReplicaSet:
+    def test_round_robin_over_ready_replicas(self):
+        clock = FakeClock()
+        rs = make_set(clock)
+        rs.start()
+        for i in range(4):
+            rs.submit(payload(i), request_id=f"rr{i}")
+        depths = [len(rep.engine.queue) for rep in rs.replicas]
+        assert depths == [2, 2]
+
+    def test_chaos_kill_reroutes_detects_and_restarts(self):
+        clock = FakeClock()
+        rs = make_set(clock)
+        rs.start()
+        chaos_mod.install(chaos_mod.ChaosPlan(serve_replica_kill_at=2))
+        responses = []
+        for i in range(6):
+            responses.extend(
+                rs.submit(payload(i), request_id=f"k{i}", deadline_s=5.0)
+            )
+            responses.extend(rs.poll())
+            clock.advance(0.05)
+        dead = [rep for rep in rs.replicas if not rep.alive]
+        assert len(dead) == 1
+        # heartbeat goes stale -> supervisor drains + schedules restart
+        clock.advance(1.0)
+        responses.extend(rs.poll())
+        assert dead[0].state == STATE_BACKOFF
+        assert sm.counter(sm.REPLICA_RESTARTS).value(reason="dead") == 1
+        # survivors keep serving the whole time
+        clock.advance(0.05)
+        responses.extend(rs.submit(payload(9), request_id="k9"))
+        responses.extend(rs.poll())
+        # backoff elapses -> replica restarts and rejoins
+        clock.advance(1.0)
+        responses.extend(rs.poll())
+        assert dead[0].state == STATE_READY and dead[0].alive
+        # everything answered typed, nothing dropped
+        responses.extend(rs.flush())
+        got = sorted(r.request_id for r in responses)
+        assert got == sorted([f"k{i}" for i in range(6)] + ["k9"])
+        assert {r.outcome for r in responses} <= OUTCOMES
+
+    def test_wedged_replica_reroutes_queue_to_survivors(self):
+        clock = FakeClock()
+        rs = make_set(clock, batcher_config=BatcherConfig(max_linger_s=99.0))
+        rs.start()
+        # queue work on BOTH replicas without dispatching, then wedge one
+        for i in range(4):
+            rs.submit(payload(i), request_id=f"w{i}", deadline_s=60.0)
+        rs.replicas[0].wedged = True
+        stranded = len(rs.replicas[0].engine.queue)
+        assert stranded == 2
+        clock.advance(1.0)  # past heartbeat timeout
+        out = rs.poll()
+        assert rs.replicas[0].state == STATE_BACKOFF
+        assert sm.counter(sm.REPLICA_RESTARTS).value(reason="wedged") == 1
+        # the stranded requests moved to the survivor (filling its largest
+        # bucket, so the same supervisor pass dispatched all four)
+        out += rs.flush()
+        assert sorted(r.request_id for r in out) == [f"w{i}" for i in range(4)]
+        assert all(r.outcome == OUTCOME_PREDICT for r in out)
+
+    def test_default_breaker_shares_the_engine_clock(self):
+        """A virtual-clock engine must not get a wall-clock breaker:
+        cooldowns and open-seconds would mix clocks and break chaos
+        determinism (code-review regression)."""
+        clock = FakeClock()
+        eng = make_engine(clock, warm=False)
+        assert eng.breaker.clock is clock
+
+    def test_shed_stranded_answers_downed_queues_typed(self):
+        """A fast batch can finish before heartbeat detection reroutes a
+        killed replica's queue: the exit path must shed it typed, never
+        drop it (code-review regression)."""
+        clock = FakeClock()
+        rs = make_set(clock, batcher_config=BatcherConfig(max_linger_s=99.0))
+        rs.start()
+        for i in range(4):
+            rs.submit(payload(i), request_id=f"s{i}", deadline_s=60.0)
+        rs.replicas[0].alive = False  # killed with 2 requests queued
+        out = rs.flush() + rs.shed_stranded()
+        assert sorted(r.request_id for r in out) == [f"s{i}" for i in range(4)]
+        shed = [r for r in out if r.outcome == OUTCOME_SHED]
+        assert len(shed) == 2
+        assert all(r.reason == "replica_lost" for r in shed)
+
+    def test_all_replicas_down_sheds_no_replica(self):
+        clock = FakeClock()
+        rs = make_set(clock, replicas=1)
+        rs.start()
+        rs.replicas[0].alive = False
+        out = rs.submit(payload(), request_id="n0")
+        assert [r.outcome for r in out] == [OUTCOME_SHED]
+        assert out[0].reason == REASON_NO_REPLICA
+        assert sm.counter(sm.SHED).value(reason=REASON_NO_REPLICA) == 1
+
+    def test_breaker_open_fleet_recovers_after_cooldown(self):
+        """Readiness-gated routing starves a breaker-OPEN replica of the
+        allow() calls that lazily transition it to half-open — with an
+        empty queue nothing dispatches, so without the supervisor's tick
+        an open fleet would shed no_replica FOREVER after the fault
+        cleared (code-review regression)."""
+        clock = FakeClock()
+        rs = make_set(clock)
+        rs.start()
+        for rep in rs.replicas:
+            for _ in range(rep.engine.breaker.failure_threshold):
+                rep.engine.breaker.record_failure()
+            assert rep.engine.breaker.state == BREAKER_OPEN
+        out = rs.submit(payload(), request_id="starved")
+        assert [r.reason for r in out] == [REASON_NO_REPLICA]
+        rs.poll()  # before the cooldown: still open, still unroutable
+        assert not rs.ready_replicas()
+        clock.advance(0.6)  # past the breaker's first 0.5s cooldown
+        rs.poll()  # supervisor tick: open -> half-open, readiness returns
+        for rep in rs.replicas:
+            assert rep.engine.breaker.state == BREAKER_HALF_OPEN
+            assert rep.routable()
+        # the next routed dispatch is the probe; its success recloses
+        rs.submit(payload(1), request_id="probe", deadline_s=5.0)
+        out = rs.flush()
+        assert [r.request_id for r in out] == ["probe"]
+        assert out[0].outcome == OUTCOME_PREDICT
+        assert any(
+            rep.engine.breaker.state == BREAKER_CLOSED
+            for rep in rs.replicas
+        )
+
+    def test_failing_factory_stays_in_backoff_with_longer_delays(self):
+        clock = FakeClock()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] > 1:  # first build (start) works, rebuilds fail
+                raise RuntimeError("artifact gone")
+            return make_engine(clock)
+
+        rs = make_set(clock, replicas=1, factory=flaky,
+                      restart_base_delay_s=0.2)
+        rs.start()
+        rs.replicas[0].alive = False
+        clock.advance(1.0)
+        rs.poll()  # detect death, schedule restart at +0.2
+        first_at = rs.replicas[0].restart_at
+        clock.advance(0.3)
+        rs.poll()  # restart attempt fails -> backoff again, longer delay
+        assert rs.replicas[0].state == STATE_BACKOFF
+        assert rs.replicas[0].restart_at - clock() >= 0.4 - 1e-9
+        assert rs.replicas[0].restart_at > first_at
+
+    def test_drain_answers_ready_and_sheds_downed_queues(self):
+        clock = FakeClock()
+        rs = make_set(clock, batcher_config=BatcherConfig(max_linger_s=99.0))
+        rs.start()
+        for i in range(4):
+            rs.submit(payload(i), request_id=f"g{i}")
+        rs.replicas[0].wedged = True  # its queue cannot flush
+        out = rs.drain()
+        by = {r.request_id: r for r in out}
+        assert sorted(by) == [f"g{i}" for i in range(4)]
+        shed = [r for r in out if r.outcome == OUTCOME_SHED]
+        served = [r for r in out if r.outcome == OUTCOME_PREDICT]
+        assert len(shed) == 2 and len(served) == 2
+        assert all(r.reason == REASON_SHUTDOWN for r in shed)
+        assert not any(rep.routable() for rep in rs.replicas)
+
+
+# -------------------------------------------------------------- blue / green
+class TestHotSwap:
+    def test_verify_standby_reasons(self):
+        clock = FakeClock()
+        assert verify_standby(make_engine(clock, warm=False)) == "not_warmed"
+        assert verify_standby(
+            make_engine(clock, calibrated=False)) == REJECT_UNCALIBRATED
+        assert verify_standby(
+            make_engine(clock, calibrated=False), require_calibrated=False
+        ) is None
+        assert verify_standby(
+            make_engine(clock, expected="other")) == REJECT_FINGERPRINT
+        assert verify_standby(make_engine(clock)) is None
+
+    def test_uncalibrated_swap_rejected_old_keeps_serving(self):
+        clock = FakeClock()
+        rs = make_set(clock)
+        rs.start()
+        old = [rep.engine for rep in rs.replicas]
+        report = hot_swap(rs, lambda: make_engine(clock, calibrated=False))
+        assert not report.ok and report.reason == REJECT_UNCALIBRATED
+        assert [rep.engine for rep in rs.replicas] == old  # untouched
+        assert all(rep.routable() for rep in rs.replicas)
+        out = rs.submit(payload(), request_id="s0") + rs.flush()
+        assert [r.outcome for r in out] == [OUTCOME_PREDICT]
+        assert sm.counter(sm.SWAPS).value(
+            result="rejected", reason=REJECT_UNCALIBRATED) == 1
+
+    def test_factory_error_is_stage_failed(self):
+        clock = FakeClock()
+        rs = make_set(clock)
+        rs.start()
+
+        def boom():
+            raise OSError("no such artifact")
+
+        report = hot_swap(rs, boom)
+        assert not report.ok and report.reason == REJECT_STAGE_FAILED
+        assert "OSError" in report.detail
+
+    def test_uncalibrated_artifact_error_fails_closed(self):
+        clock = FakeClock()
+        rs = make_set(clock)
+        rs.start()
+
+        def refuse():
+            from mgproto_tpu.serving.engine import UncalibratedArtifactError
+
+            raise UncalibratedArtifactError("no calibration.json")
+
+        report = hot_swap(rs, refuse)
+        assert not report.ok and report.reason == REJECT_UNCALIBRATED
+
+    def test_chaos_poisoned_swap_rejected_then_clean_commit(self):
+        clock = FakeClock()
+        rs = make_set(clock)
+        rs.start()
+        chaos_mod.install(chaos_mod.ChaosPlan(serve_swap_bad_artifact=1))
+        factory = lambda: make_engine(clock)  # noqa: E731 (calibrated!)
+        bad = hot_swap(rs, factory)
+        assert not bad.ok and bad.reason == REJECT_UNCALIBRATED
+        good = hot_swap(rs, factory)
+        assert good.ok and good.reason == SWAP_COMMITTED
+        assert good.replicas_swapped == 2
+
+    def test_committed_swap_transfers_queued_zero_dropped(self):
+        clock = FakeClock()
+        rs = make_set(clock, batcher_config=BatcherConfig(max_linger_s=99.0))
+        rs.start()
+        for i in range(5):
+            rs.submit(payload(i), request_id=f"t{i}", deadline_s=60.0)
+        queued = sum(len(rep.engine.queue) for rep in rs.replicas)
+        assert queued == 5
+        old = [rep.engine for rep in rs.replicas]
+        report = hot_swap(rs, lambda: make_engine(clock))
+        assert report.ok and report.transferred == 5
+        assert all(
+            rep.engine is not o
+            for rep, o in zip(rs.replicas, old)
+        )
+        assert all(len(o.queue) == 0 for o in old)
+        # the green fleet answers every transferred request, none shed
+        out = rs.flush()
+        assert sorted(r.request_id for r in out) == [f"t{i}" for i in range(5)]
+        assert all(r.outcome == OUTCOME_PREDICT for r in out)
+        assert sm.counter(sm.SWAP_TRANSFERRED).value() == 5
+        # later restarts build the NEW factory
+        rs.replicas[0].alive = False
+        clock.advance(1.0)
+        rs.poll()
+        clock.advance(1.0)
+        rs.poll()
+        assert rs.replicas[0].state == STATE_READY
+
+
+class TestStagedSwapSplit:
+    def test_flip_covers_replica_lost_during_offpump_staging(self):
+        """The frontend stages one standby per replica SLOT off-pump and
+        flips on-pump: a replica that died while the green fleet warmed is
+        simply absent from the live list taken at flip time, and queued
+        work on the survivor still transfers (code-review regression: the
+        whole hot_swap used to run on the pump, freezing traffic for the
+        entire staging duration)."""
+        clock = FakeClock()
+        rs = make_set(
+            clock, batcher_config=BatcherConfig(max_linger_s=99.0)
+        )
+        rs.start()
+        green = lambda: make_engine(clock, capacity=8)  # noqa: E731
+        standbys, rejection = stage_fleet(len(rs.replicas), green)
+        assert rejection is None and len(standbys) == 2
+        # one replica dies while the standbys warmed
+        rs.replicas[1].engine = None
+        rs.replicas[1].batcher = None
+        rs.replicas[1].probe = None
+        rs.replicas[1].state = STATE_BACKOFF
+        rs.submit(payload(), request_id="q0", deadline_s=60.0)
+        report = flip_fleet(rs, green, standbys)
+        assert report.ok and report.reason == SWAP_COMMITTED
+        assert report.replicas_swapped == 1 and report.transferred == 1
+        out = rs.flush()
+        assert [r.request_id for r in out] == ["q0"]
+        assert out[0].outcome == OUTCOME_PREDICT
+        assert rs.engine_factory is green  # restarts build green
+
+    def test_stage_fleet_rejection_counts_and_stages_nothing(self):
+        clock = FakeClock()
+        standbys, rejection = stage_fleet(
+            2, lambda: make_engine(clock, calibrated=False)
+        )
+        assert standbys == [] and not rejection.ok
+        assert rejection.reason == REJECT_UNCALIBRATED
+        assert sm.counter(sm.SWAPS).value(
+            result="rejected", reason=REJECT_UNCALIBRATED
+        ) == 1
+
+
+# ------------------------------------------------------------- HTTP frontend
+async def _http(port, method, path, body=None):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    data = json.dumps(body).encode() if body is not None else b""
+    w.write(
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Length: {len(data)}\r\n\r\n".encode() + data
+    )
+    await w.drain()
+    raw = await r.read()
+    w.close()
+    head, _, payload_ = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), payload_
+
+
+class TestFrontend:
+    def _plane(self):
+        import time as _time
+
+        clock = _time.monotonic
+        rs = ReplicaSet(
+            lambda: make_engine(clock),
+            replicas=2,
+            clock=clock,
+            batcher_config=BatcherConfig(max_linger_s=0.005),
+        )
+        rs.start()
+        return rs
+
+    def test_http_predict_probes_metrics_and_drain(self):
+        from mgproto_tpu.serving.frontend import Frontend
+
+        rs = self._plane()
+        fe = Frontend(rs, poll_interval_s=0.002)
+        img = payload().tolist()
+
+        async def drill():
+            await fe.start()
+            s, b = await _http(fe.port, "GET", "/healthz")
+            assert s == 200 and json.loads(b)["alive"]
+            s, b = await _http(fe.port, "GET", "/readyz")
+            assert s == 200 and json.loads(b)["ready"]
+            results = await asyncio.gather(*[
+                _http(fe.port, "POST", "/v1/predict",
+                      {"id": f"h{i}", "image": img, "deadline_ms": 5000})
+                for i in range(5)
+            ])
+            for s, b in results:
+                rec = json.loads(b)
+                assert s == 200 and rec["outcome"] == OUTCOME_PREDICT
+            # malformed JSON body -> typed reject, not a hang or 500
+            s, b = await _http(fe.port, "POST", "/v1/predict", {"nope": 1})
+            assert s == 400 and json.loads(b)["outcome"] == OUTCOME_REJECT
+            # non-numeric deadline_ms -> typed 400, not a dead handler
+            # task and a reset connection (code-review regression)
+            s, b = await _http(fe.port, "POST", "/v1/predict",
+                               {"id": "dl", "image": img,
+                                "deadline_ms": {}})
+            assert s == 400 and json.loads(b)["reason"] == "malformed"
+            # bad payload -> the engine's typed validation reject
+            s, b = await _http(fe.port, "POST", "/v1/predict",
+                               {"id": "bad", "image": [[0.0, 1.0]]})
+            assert s == 400 and json.loads(b)["reason"] == "bad_shape"
+            s, b = await _http(fe.port, "GET", "/metrics")
+            assert s == 200 and b"serving_requests_total" in b
+            s, b = await _http(fe.port, "GET", "/nowhere")
+            assert s == 404
+            # unconfigured swap endpoint answers typed
+            s, b = await _http(fe.port, "POST", "/admin/swap",
+                               {"artifact": "x.mgproto"})
+            assert s == 501
+            fe.request_stop()
+            await fe.run_until_drained()
+
+        asyncio.run(drill())
+        assert fe.outcomes.get(OUTCOME_PREDICT, 0) == 5
+
+    def test_stalled_body_times_out_408(self):
+        """A client that announces a Content-Length and never sends the
+        body must get a 408 and its socket closed — not hold the handler
+        task and file descriptor open forever (code-review regression:
+        only the head reads were timeout-wrapped)."""
+        from mgproto_tpu.serving.frontend import Frontend
+
+        rs = self._plane()
+        fe = Frontend(rs, poll_interval_s=0.002, io_timeout_s=0.05)
+
+        async def drill():
+            await fe.start()
+            r, w = await asyncio.open_connection("127.0.0.1", fe.port)
+            w.write(
+                b"POST /v1/predict HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Length: 100\r\n\r\n"  # body never arrives
+            )
+            await w.drain()
+            raw = await asyncio.wait_for(r.read(), timeout=5.0)
+            w.close()
+            assert int(raw.split()[1]) == 408
+            # the frontend still serves afterwards
+            s, b = await _http(
+                fe.port, "POST", "/v1/predict",
+                {"id": "ok", "image": payload().tolist(),
+                 "deadline_ms": 5000},
+            )
+            assert s == 200 and json.loads(b)["outcome"] == OUTCOME_PREDICT
+            fe.request_stop()
+            await fe.run_until_drained()
+
+        asyncio.run(drill())
+
+    def test_oversized_head_answers_400(self):
+        """Drip-fed or bloated headers are capped cumulatively: many small
+        headers past max_head_bytes get a 400, not unbounded buffering
+        (code-review regression). A small injected cap keeps the drill
+        inside one socket buffer — large transfers through this sandbox's
+        TCP stack trickle once flow control kicks in."""
+        from mgproto_tpu.serving.frontend import Frontend
+
+        rs = self._plane()
+        fe = Frontend(rs, poll_interval_s=0.002, max_head_bytes=2048)
+
+        async def drill():
+            await fe.start()
+            r, w = await asyncio.open_connection("127.0.0.1", fe.port)
+            w.write(b"GET /healthz HTTP/1.1\r\nHost: t\r\n")
+            for i in range(120):  # ~4KB of small headers, no blank line
+                w.write(b"X-Pad-%d: aaaaaaaaaaaaaaaaaaaaaaaa\r\n" % i)
+            await w.drain()
+            raw = await asyncio.wait_for(r.read(), timeout=5.0)
+            w.close()
+            assert int(raw.split()[1]) == 400
+            fe.request_stop()
+            await fe.run_until_drained()
+
+        asyncio.run(drill())
+
+    def test_preemption_flag_drains_inflight_typed(self):
+        from mgproto_tpu.serving.frontend import Frontend
+
+        rs = self._plane()
+        # linger far beyond the test horizon: requests sit queued until the
+        # drain, which must still answer them (flush through the device)
+        for rep in rs.replicas:
+            rep.batcher.config = BatcherConfig(max_linger_s=60.0)
+        handler = FlipHandler(after=10**9)
+        fe = Frontend(rs, poll_interval_s=0.002,
+                      preemption_handler=handler)
+        img = payload().tolist()
+
+        async def drill():
+            await fe.start()
+            task = asyncio.create_task(
+                _http(fe.port, "POST", "/v1/predict",
+                      {"id": "z0", "image": img})
+            )
+            await asyncio.sleep(0.05)  # request is queued, not dispatched
+            handler.after = 0  # SIGTERM arrives (flag raised)
+            fe._kick.set()
+            s, b = await task
+            rec = json.loads(b)
+            assert rec["request_id"] == "z0"
+            assert rec["outcome"] in (OUTCOME_PREDICT, OUTCOME_SHED)
+            await fe.run_until_drained()
+
+        asyncio.run(drill())
+
+    def test_swap_endpoint_honors_allow_uncalibrated(self):
+        """An operator who opted into degraded serving can promote an
+        uncalibrated artifact over the network — same policy as the batch
+        drill (code-review regression)."""
+        import time as _time
+
+        from mgproto_tpu.serving.frontend import Frontend
+
+        clock = _time.monotonic
+        rs = ReplicaSet(
+            lambda: make_engine(clock, calibrated=False), replicas=1,
+            clock=clock,
+            batcher_config=BatcherConfig(max_linger_s=0.005),
+        )
+        rs.start()
+        fe = Frontend(
+            rs, poll_interval_s=0.002,
+            swap_factory_builder=lambda p: (
+                lambda: make_engine(clock, calibrated=False)
+            ),
+            require_calibrated_swap=False,
+        )
+
+        async def drill():
+            await fe.start()
+            s, b = await _http(fe.port, "POST", "/admin/swap",
+                               {"artifact": "degraded.mgproto"})
+            assert s == 200 and json.loads(b)["reason"] == SWAP_COMMITTED
+            fe.request_stop()
+            await fe.run_until_drained()
+
+        asyncio.run(drill())
+
+    def test_swap_endpoint_commits_and_rejects(self):
+        import time as _time
+
+        from mgproto_tpu.serving.frontend import Frontend
+
+        clock = _time.monotonic
+        rs = ReplicaSet(
+            lambda: make_engine(clock), replicas=1, clock=clock,
+            batcher_config=BatcherConfig(max_linger_s=0.005),
+        )
+        rs.start()
+
+        def builder(path):
+            if path == "good.mgproto":
+                return lambda: make_engine(clock)
+            return lambda: make_engine(clock, calibrated=False)
+
+        fe = Frontend(rs, poll_interval_s=0.002,
+                      swap_factory_builder=builder)
+
+        async def drill():
+            await fe.start()
+            s, b = await _http(fe.port, "POST", "/admin/swap",
+                               {"artifact": "bad.mgproto"})
+            assert s == 409
+            assert json.loads(b)["reason"] == REJECT_UNCALIBRATED
+            s, b = await _http(fe.port, "POST", "/admin/swap",
+                               {"artifact": "good.mgproto"})
+            assert s == 200 and json.loads(b)["reason"] == SWAP_COMMITTED
+            # the fleet still serves after the flip
+            s, b = await _http(fe.port, "POST", "/v1/predict",
+                               {"id": "after", "image": payload().tolist()})
+            assert s == 200 and json.loads(b)["outcome"] == OUTCOME_PREDICT
+            fe.request_stop()
+            await fe.run_until_drained()
+
+        asyncio.run(drill())
+
+
+# ------------------------------------------------------- graceful drain (CLI)
+class TestGracefulDrain:
+    def test_batch_driver_sheds_everything_typed_on_flag(self):
+        from mgproto_tpu.cli.serve import drive_batch_engine
+
+        clock = FakeClock()
+        eng = make_engine(clock)
+        ids = [f"b{i}" for i in range(8)]
+        payloads = [payload(i) for i in range(8)]
+        # flag rises after 3 submit-loop checks: the rest must still be
+        # answered (typed shed), never dropped
+        out = drive_batch_engine(eng, payloads, ids, FlipHandler(after=3))
+        assert [r.request_id for r in out] == ids
+        assert {r.outcome for r in out} <= OUTCOMES
+        shed = [r for r in out if r.outcome == OUTCOME_SHED]
+        assert shed and all(r.reason == REASON_SHUTDOWN for r in shed)
+
+    def test_batch_driver_without_flag_answers_all(self):
+        from mgproto_tpu.cli.serve import drive_batch_engine
+
+        clock = FakeClock()
+        eng = make_engine(clock)
+        ids = [f"c{i}" for i in range(5)]
+        out = drive_batch_engine(
+            eng, [payload(i) for i in range(5)], ids, FlipHandler(10**9)
+        )
+        assert [r.request_id for r in out] == ids
+        assert all(r.outcome == OUTCOME_PREDICT for r in out)
+
+    def test_plane_driver_drains_typed_on_flag(self):
+        from mgproto_tpu.cli.serve import drive_batch_plane
+
+        clock = FakeClock()
+        rs = make_set(clock, batcher_config=BatcherConfig(max_linger_s=99.0))
+        rs.start()
+        ids = [f"p{i}" for i in range(6)]
+        out, reports = drive_batch_plane(
+            rs, [payload(i) for i in range(6)], ids, FlipHandler(after=2)
+        )
+        assert sorted(r.request_id for r in out) == ids
+        assert {r.outcome for r in out} <= OUTCOMES
+        assert any(
+            r.outcome == OUTCOME_SHED and r.reason == REASON_SHUTDOWN
+            for r in out
+        )
+        assert reports == []
+
+
+# ---------------------------------------------------------- summarize section
+class TestSummarizeServingPlane:
+    def test_serving_section_carries_plane_story(self, tmp_path):
+        from mgproto_tpu.cli.telemetry import summarize
+
+        reg = MetricRegistry()
+        prev = set_current_registry(reg)
+        try:
+            sm.register_serving_metrics(reg)
+            sm.counter(sm.SHED).inc(3, reason="queue_full")
+            sm.counter(sm.SHED).inc(2, reason="deadline")
+            sm.gauge(sm.BREAKER_OPEN_FRACTION).set(0.125)
+            for fill in (0.5, 1.0, 1.0, 0.25):
+                sm.histogram(sm.BATCH_FILL_HIST).observe(fill)
+            sm.counter(sm.DISPATCHES).inc(4, trigger="bucket_full")
+            sm.counter(sm.REPLICA_RESTARTS).inc(reason="dead")
+            sm.counter(sm.SWAPS).inc(result="committed")
+            sm.counter(sm.SWAP_TRANSFERRED).inc(5)
+            # per-replica + unlabeled-total queue depth: summarize must
+            # report the TOTAL, not whichever replica flushed last
+            sm.gauge(sm.QUEUE_DEPTH).set(1.0, replica="r0")
+            sm.gauge(sm.QUEUE_DEPTH).set(2.0, replica="r1")
+            sm.gauge(sm.QUEUE_DEPTH).set(3.0)
+            with open(tmp_path / "metrics.jsonl", "w") as f:
+                f.write(json.dumps({"metrics": reg.snapshot()}) + "\n")
+        finally:
+            set_current_registry(prev)
+        s = summarize(str(tmp_path))
+        srv = s["serving"]
+        assert srv["shed_by_reason"] == {"queue_full": 3.0, "deadline": 2.0}
+        assert srv["breaker_open_time_fraction"] == 0.125
+        assert srv["batch_fill"]["dispatches"] == 4
+        assert srv["batch_fill"]["mean"] == pytest.approx(0.6875)
+        assert srv["dispatches_by_trigger"] == {"bucket_full": 4.0}
+        assert srv["replica_restarts"] == {"dead": 1.0}
+        assert srv["swaps_by_result"] == {"committed": 1.0}
+        assert srv["swap_transferred"] == 5.0
+        assert srv["queue_depth"] == 3.0  # the unlabeled fleet total
+        # and the table renderer swallows the nested dicts
+        from mgproto_tpu.cli.telemetry import render_table
+
+        assert "batch_fill" in render_table(s)
+
+
+# -------------------------------------------------------------------- lint
+class TestNoBlockingSleepLint:
+    SCRIPT = os.path.join(REPO, "scripts", "check_no_blocking_sleep.py")
+
+    def _run(self, root):
+        return subprocess.run(
+            [sys.executable, self.SCRIPT, str(root)],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_repo_serving_is_clean(self):
+        proc = self._run(REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_detects_time_sleep_variants(self, tmp_path):
+        pkg = tmp_path / "mgproto_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import time as t\n"
+            "from time import sleep as zzz\n"
+            "def f():\n    t.sleep(1)\n"
+            "def g():\n    zzz(2)\n"
+        )
+        proc = self._run(tmp_path)
+        out = proc.stdout.replace(os.sep, "/")
+        assert proc.returncode == 1
+        assert "serving/bad.py:4" in out and "serving/bad.py:6" in out
+
+    def test_detects_uninjected_retry_call(self, tmp_path):
+        pkg = tmp_path / "mgproto_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "from mgproto_tpu.resilience.retry import retry_call\n"
+            "def f():\n    return retry_call(print, retries=2)\n"
+        )
+        proc = self._run(tmp_path)
+        assert proc.returncode == 1
+        assert "bad.py:3" in proc.stdout
+
+    def test_injected_retry_and_asyncio_sleep_pass(self, tmp_path):
+        pkg = tmp_path / "mgproto_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (pkg / "ok.py").write_text(
+            "import asyncio\n"
+            "from mgproto_tpu.resilience.retry import retry_call\n"
+            "def f(clock):\n"
+            "    return retry_call(print, retries=2, sleep=lambda s: None)\n"
+            "async def g():\n    await asyncio.sleep(0)\n"
+        )
+        proc = self._run(tmp_path)
+        assert proc.returncode == 0, proc.stdout
+
+
+# ----------------------------------------------- chaos plan plumbing (env)
+def test_plane_chaos_env_knobs():
+    plan = chaos_mod.plan_from_env({
+        "MGPROTO_CHAOS_SERVE_REPLICA_KILL_AT": "12",
+        "MGPROTO_CHAOS_SERVE_WEDGE_AT": "30",
+        "MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT": "2",
+    })
+    assert plan is not None and plan.any_active()
+    st = chaos_mod.ChaosState(plan)
+    assert not st.serve_replica_kill_due(11)
+    assert st.serve_replica_kill_due(12)
+    assert not st.serve_replica_kill_due(13)  # one-shot
+    assert st.serve_replica_wedge_due(31)
+    assert not st.serve_replica_wedge_due(32)
+    assert st.serve_swap_bad_artifact_due()
+    assert st.serve_swap_bad_artifact_due()
+    assert not st.serve_swap_bad_artifact_due()  # N=2 consumed
