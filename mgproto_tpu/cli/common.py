@@ -197,6 +197,21 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                         "checkpoints + exits 0, second kills)")
     p.add_argument("--profile_dir", default="",
                    help="write a jax.profiler trace of one epoch here")
+    # performance observatory (obs/profiler.py): step-scoped capture
+    # windows, far cheaper than the epoch-wide --profile_dir trace
+    p.add_argument("--profile_steps", default="",
+                   help="arm a profiler capture window for this step range "
+                        "('120:130', or a bare step for one step); off-TPU "
+                        "the window degrades to a cost-analysis-only "
+                        "capture (obs/profiler.py)")
+    p.add_argument("--profile_on_anomaly", action="store_true",
+                   help="arm profiler capture automatically on anomalies: "
+                        "step-time spike vs EMA, mid-run jit recompile, or "
+                        "loader-wait fraction over threshold; traces land "
+                        "under --profile_out")
+    p.add_argument("--profile_out", default="",
+                   help="capture-window output dir (default: "
+                        "evidence/trace_<model_dir basename>)")
     # telemetry (metric registry + tracing spans + step/health monitors);
     # both dash and underscore spellings resolve to the same dest
     p.add_argument("--telemetry-dir", "--telemetry_dir", dest="telemetry_dir",

@@ -309,6 +309,17 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--heartbeat_timeout_s", type=float, default=2.0,
                    help="replica heartbeat staleness before the supervisor "
                         "drains + restarts it")
+    # performance observatory (ISSUE 8)
+    p.add_argument("--trace_requests", action="store_true",
+                   help="end-to-end request tracing: frontend->batcher->"
+                        "replica->engine stage spans in the telemetry "
+                        "Chrome trace, serving_stage_seconds histograms in "
+                        "/metrics, and a per-response 'timings' breakdown "
+                        "(obs/reqtrace.py; zero per-request cost when off)")
+    p.add_argument("--profile_warmup", default="",
+                   help="capture a profiler trace of warmup compilation "
+                        "into this dir (off-TPU: cost-analysis-only "
+                        "capture — obs/profiler.py)")
     # NB: add_train_args already contributes --auto_tune; here it sizes the
     # warmup bucket set instead of the train plan (perf/planner.py
     # plan_serve_buckets): over-budget buckets are dropped before warmup
@@ -337,6 +348,20 @@ def main(argv: Optional[list] = None) -> None:
         register_serving_metrics(telem.registry)
         monitor = StepMonitor(registry=telem.registry, phase="serve")
 
+    # performance observatory: per-run flight recorder (dumps on replica
+    # death when a telemetry dir gives it somewhere to write) + opt-in
+    # end-to-end request tracing on the plane's production clock
+    from mgproto_tpu.obs import reqtrace
+    from mgproto_tpu.obs.flightrec import FlightRecorder, set_recorder
+
+    prev_recorder = set_recorder(
+        FlightRecorder(dump_dir=args.telemetry_dir or None)
+    )
+    if args.trace_requests:
+        reqtrace.enable(
+            tracer=telem.tracer if telem else None, include_timings=True
+        )
+
     try:
         if args.listen:
             _main_listen(args, handler, telem)
@@ -347,6 +372,9 @@ def main(argv: Optional[list] = None) -> None:
         if telem:
             telem.flush()
     finally:
+        if args.trace_requests:
+            reqtrace.disable()
+        set_recorder(prev_recorder)
         uninstall()  # leave the embedding process's signal dispositions alone
         if telem:
             telem.close()
@@ -419,6 +447,44 @@ def _summary_line(responses, compiled, steady, gate, readiness, extra=None):
     print(json.dumps(line))
 
 
+def _warmup_profile(args):
+    """Context manager for --profile_warmup: a real device trace on
+    TPU/GPU, a cost-analysis-only capture elsewhere (the cost analysis is
+    written by `_write_warmup_costs` AFTER warmup, once the engine's
+    compiled programs exist); nullcontext when unset."""
+    import contextlib
+
+    from mgproto_tpu.obs.profiler import profile_block
+
+    if not args.profile_warmup:
+        return contextlib.nullcontext()
+    return profile_block(args.profile_warmup, reason="serve_warmup")
+
+
+def _write_warmup_costs(capture_dir, engine) -> None:
+    """The off-TPU --profile_warmup degrade: per-bucket XLA cost analysis
+    of the warmed inference program into the capture dir (on TPU/GPU the
+    real device trace already carries the op timeline)."""
+    import os
+
+    from mgproto_tpu.obs.profiler import COST_FILE, trace_supported
+
+    if not capture_dir or engine is None or trace_supported():
+        return
+    try:
+        costs = engine.warmup_costs()
+    except Exception as e:  # profiling must never take the server down
+        costs = {"error": f"{type(e).__name__}: {e}"}
+    with open(os.path.join(capture_dir, COST_FILE), "w") as f:
+        json.dump(costs, f, indent=2, sort_keys=True)
+
+
+def _first_engine(rs):
+    return next(
+        (r.engine for r in rs.replicas if r.engine is not None), None
+    )
+
+
 def _main_batch_engine(args, handler, telem, monitor) -> None:
     """The original single-engine batch face (plus graceful drain)."""
     from mgproto_tpu.serving.health import HealthProbe
@@ -426,7 +492,9 @@ def _main_batch_engine(args, handler, telem, monitor) -> None:
     engine = build_engine(args, monitor=monitor)
     if args.auto_tune:
         _apply_auto_tune(args, engine, telem)
-    compiled = engine.warmup()
+    with _warmup_profile(args) as capture_dir:
+        compiled = engine.warmup()
+        _write_warmup_costs(capture_dir, engine)
     payloads, ids = _load_payloads(args)
     responses = drive_batch_engine(engine, payloads, ids, handler)
     for r in responses:
@@ -465,7 +533,9 @@ def _build_plane(args, telem):
 def _main_batch_plane(args, handler, telem) -> None:
     """Batch face through the replica plane (--replicas > 1 or --swap)."""
     rs = _build_plane(args, telem)
-    compiled = rs.start()
+    with _warmup_profile(args) as capture_dir:
+        compiled = rs.start()
+        _write_warmup_costs(capture_dir, _first_engine(rs))
     payloads, ids = _load_payloads(args)
     swap_at = len(payloads) // 2 if args.swap else None
     responses, reports = drive_batch_plane(
@@ -502,7 +572,9 @@ def _main_listen(args, handler, telem) -> None:
     if not host or not port:
         raise SystemExit(f"--listen must be HOST:PORT, got {args.listen!r}")
     rs = _build_plane(args, telem)
-    compiled = rs.start()
+    with _warmup_profile(args) as capture_dir:
+        compiled = rs.start()
+        _write_warmup_costs(capture_dir, _first_engine(rs))
     frontend = Frontend(
         rs,
         host=host,

@@ -174,6 +174,27 @@ def _unlabeled_value(snapshot: Dict, name: str, default=None):
     return sum(labeled) if labeled else default
 
 
+def _stage_latency_section(
+    snapshot: Dict, name: str
+) -> Dict[str, Dict[str, float]]:
+    """p50/p99/mean per `stage` label of the request-stage histogram."""
+    m = snapshot.get(name)
+    out: Dict[str, Dict[str, float]] = {}
+    if not m or m.get("type") != "histogram":
+        return out
+    for s in m.get("series", []):
+        stage = s.get("labels", {}).get("stage")
+        if stage is None or not s.get("count"):
+            continue
+        out[stage] = {
+            "count": s["count"],
+            "mean": s["sum"] / s["count"],
+            "p50": percentile_from_buckets(s, 50.0),
+            "p99": percentile_from_buckets(s, 99.0),
+        }
+    return out
+
+
 def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
     """Serving story: outcomes, PER-REASON shed counts (queue_full vs
     deadline vs shutdown...), latency percentiles, trust + breaker state
@@ -211,6 +232,11 @@ def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
                 hist, p
             )
         section["request_max_seconds"] = hist["max"]
+    # per-stage request latency (obs/reqtrace.py: queue / device / total),
+    # present only when request tracing ran
+    stages = _stage_latency_section(last, sm.STAGE_SECONDS)
+    if stages:
+        section["stage_seconds"] = stages
     fill = _hist_series(last, sm.BATCH_FILL_HIST)
     if fill and fill["count"]:
         section["batch_fill"] = {
@@ -456,7 +482,16 @@ def render_table(summary: Dict[str, Any]) -> str:
         section("serving")
         for k, v in summary["serving"].items():
             if isinstance(v, dict):
-                v = " ".join(f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())) or "-"
+                parts = []
+                for kk, vv in sorted(v.items()):
+                    if isinstance(vv, dict):  # e.g. stage_seconds per stage
+                        inner = ",".join(
+                            f"{ik}={_fmt(iv)}" for ik, iv in sorted(vv.items())
+                        )
+                        parts.append(f"{kk}({inner})")
+                    else:
+                        parts.append(f"{kk}={_fmt(vv)}")
+                v = " ".join(parts) or "-"
             rows.append((k, v))
     if "health" in summary:
         h = summary["health"]
@@ -485,9 +520,168 @@ def render_table(summary: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
-def main(argv: Optional[list] = None) -> None:
+# ---------------------------------------------------------- regression gate
+# `mgproto-telemetry check <dir> --baseline FILE`: compare a run's
+# summarized metrics against a committed baseline with tolerance bands and
+# exit nonzero on regression — the observability loop's enforcement arm
+# (BENCH/evidence numbers become CI gates instead of trivia). The baseline
+# is generated from a known-good run (`--write-baseline`) and committed;
+# its entries carry their own direction + tolerance so an operator can
+# widen a band with a one-line edit, reviewed like any other change.
+
+# default gate set for --write-baseline: (dotted summary key, direction,
+# relative tolerance). direction 'higher' = regression when the new value
+# drops below baseline*(1-tol); 'lower' = regression when it rises above
+# baseline*(1+tol). Entries whose key is absent from the summary are
+# skipped at write time; at CHECK time a missing key fails (a metric that
+# vanished is itself a regression of the telemetry contract).
+DEFAULT_GATES = (
+    ("steps.images_per_sec", "higher", 0.20),
+    ("steps.step_time_ema_seconds", "lower", 0.25),
+    ("steps.step_time_p99_seconds", "lower", 0.30),
+    ("recompiles.jit_recompiles_total", "lower", 0.0),
+    ("serving.request_p99_seconds", "lower", 0.30),
+    ("serving.breaker_open_time_fraction", "lower", 0.0),
+)
+
+
+def _lookup(summary: Dict[str, Any], dotted: str):
+    node: Any = summary
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def build_baseline(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """A baseline record from a known-good run's summary: every default
+    gate whose key holds a number, frozen with its direction + band."""
+    entries = []
+    for key, direction, rel_tol in DEFAULT_GATES:
+        value = _lookup(summary, key)
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        entries.append({
+            "key": key,
+            "value": float(value),
+            "direction": direction,
+            "rel_tol": rel_tol,
+            "abs_tol": 0.0,
+        })
+    return {
+        "telemetry_check_baseline": True,
+        "telemetry_dir": summary.get("telemetry_dir"),
+        "entries": entries,
+    }
+
+
+def check_entry(entry: Dict[str, Any], summary: Dict[str, Any]) -> Dict:
+    """One gate: {key, baseline, value, allowed, ok, why}."""
+    key = entry["key"]
+    base = float(entry["value"])
+    direction = entry.get("direction", "lower")
+    rel = float(entry.get("rel_tol", 0.0))
+    abs_tol = float(entry.get("abs_tol", 0.0))
+    value = _lookup(summary, key)
+    row = {"key": key, "baseline": base, "value": value,
+           "direction": direction}
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        row.update(ok=False, why="metric missing from run summary")
+        return row
+    value = float(value)
+    if direction == "higher":
+        allowed = base * (1.0 - rel) - abs_tol
+        ok = value >= allowed
+        why = "" if ok else f"{value:.6g} < allowed {allowed:.6g}"
+    elif direction == "lower":
+        allowed = base * (1.0 + rel) + abs_tol
+        ok = value <= allowed
+        why = "" if ok else f"{value:.6g} > allowed {allowed:.6g}"
+    elif direction == "equal":
+        allowed = abs(base) * rel + abs_tol
+        ok = abs(value - base) <= allowed
+        why = "" if ok else f"|{value:.6g} - {base:.6g}| > {allowed:.6g}"
+    else:
+        row.update(ok=False, why=f"unknown direction {direction!r}")
+        return row
+    row.update(allowed=allowed, ok=ok, why=why)
+    return row
+
+
+def check(summary: Dict[str, Any], baseline: Dict[str, Any]) -> Dict:
+    """Every baseline entry checked; {'ok': bool, 'rows': [...]}."""
+    entries = baseline.get("entries", [])
+    rows = [check_entry(e, summary) for e in entries]
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
+def check_main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(
-        description="Summarize an mgproto-tpu telemetry directory"
+        prog="mgproto-telemetry check",
+        description="Gate a telemetry dir against a committed baseline "
+                    "(exit 0 = within tolerance, 1 = regression)",
+    )
+    p.add_argument("dir", help="telemetry dir (or a run dir containing "
+                               "telemetry/)")
+    p.add_argument("--baseline", required=True,
+                   help="baseline JSON (generate with --write-baseline "
+                        "from a known-good run, then commit it)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="summarize the dir and WRITE --baseline from it "
+                        "(no checking)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the check result as one JSON object")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        raise SystemExit(f"not a directory: {args.dir}")
+    summary = summarize(args.dir)
+    if args.write_baseline:
+        baseline = build_baseline(summary)
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+        print(f"wrote {len(baseline['entries'])} gate entries to "
+              f"{args.baseline}")
+        return 0
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"cannot read baseline {args.baseline}: {e}")
+    if not baseline.get("telemetry_check_baseline"):
+        raise SystemExit(
+            f"{args.baseline} is not a telemetry check baseline "
+            "(generate one with --write-baseline)"
+        )
+    result = check(summary, baseline)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        width = max((len(r["key"]) for r in result["rows"]), default=3)
+        for r in result["rows"]:
+            status = "ok  " if r["ok"] else "FAIL"
+            detail = f" ({r['why']})" if r["why"] else ""
+            print(f"{status} {r['key']:<{width}}  "
+                  f"base={_fmt(r['baseline'])} new={_fmt(r['value'])}"
+                  f"{detail}")
+        print(f"{result['checked']} checked, {result['failed']} failed")
+    return 0 if result["ok"] else 1
+
+
+def main(argv: Optional[list] = None) -> Optional[int]:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # subcommand dispatch with bare-directory back-compat:
+    # `mgproto-telemetry <dir>` keeps meaning summarize
+    if argv and argv[0] == "check":
+        return check_main(argv[1:])
+    if argv and argv[0] == "summarize":
+        argv = argv[1:]
+    p = argparse.ArgumentParser(
+        description="Summarize an mgproto-tpu telemetry directory "
+                    "(subcommands: summarize [default], check)"
     )
     p.add_argument("dir", help="telemetry dir (or a run dir containing "
                                "telemetry/)")
@@ -501,7 +695,8 @@ def main(argv: Optional[list] = None) -> None:
         print(json.dumps(summary, indent=2))
     else:
         print(render_table(summary))
+    return None
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -102,6 +102,9 @@ def run_training(
     keep_best: int = 1,
     chaos=None,
     auto_tune: bool = False,
+    profile_steps: str = "",
+    profile_on_anomaly: bool = False,
+    profile_out: str = "",
 ):
     """Run the full schedule; returns (final_state, last_test_accuracy).
 
@@ -293,6 +296,34 @@ def run_training(
             # "autotune", rejections -> autotune_plan_rejected_total
             telem.observe_autotune(autotune_outcome)
 
+    # performance observatory (ISSUE 8): a fresh flight recorder for this
+    # run, dumping next to the telemetry artifacts on divergence rollback,
+    # preemption, or crash; plus the optional profiler capture window
+    from mgproto_tpu.obs.flightrec import FlightRecorder, set_recorder
+    from mgproto_tpu.obs.profiler import ProfilerWindow, parse_step_range
+
+    recorder = FlightRecorder(
+        dump_dir=telemetry_dir or os.path.join(cfg.model_dir, "telemetry")
+    )
+    prev_recorder = set_recorder(recorder)
+    window = None
+    if profile_steps or profile_on_anomaly:
+        from mgproto_tpu.obs.stall import step_costs
+
+        window = ProfilerWindow(
+            out_dir=profile_out or os.path.join(
+                "evidence", f"trace_{os.path.basename(cfg.model_dir) or 'run'}"
+            ),
+            steps=parse_step_range(profile_steps),
+            on_anomaly=profile_on_anomaly,
+            monitor=telem.monitor if telem else None,
+            # the off-TPU degrade lowers THE production step program of
+            # this run's config (obs/stall.py) — same helper the
+            # auto-tuner measures with
+            cost_provider=lambda: step_costs(cfg),
+            log=log,
+        )
+
     # recovery wiring: preemption flag (signal handlers, if any, are
     # installed by main(); chaos raises the same flag), active chaos state,
     # multi-host stop agreement
@@ -327,10 +358,15 @@ def run_training(
                     train_loader, test_loader, push_loader, push_ds,
                     ood_loaders, log, metrics, telem, run_meta, img_dir,
                     render_push, target_accu, guard, skip_batches,
+                    window=window,
                 )
             except DivergenceError as e:
                 rollbacks += 1
                 res_metrics.counter(res_metrics.ROLLBACKS).inc()
+                recorder.record("rollback", epoch=epoch, error=str(e))
+                dumped = recorder.maybe_dump("divergence_rollback")
+                if dumped:
+                    log(f"flight recorder dumped to {dumped}")
                 if rollbacks > max_rollbacks:
                     log(f"rollback budget exhausted ({max_rollbacks}); giving up")
                     raise
@@ -387,6 +423,11 @@ def run_training(
                 if telem:
                     telem.flush(step=int(state.step),
                                 extra={"event": "preemption"})
+                recorder.record(
+                    "preemption", epoch=epoch, batch=guard.batches_done,
+                    reason=handler.reason or "",
+                )
+                recorder.maybe_dump("preemption")
                 log(
                     f"preempted ({handler.reason}); saved {path} at epoch "
                     f"{epoch} batch {guard.batches_done}; resume with "
@@ -425,7 +466,16 @@ def run_training(
                 metadata=run_meta,
             )
             log("training done")
+    except BaseException:
+        # unhandled crash (incl. the exhausted-rollback re-raise): the ring
+        # of recent steps/events is the post-mortem — dump it before the
+        # exception propagates
+        recorder.maybe_dump("crash")
+        raise
     finally:
+        if window is not None:
+            window.close()  # never leave a device trace open
+        set_recorder(prev_recorder)
         if chaos_installed:
             chaos_mod.set_active(prev_chaos)
         if telem:
@@ -443,7 +493,7 @@ def _run_epoch(
     cfg, trainer, state, epoch, start_epoch, profile_dir,
     train_loader, test_loader, push_loader, push_ds, ood_loaders,
     log, metrics, telem, run_meta, img_dir, render_push, target_accu,
-    guard=None, skip_batches=0,
+    guard=None, skip_batches=0, window=None,
 ):
     """One epoch of the reference main.py flow (train / test / conditional
     push), under an `epoch` tracing span so the stage spans nest.
@@ -475,6 +525,7 @@ def _run_epoch(
                 state, batches, epoch,
                 monitor=telem.monitor if telem else None,
                 guard=guard,
+                window=window,
             )
         if last is not None:
             m = jax.device_get(last._asdict())
@@ -597,6 +648,9 @@ def main(argv: Optional[list] = None) -> None:
         keep_best=args.keep_best,
         chaos=chaos_state,
         auto_tune=args.auto_tune,
+        profile_steps=args.profile_steps,
+        profile_on_anomaly=args.profile_on_anomaly,
+        profile_out=args.profile_out,
     )
     # a preempted run exits 0: the scheduler sees a clean shutdown and the
     # marker file + checkpoint make the next invocation resume bit-exactly

@@ -580,7 +580,7 @@ class Trainer:
         return jax.device_put(out)
 
     def train_epoch(self, state, batches, epoch: int, monitor=None,
-                    guard=None):
+                    guard=None, window=None):
         """Drive one epoch over an iterable of (images, labels) host batches.
 
         Batches are device-prefetched (data/loader.py device_prefetch): batch
@@ -620,8 +620,16 @@ class Trainer:
         path (normal end and guard-preemption stop both fall through the
         flush below), its metrics fold into the epoch accumulators, and
         each step's bank-in-flight window feeds the monitor's
-        `bank_dispatch_overlap_fraction` gauge."""
+        `bank_dispatch_overlap_fraction` gauge.
+
+        `window` (an obs.profiler.ProfilerWindow) observes each step too:
+        it arms/disarms `jax.profiler` capture on its configured step range
+        or anomaly triggers (spike vs EMA, recompile via `monitor`,
+        loader-wait fraction). Every step also lands on the process flight
+        recorder's ring, so a failure dump shows the steps leading up to
+        it."""
         from mgproto_tpu.data.loader import device_prefetch
+        from mgproto_tpu.obs.flightrec import record_event
         from mgproto_tpu.telemetry.monitor import tree_transfer_bytes
 
         self.reset_bank_pipeline()
@@ -631,6 +639,7 @@ class Trainer:
             batches = guard.wrap_batches(batches)
         last = None
         em_max = fm_max = fb_sum = None
+        step_i = 0
         t_prev = time.perf_counter()
         prefetched = device_prefetch(
             batches, self.put_batch, depth=self.cfg.data.prefetch_depth
@@ -656,16 +665,25 @@ class Trainer:
                 warm=flags["warm"],
                 seeds=batch[2] if len(batch) > 2 else None,
             )
+            now = time.perf_counter()
+            step_s = now - t_prev
+            t_prev = now
             if monitor is not None:
-                now = time.perf_counter()
                 monitor.observe_step(
                     int(images.shape[0]),
-                    now - t_prev,
+                    step_s,
                     transfer_bytes=tree_transfer_bytes(batch),
                     wait_seconds=wait_s,
                     bank_overlap_seconds=self._bank_overlap_step_s,
                 )
-                t_prev = now
+            wait_frac = wait_s / step_s if step_s > 0 else 0.0
+            record_event(
+                "step", epoch=epoch, i=step_i,
+                seconds=round(step_s, 6), wait_s=round(wait_s, 6),
+            )
+            step_i += 1
+            if window is not None:
+                window.on_step(step_s, wait_fraction=wait_frac)
             em_max = (
                 last.em_active if em_max is None
                 else jnp.maximum(em_max, last.em_active)

@@ -1,0 +1,45 @@
+"""Performance observatory (ISSUE 8): the instrumentation loop that turns
+"the run was slow" into line items.
+
+Sits on top of the PR-1 telemetry substrate (registry / tracer / monitors)
+and closes the loop ROADMAP item 2 opens — capture evidence, attribute the
+stall budget, and make the numbers enforceable:
+
+  profiler  — `ProfilerWindow`: arms `jax.profiler` trace capture for a
+              configured step range or automatically on anomaly triggers
+              (step-time spike vs EMA, recompile, loader-wait fraction);
+              degrades to a cost-analysis-only capture off-TPU so the whole
+              arming path is tier-1 testable.
+  stall     — stall-budget attribution: a captured device trace (or the
+              hermetic XLA cost-analysis fallback) apportioned into
+              MXU-busy / HBM-bound / host+infeed / bubble buckets, with
+              measured-vs-attainable MFU in the PERF.md decomposition.
+              Driven by `scripts/trace_report.py`.
+  reqtrace  — end-to-end request tracing through the serving plane:
+              frontend -> batcher -> replica -> engine stage spans on the
+              plane's injectable clock, per-stage latency histograms, and
+              an opt-in timing breakdown on the ServeResponse. Zero
+              per-request work when disabled.
+  flightrec — bounded ring buffer of recent structured events (steps,
+              dispatch triggers, breaker transitions, swaps, chaos
+              injections, rollbacks) dumped to JSONL on divergence
+              rollback, preemption, replica death or crash.
+
+Everything here is host-side; `stall`'s cost-analysis path is the only
+module that touches jax, and only when asked to lower a program. The
+regression gate lives in `cli/telemetry.py` (`mgproto-telemetry check`).
+"""
+
+from mgproto_tpu.obs.flightrec import (
+    FlightRecorder,
+    get_recorder,
+    record_event,
+    set_recorder,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "get_recorder",
+    "record_event",
+    "set_recorder",
+]

@@ -1,0 +1,149 @@
+"""Flight recorder: a bounded ring of recent structured events, dumped on
+failure.
+
+Post-mortems of a crashed or rolled-back run keep asking the same question:
+what was the system DOING in the seconds before it went wrong? Metrics are
+aggregates and the log is prose; the flight recorder keeps the last N
+structured events — train steps, micro-batch dispatch triggers, breaker
+transitions, replica failures/restarts, hot swaps, chaos injections,
+divergence streaks — and writes them as JSONL exactly when something dies:
+
+  * divergence rollback / preemption / unhandled crash (cli/train.py)
+  * replica death or wedge detection (serving/replica.py)
+
+Recording is always on and deliberately cheap (one small dict appended to a
+`deque(maxlen=...)` under a lock — the ring IS the retention policy); the
+DUMP only happens when a `dump_dir` has been configured, so library users
+and tests pay zero IO. The process-current recorder follows the same
+install/restore pattern as the telemetry registry and tracer.
+
+Events are host-side plain data; callers must `device_get` anything device-
+resident first (same contract as the metric registry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from mgproto_tpu.telemetry.tracing import _jsonable
+
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Ring buffer of recent events + dump-to-JSONL on failure."""
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        clock=time.time,
+        dump_dir: Optional[str] = None,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.clock = clock
+        self.dump_dir = dump_dir
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0  # total events recorded (survives ring eviction)
+        self._dumps = 0
+        self.dumped: List[str] = []  # paths written by maybe_dump
+
+    # ----------------------------------------------------------------- record
+    def record(self, kind: str, **fields) -> None:
+        """Append one event. Fields must be JSON-able scalars (everything
+        else is stringified, like span attrs)."""
+        evt: Dict[str, Any] = {
+            "ts": float(self.clock()),
+            "kind": str(kind),
+        }
+        for k, v in fields.items():
+            evt[k] = _jsonable(v)
+        with self._lock:
+            evt["seq"] = self._seq
+            self._seq += 1
+            self._events.append(evt)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    @property
+    def recorded_total(self) -> int:
+        with self._lock:
+            return self._seq
+
+    # ------------------------------------------------------------------- dump
+    def dump(self, path: str, reason: str) -> str:
+        """Write the ring as JSONL: one header record (reason, wall time,
+        counts), then one line per event, oldest first. Atomic (tmp+rename)
+        so a crash during the dump never leaves a torn file for the
+        post-mortem that needs it most."""
+        events = self.events()
+        header = {
+            "flight_recorder": True,
+            "reason": str(reason),
+            "dumped_at": time.time(),
+            "events": len(events),
+            "recorded_total": self.recorded_total,
+            "capacity": self.capacity,
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for evt in events:
+                f.write(json.dumps(evt) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    def maybe_dump(self, reason: str) -> Optional[str]:
+        """Dump iff a `dump_dir` is configured (the failure hooks call this
+        unconditionally; a library/test process without a configured dir
+        pays nothing). Each dump gets its own numbered file so a rollback
+        storm cannot overwrite the first — usually most interesting —
+        capture."""
+        if not self.dump_dir:
+            return None
+        with self._lock:
+            n = self._dumps
+            self._dumps += 1
+        path = os.path.join(
+            self.dump_dir, f"flightrec_{reason}_{n:03d}.jsonl"
+        )
+        out = self.dump(path, reason)
+        self.dumped.append(out)
+        return out
+
+
+_DEFAULT = FlightRecorder()
+_CURRENT = _DEFAULT
+
+
+def get_recorder() -> FlightRecorder:
+    """The process-current recorder (always exists; dump_dir may be None)."""
+    return _CURRENT
+
+
+def set_recorder(recorder: Optional[FlightRecorder]) -> FlightRecorder:
+    """Install `recorder` as process-current (None -> the process default);
+    returns the previously current one so callers can restore it."""
+    global _CURRENT
+    prev = _CURRENT
+    _CURRENT = recorder if recorder is not None else _DEFAULT
+    return prev
+
+
+def record_event(kind: str, **fields) -> None:
+    """One-liner for instrumentation sites: record on the current ring."""
+    _CURRENT.record(kind, **fields)

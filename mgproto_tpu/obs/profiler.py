@@ -1,0 +1,316 @@
+"""Programmatic profiler windows: capture the trace exactly when it matters.
+
+ROADMAP item 2's first lever is "capture the pending profiler trace and
+apportion the stall budget" — but a trace captured at an arbitrary moment
+usually misses the anomaly it was meant to explain. `ProfilerWindow` arms
+`jax.profiler` trace capture either
+
+  * for a CONFIGURED STEP RANGE (`--profile_steps A:B`, e.g. steady state
+    well past warmup), or
+  * AUTOMATICALLY when a trigger fires (`--profile_on_anomaly`):
+      - step-time spike: a step slower than `spike_factor` x the window's
+        own EMA (after `min_steps` of settling),
+      - recompile: the watched StepMonitor's `jit_recompiles_total` grew
+        mid-run (steady state must be zero-recompile; any growth is
+        exactly the moment to capture),
+      - loader-wait: the step blocked on the input pipeline for more than
+        `wait_fraction` of its wall time.
+
+A step-range capture is ONE window spanning the whole range (a bare step
+captures one step); anomaly captures each run `capture_steps` steps. Every
+capture writes one directory under `out_dir` (`trace_<reason>_step<N>/`),
+with at most `max_captures` anomaly captures per run and a
+`cooldown_steps` refractory period so a pathological run cannot spend its
+epoch writing traces.
+
+OFF-TPU DEGRADE: `jax.profiler` traces on CPU carry no device lanes worth
+attributing, so off-TPU (or when `start_trace` raises) the window degrades
+to a COST-ANALYSIS-ONLY capture: the `cost_provider` callable (the caller
+lowers its actual production program — see `obs/stall.py::step_costs`)
+is invoked once and its FLOPs/bytes report is written as
+`cost_analysis.json` next to a `capture_meta.json` describing why the
+window armed. That keeps the whole arm/disarm/trigger path tier-1 testable
+and still yields the numbers `scripts/trace_report.py` attributes.
+
+Every arm/disarm is also recorded on the flight recorder, so a post-mortem
+dump shows whether (and why) a capture was in flight.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from mgproto_tpu.obs.flightrec import record_event
+
+META_FILE = "capture_meta.json"
+COST_FILE = "cost_analysis.json"
+
+
+def parse_step_range(raw: str) -> Optional[Tuple[int, int]]:
+    """'120:130' -> (120, 130); '' -> None. A bare 'N' captures one step."""
+    raw = (raw or "").strip()
+    if not raw:
+        return None
+    start, sep, end = raw.partition(":")
+    a = int(start)
+    b = int(end) if sep and end else a + 1
+    if b <= a:
+        raise ValueError(f"empty profile step range {raw!r}")
+    return a, b
+
+
+def _backend() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "none"
+
+
+def trace_supported() -> bool:
+    """Real device-trace capture is only worth the IO on an accelerator."""
+    return _backend() in ("tpu", "gpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class Triggers:
+    """Anomaly-trigger knobs (see module docstring)."""
+
+    spike_factor: float = 3.0
+    min_steps: int = 20  # EMA settle time before the spike trigger arms
+    wait_fraction: float = 0.5
+    recompile: bool = True
+    ema_alpha: float = 0.1
+
+
+class ProfilerWindow:
+    """Step-driven capture controller; `on_step` is the only per-step hook
+    (engine/train.py calls it after each observed step)."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        steps: Optional[Tuple[int, int]] = None,
+        on_anomaly: bool = False,
+        triggers: Optional[Triggers] = None,
+        capture_steps: int = 3,
+        max_captures: int = 2,
+        cooldown_steps: int = 50,
+        monitor=None,
+        cost_provider: Optional[Callable[[], dict]] = None,
+        log: Optional[Callable[[str], None]] = None,
+    ):
+        self.out_dir = out_dir
+        self.steps = steps
+        self.on_anomaly = bool(on_anomaly)
+        self.triggers = triggers if triggers is not None else Triggers()
+        self.capture_steps = max(int(capture_steps), 1)
+        self.max_captures = max(int(max_captures), 1)
+        self.cooldown_steps = max(int(cooldown_steps), 0)
+        self.monitor = monitor
+        self.cost_provider = cost_provider
+        self.log = log
+        self.captures: List[dict] = []  # {dir, reason, step, fallback}
+        self._step = 0  # steps observed by THIS window (this invocation)
+        self._ema: Optional[float] = None
+        self._armed_reason: Optional[str] = None
+        self._armed_at = 0
+        self._tracing = False  # a real jax.profiler trace is open
+        self._cooldown_until = -1
+        self._last_recompiles = (
+            monitor.recompile_count if monitor is not None else 0
+        )
+
+    # ------------------------------------------------------------------ state
+    @property
+    def armed(self) -> bool:
+        return self._armed_reason is not None
+
+    @property
+    def steps_observed(self) -> int:
+        return self._step
+
+    # ------------------------------------------------------------------- hook
+    def on_step(self, seconds: float, wait_fraction: float = 0.0) -> None:
+        """Observe one completed step; decides arm/disarm. `seconds` is the
+        step's host wall time, `wait_fraction` the loader-blocked share of
+        it. Step indices count THIS window's observations (a resumed run
+        restarts at 0 — document ranges accordingly)."""
+        step = self._step
+        self._step += 1
+
+        if self.armed:
+            # an explicit step range is ONE window: it stays open until the
+            # range ends (never fragmented into capture_steps-long pieces);
+            # anomaly windows run capture_steps steps
+            if self._armed_reason == "steps":
+                if self.steps is None or step >= self.steps[1]:
+                    self.disarm()
+            elif step - self._armed_at + 1 >= self.capture_steps:
+                self.disarm()
+            return
+
+        reason = self._due(step, seconds, wait_fraction)
+        # EMA updates AFTER the spike check so the spike that arms the
+        # window does not immediately poison its own baseline
+        a = self.triggers.ema_alpha
+        self._ema = (
+            seconds if self._ema is None
+            else a * seconds + (1 - a) * self._ema
+        )
+        if reason is not None:
+            self.arm(reason)
+
+    def _due(
+        self, step: int, seconds: float, wait_fraction: float
+    ) -> Optional[str]:
+        if self.steps is not None and self.steps[0] <= step < self.steps[1]:
+            return "steps"
+        if not self.on_anomaly:
+            return None
+        if len(self.captures) >= self.max_captures:
+            return None
+        if step < self._cooldown_until:
+            return None
+        t = self.triggers
+        if t.recompile and self.monitor is not None:
+            count = self.monitor.recompile_count
+            if count > self._last_recompiles:
+                self._last_recompiles = count
+                return "recompile"
+            self._last_recompiles = count
+        if (
+            self._ema is not None
+            and step >= t.min_steps
+            and seconds > t.spike_factor * self._ema
+        ):
+            return "spike"
+        if wait_fraction >= t.wait_fraction and step >= t.min_steps:
+            return "loader_wait"
+        return None
+
+    # ----------------------------------------------------------- arm / disarm
+    def arm(self, reason: str) -> str:
+        """Open a capture window NOW (also the public entry for one-shot
+        captures, e.g. serve warmup). Returns the capture directory."""
+        if self.armed:
+            return self.captures[-1]["dir"]
+        path = os.path.join(
+            self.out_dir, f"trace_{reason}_step{self._step:06d}"
+        )
+        os.makedirs(path, exist_ok=True)
+        self._armed_reason = reason
+        self._armed_at = self._step
+        fallback = True
+        if trace_supported():
+            try:
+                import jax
+
+                jax.profiler.start_trace(path)
+                self._tracing = True
+                fallback = False
+            except Exception as e:  # plugin missing, second trace, ...
+                if self.log:
+                    self.log(f"profiler: start_trace failed ({e}); "
+                             "falling back to cost analysis")
+        capture = {
+            "dir": path,
+            "reason": reason,
+            "step": self._step,
+            "fallback": fallback,
+        }
+        self.captures.append(capture)
+        record_event(
+            "profiler_arm", reason=reason, step=self._step, dir=path,
+            fallback=fallback,
+        )
+        if self.log:
+            self.log(
+                f"profiler: armed ({reason}) at step {self._step} -> {path}"
+            )
+        self._write_meta(capture)
+        if fallback:
+            self._write_cost_analysis(capture)
+        return path
+
+    def disarm(self) -> None:
+        """Close the open window (stop the device trace if one is live)."""
+        if not self.armed:
+            return
+        reason = self._armed_reason
+        self._armed_reason = None
+        self._cooldown_until = self._step + self.cooldown_steps
+        if self._tracing:
+            self._tracing = False
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                if self.log:
+                    self.log(f"profiler: stop_trace failed ({e})")
+        record_event("profiler_disarm", reason=reason, step=self._step)
+        if self.log:
+            self.log(f"profiler: capture closed at step {self._step}")
+
+    def close(self) -> None:
+        """End-of-run safety: never leave a device trace open."""
+        self.disarm()
+
+    # -------------------------------------------------------------- fallbacks
+    def _write_meta(self, capture: dict) -> None:
+        meta = {
+            "profiler_capture": True,
+            "reason": capture["reason"],
+            "step": capture["step"],
+            "backend": _backend(),
+            "fallback": capture["fallback"],
+            "capture_steps": self.capture_steps,
+            "wall_time": time.time(),
+        }
+        with open(os.path.join(capture["dir"], META_FILE), "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+
+    def _write_cost_analysis(self, capture: dict) -> None:
+        """The off-TPU degrade: one cost/memory-analysis report of the
+        production program, so the capture still feeds trace_report's
+        roofline attribution."""
+        if self.cost_provider is None:
+            return
+        try:
+            costs = self.cost_provider()
+        except Exception as e:
+            costs = {"error": f"{type(e).__name__}: {e}"}
+            if self.log:
+                self.log(f"profiler: cost_provider failed ({e})")
+        with open(os.path.join(capture["dir"], COST_FILE), "w") as f:
+            json.dump(costs, f, indent=2, sort_keys=True)
+
+
+@contextlib.contextmanager
+def profile_block(
+    out_dir: str,
+    cost_provider: Optional[Callable[[], dict]] = None,
+    reason: str = "block",
+    log: Optional[Callable[[str], None]] = None,
+):
+    """One-shot capture around a block (serve warmup uses this): a real
+    device trace on TPU/GPU, the cost-analysis fallback elsewhere. No-op
+    when `out_dir` is falsy."""
+    if not out_dir:
+        yield None
+        return
+    window = ProfilerWindow(
+        out_dir, cost_provider=cost_provider, log=log
+    )
+    path = window.arm(reason)
+    try:
+        yield path
+    finally:
+        window.disarm()

@@ -1,0 +1,341 @@
+"""Stall-budget attribution: where does the step time actually go?
+
+PERF.md's headroom decomposition ends with "apportioning those needs the
+profiler trace" — the measured 55.8% MFU vs the ~88.6% structural ceiling,
+with the gap blamed on HBM stalls, host/infeed time and bubbles but never
+itemized. This module produces that itemization as ONE schema, from either
+evidence source:
+
+  * a CAPTURED DEVICE TRACE (Chrome trace-event JSON, as written by
+    `jax.profiler` / xprof or by our own exporters): device-op durations
+    are classified by name into MXU / HBM / host+infeed buckets and the
+    gaps on the busiest device lane become the bubble bucket.
+  * the HERMETIC COST-ANALYSIS FALLBACK (CPU, tier-1): the production step
+    program is lowered through the SAME `perf.planner.lower_split_programs`
+    helper the auto-tuner and `bench.py --measure overlap` use, XLA's
+    cost analysis supplies FLOPs + bytes accessed, and a roofline model
+    apportions a (measured or modeled) step time.
+
+Both paths emit the same report: step time split into four buckets that sum
+to ~100% —
+
+    mxu_busy    time the matrix units are doing the program's FLOPs
+    hbm_bound   bandwidth time NOT hidden behind compute (bytes/BW minus
+                the compute it could overlap; the roofline's memory wall)
+    host_infeed host + input-pipeline time the device sat waiting
+    bubble      everything else (scheduling gaps, launch latency, the
+                residual between model and measurement)
+
+— plus measured vs attainable MFU in the PERF.md decomposition (the
+attainable bound defaults to the committed
+`evidence/mfu_headroom_b256.json` flop-weighted tiling bound).
+
+Driven by `scripts/trace_report.py`; `ProfilerWindow`'s off-TPU fallback
+uses `step_costs` as its cost provider.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# v5e reference peaks (overridable everywhere): bf16 MXU peak and HBM BW
+DEFAULT_PEAK_FLOPS = 197e12
+DEFAULT_HBM_BYTES_PER_S = 819e9
+DEFAULT_ATTAINABLE_MFU = 0.886  # PERF.md structural ceiling (see below)
+
+BUCKETS = ("mxu_busy", "hbm_bound", "host_infeed", "bubble")
+
+# ---------------------------------------------------------------- trace side
+# device-op name -> bucket. Checked in order; first hit wins. The MXU list
+# is deliberately ahead of the HBM list: a fusion named "fusion.conv..."
+# is matrix work even though plain "fusion" defaults to bandwidth-bound.
+_HOST_TOKENS = (
+    "infeed", "outfeed", "host", "transfer", "copy-start", "copy-done",
+    "send", "recv",
+)
+_MXU_TOKENS = (
+    "convolution", "conv", "dot", "matmul", "gemm", "mxu", "einsum",
+    "cublas", "custom-call",  # the fused Pallas scoring/E-step kernels
+)
+_HBM_TOKENS = (
+    "copy", "scatter", "gather", "reduce", "broadcast", "transpose",
+    "select", "concatenate", "slice", "pad", "iota", "sort", "fusion",
+    "all-reduce", "all-gather", "reduce-scatter", "bitcast", "compare",
+    "loop", "while", "dynamic-update",
+)
+
+
+def classify_op(name: str) -> str:
+    """Bucket for one device-op (trace event) name."""
+    n = name.lower()
+    for tok in _HOST_TOKENS:
+        if tok in n:
+            return "host_infeed"
+    for tok in _MXU_TOKENS:
+        if tok in n:
+            return "mxu_busy"
+    for tok in _HBM_TOKENS:
+        if tok in n:
+            return "hbm_bound"
+    return "hbm_bound"  # unknown elementwise tails are bandwidth-bound
+
+
+def load_chrome_trace(path: str) -> List[Dict[str, Any]]:
+    """traceEvents from a Chrome trace file (.json / .json.gz) or from the
+    newest *.trace.json(.gz) under a profiler output directory."""
+    if os.path.isdir(path):
+        candidates = sorted(
+            glob.glob(os.path.join(path, "**", "*.trace.json*"),
+                      recursive=True),
+            key=os.path.getmtime,
+        )
+        if not candidates:
+            raise FileNotFoundError(
+                f"no *.trace.json(.gz) under {path} — is this a profiler "
+                "output directory?"
+            )
+        path = candidates[-1]
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data) if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path} is not a Chrome trace")
+    return events
+
+
+def attribute_trace(
+    events: Iterable[Dict[str, Any]],
+    host_infeed_s: float = 0.0,
+) -> Dict[str, Any]:
+    """Bucket seconds from complete ('X') trace events. The busiest
+    pid/tid lane is taken as THE device lane: its busy time is classified
+    by op name, and the unoccupied remainder of its span is the bubble.
+    `host_infeed_s` adds externally measured host wait (e.g. telemetry's
+    loader_wait_fraction x step time) on top of host-named ops."""
+    lanes: Dict[Tuple[Any, Any], Dict[str, float]] = {}
+    per_lane_events: Dict[Tuple[Any, Any], List] = {}
+    for e in events:
+        if e.get("ph", "X") != "X":
+            continue
+        dur = float(e.get("dur", 0.0)) / 1e6
+        if dur <= 0:
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        lane = lanes.setdefault(key, {"busy": 0.0})
+        lane["busy"] += dur
+        per_lane_events.setdefault(key, []).append(e)
+    if not lanes:
+        raise ValueError("trace has no complete events to attribute")
+    device_lane = max(lanes, key=lambda k: lanes[k]["busy"])
+    evs = per_lane_events[device_lane]
+    buckets = {b: 0.0 for b in BUCKETS}
+    t_min, t_max = float("inf"), float("-inf")
+    for e in evs:
+        ts = float(e.get("ts", 0.0)) / 1e6
+        dur = float(e.get("dur", 0.0)) / 1e6
+        buckets[classify_op(str(e.get("name", "?")))] += dur
+        t_min = min(t_min, ts)
+        t_max = max(t_max, ts + dur)
+    span = max(t_max - t_min, 0.0)
+    busy = sum(buckets.values())
+    buckets["bubble"] = max(span - busy, 0.0)
+    buckets["host_infeed"] += max(float(host_infeed_s), 0.0)
+    total = sum(buckets.values())
+    return {
+        "source": "trace",
+        "device_lane": {"pid": device_lane[0], "tid": device_lane[1],
+                        "events": len(evs)},
+        "span_s": span,
+        "step_time_s": total,
+        "buckets": _fractions(buckets, total),
+    }
+
+
+# ------------------------------------------------------------ cost-model side
+def step_costs(cfg, batch: Optional[int] = None) -> Dict[str, Any]:
+    """FLOPs / bytes-accessed / peak-bytes of the production step program(s)
+    for `cfg` at `batch` (per-chip), from XLA's compiled-module analyses —
+    hermetic on CPU. Async-bank configs report trunk + bank separately and
+    summed; sync configs the monolithic step. Shapes only: the state is
+    `eval_shape`d, nothing real is allocated. Also the `cost_provider`
+    behind ProfilerWindow's off-TPU fallback capture."""
+    import jax
+    import jax.numpy as jnp
+
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.perf.planner import _program_peak, lower_split_programs
+
+    trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
+    state = jax.eval_shape(
+        lambda rng: trainer.init_state(rng, for_restore=True),
+        jax.random.PRNGKey(0),
+    )
+    m = cfg.model
+    b = int(batch) if batch else int(cfg.data.train_batch_size)
+    img_dtype = jnp.uint8 if trainer._device_augment else jnp.float32
+    images = jax.ShapeDtypeStruct((b, m.img_size, m.img_size, 3), img_dtype)
+    labels = jax.ShapeDtypeStruct((b,), jnp.int32)
+    seeds = jax.ShapeDtypeStruct((b,), jnp.uint32)
+    use_mine = jnp.asarray(1.0, jnp.float32)
+    update_gmm = jnp.asarray(True, bool)
+
+    def _costs(compiled) -> Dict[str, Any]:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        peak, _ = _program_peak(compiled)
+        return {
+            "flops": float(ca.get("flops") or 0.0),
+            "bytes_accessed": float(
+                ca.get("bytes accessed", ca.get("bytes_accessed")) or 0.0
+            ),
+            "peak_bytes": int(peak),
+        }
+
+    programs: Dict[str, Dict[str, Any]] = {}
+    if trainer.async_bank:
+        trunk_l, bank_l = lower_split_programs(
+            trainer, state, images, labels, seeds, use_mine, update_gmm
+        )
+        programs["trunk"] = _costs(trunk_l.compile())
+        programs["bank"] = _costs(bank_l.compile())
+    else:
+        programs["step"] = _costs(
+            trainer._train_step.lower(
+                state, images, labels, seeds, use_mine, update_gmm,
+                warm=False,
+            ).compile()
+        )
+    return {
+        "batch": b,
+        "backend": jax.default_backend(),
+        "async_bank": trainer.async_bank,
+        "programs": programs,
+        "flops": sum(p["flops"] for p in programs.values()),
+        "bytes_accessed": sum(
+            p["bytes_accessed"] for p in programs.values()
+        ),
+        "peak_bytes": sum(p["peak_bytes"] for p in programs.values()),
+    }
+
+
+def roofline_buckets(
+    flops: float,
+    bytes_accessed: float,
+    step_time_s: Optional[float] = None,
+    host_infeed_s: float = 0.0,
+    peak_flops: float = DEFAULT_PEAK_FLOPS,
+    hbm_bytes_per_s: float = DEFAULT_HBM_BYTES_PER_S,
+) -> Dict[str, Any]:
+    """Apportion a step via the roofline: compute time is flops/peak, the
+    HBM bucket is the bandwidth time compute cannot hide, host time is
+    whatever the caller measured.
+
+    A MEASURED `step_time_s` is GROUND TRUTH: the buckets partition it
+    exactly. The bandwidth model is an upper bound on stall time (XLA's
+    bytes-accessed is fusion-pessimistic, especially on the CPU backend),
+    so the HBM bucket is clamped into the measured residual after compute
+    and host time; whatever the bandwidth model cannot claim is the
+    bubble. `hbm_model_clamped` flags when the clamp bit (the model had
+    MORE traffic than the residual — read the HBM bucket as "at least
+    this bound-ness", not a precise stall count). Without a measurement
+    the modeled sum stands in (bubble 0) and the report says so.
+    Fractions always sum to 1 of the reported step time."""
+    mxu_s = flops / peak_flops if peak_flops > 0 else 0.0
+    hbm_total_s = bytes_accessed / hbm_bytes_per_s if hbm_bytes_per_s else 0.0
+    hbm_raw_s = max(hbm_total_s - mxu_s, 0.0)
+    host_s = max(float(host_infeed_s), 0.0)
+    measured = step_time_s is not None
+    if measured:
+        # a step cannot be shorter than its compute + host floor; a
+        # measurement below it means the peaks are mis-set, and the floor
+        # wins so the partition stays consistent
+        total = max(float(step_time_s), mxu_s + host_s)
+        hbm_s = min(hbm_raw_s, max(total - mxu_s - host_s, 0.0))
+    else:
+        total = mxu_s + hbm_raw_s + host_s
+        hbm_s = hbm_raw_s
+    buckets = {
+        "mxu_busy": mxu_s,
+        "hbm_bound": hbm_s,
+        "host_infeed": host_s,
+        "bubble": max(total - mxu_s - hbm_s - host_s, 0.0),
+    }
+    return {
+        "source": "cost_analysis",
+        "step_time_s": total,
+        "step_time_measured": measured,
+        "modeled_step_time_s": mxu_s + hbm_raw_s + host_s,
+        "hbm_total_s": hbm_total_s,
+        "hbm_model_clamped": measured and hbm_raw_s > hbm_s,
+        "buckets": _fractions(buckets, total),
+    }
+
+
+# ------------------------------------------------------------------- report
+def _fractions(buckets: Dict[str, float], total: float) -> Dict[str, Any]:
+    return {
+        name: {
+            "seconds": buckets[name],
+            "fraction": buckets[name] / total if total > 0 else 0.0,
+        }
+        for name in BUCKETS
+    }
+
+
+def attainable_mfu_default(repo_root: Optional[str] = None) -> float:
+    """The committed structural ceiling (mfu_headroom's FLOP-weighted MXU
+    tiling bound), falling back to the PERF.md constant."""
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    path = os.path.join(root, "evidence", "mfu_headroom_b256.json")
+    try:
+        with open(path) as f:
+            v = json.load(f).get("flop_weighted_mxu_eff_bound")
+        if v:
+            return float(v)
+    except (OSError, ValueError):
+        pass
+    return DEFAULT_ATTAINABLE_MFU
+
+
+def finish_report(
+    attribution: Dict[str, Any],
+    flops: Optional[float] = None,
+    peak_flops: float = DEFAULT_PEAK_FLOPS,
+    attainable_mfu: Optional[float] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Wrap a bucket attribution into the one stall-report schema: add the
+    fraction-sum self-check and the measured-vs-attainable MFU line items
+    (PERF.md decomposition: measured = flops / (step x peak), attainable =
+    the array-padding ceiling, ratio = the stall tax the buckets itemize)."""
+    report: Dict[str, Any] = {"stall_report": True, **attribution}
+    fractions = [
+        b["fraction"] for b in attribution["buckets"].values()
+    ]
+    report["fraction_sum"] = sum(fractions)
+    att = (
+        float(attainable_mfu) if attainable_mfu is not None
+        else attainable_mfu_default()
+    )
+    report["attainable_mfu"] = att
+    step = attribution.get("step_time_s") or 0.0
+    if flops and step > 0 and peak_flops > 0:
+        measured = flops / (step * peak_flops)
+        report["flops"] = flops
+        report["peak_flops"] = peak_flops
+        report["measured_mfu"] = measured
+        report["mfu_ratio_measured_over_attainable"] = (
+            measured / att if att > 0 else None
+        )
+    if extra:
+        report.update(extra)
+    return report

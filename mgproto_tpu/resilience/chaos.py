@@ -142,9 +142,13 @@ class ChaosState:
         self._bad_swaps_left = int(plan.serve_swap_bad_artifact)
 
     def _count(self, kind: str) -> None:
+        from mgproto_tpu.obs.flightrec import record_event
         from mgproto_tpu.resilience import metrics as _m
 
         _m.counter(_m.CHAOS_INJECTIONS).inc(kind=kind)
+        # every injected fault lands on the flight recorder too: a
+        # post-mortem dump must show the chaos that provoked the failure
+        record_event("chaos_injection", fault=kind)
 
     # ------------------------------------------------------------- loader IO
     def loader_should_fail(

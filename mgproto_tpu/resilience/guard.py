@@ -179,6 +179,15 @@ class EpochGuard:
         streak = int(jax.device_get(self._streak))
         if streak >= self.max_bad_steps:
             self._flush_bad()
+            from mgproto_tpu.obs.flightrec import record_event
+
+            # the flight recorder's ring (recent steps, chaos injections)
+            # is about to be dumped by the driver's rollback path; the
+            # divergence event itself must be ON it
+            record_event(
+                "divergence", streak=streak, epoch=self.epoch,
+                step=self._base_step + self.batches_done - self.already_done,
+            )
             raise DivergenceError(
                 streak=streak,
                 step=self._base_step + self.batches_done - self.already_done,

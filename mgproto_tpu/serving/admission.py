@@ -203,6 +203,12 @@ class CircuitBreaker:
         _m.counter(_m.BREAKER_TRANSITIONS).inc(
             edge=f"{self.state}->{new_state}"
         )
+        from mgproto_tpu.obs.flightrec import record_event
+
+        record_event(
+            "breaker_transition", edge=f"{self.state}->{new_state}",
+            consecutive_failures=self.consecutive_failures,
+        )
         self.state = new_state
         _m.gauge(_m.BREAKER_STATE).set(_STATE_GAUGE[new_state])
 

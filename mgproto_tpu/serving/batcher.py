@@ -33,6 +33,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, List, Optional
 
+from mgproto_tpu.obs import reqtrace as _reqtrace
+from mgproto_tpu.obs.flightrec import record_event
 from mgproto_tpu.serving import metrics as _m
 from mgproto_tpu.serving.response import ServeResponse
 
@@ -121,11 +123,27 @@ class MicroBatcher:
     def _dispatch(self, trigger: str) -> List[ServeResponse]:
         _m.counter(_m.DISPATCHES).inc(trigger=trigger)
         self.dispatches += 1
+        record_event(
+            "dispatch", replica=self.name, trigger=trigger,
+            depth=len(self.engine.queue),
+        )
         t0 = self.clock()  # before the hook: its virtual service time is
         # exactly what the cost EMA must measure
-        if self.pre_dispatch is not None:
-            self.pre_dispatch()
-        responses = self.engine.process_pending()
+        if _reqtrace.enabled():
+            # request tracing: the engine's on_dispatch stamps the batch
+            # with this replica lane, the trigger, and the t0 above — so
+            # the trace's linger/device split matches the cost EMA's view
+            _reqtrace.dispatch_context(self.name or "", trigger, t0)
+        try:
+            if self.pre_dispatch is not None:
+                self.pre_dispatch()
+            responses = self.engine.process_pending()
+        finally:
+            # a pump that never reached on_dispatch (breaker open, empty
+            # pop, device error) must not leak its context into a later
+            # context-less dispatch
+            if _reqtrace.enabled():
+                _reqtrace.clear_dispatch_context()
         dt = self.clock() - t0
         if dt > 0:  # a virtual clock that did not move leaves the prior
             a = self.config.cost_ema_alpha

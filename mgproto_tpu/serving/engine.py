@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from mgproto_tpu.obs import reqtrace as _reqtrace
 from mgproto_tpu.resilience import chaos as _chaos
 from mgproto_tpu.serving import metrics as _m
 from mgproto_tpu.serving.admission import (
@@ -210,6 +211,40 @@ class ServingEngine:
         self.warmed_up = True
         return self.monitor.check_recompiles()
 
+    def warmup_costs(self) -> Dict[str, Any]:
+        """XLA cost analysis of the inference program at every bucket —
+        the `--profile_warmup` off-TPU degrade (cli/serve.py writes this
+        as the capture's cost_analysis.json, same schema family as
+        obs/stall.step_costs). AOT-lowers each bucket shape, so it repeats
+        warmup's compile work: call only when profiling asked for it."""
+        import jax
+
+        programs: Dict[str, Any] = {}
+        for b in self.buckets:
+            spec = jax.ShapeDtypeStruct(
+                (b, self.img_size, self.img_size, 3), np.float32
+            )
+            ca = self._jit.lower(spec).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            ca = ca or {}
+            programs[f"b{b}"] = {
+                "flops": float(ca.get("flops") or 0.0),
+                "bytes_accessed": float(
+                    ca.get("bytes accessed", ca.get("bytes_accessed"))
+                    or 0.0
+                ),
+            }
+        return {
+            "backend": jax.default_backend(),
+            "buckets": [int(b) for b in self.buckets],
+            "programs": programs,
+            "flops": sum(p["flops"] for p in programs.values()),
+            "bytes_accessed": sum(
+                p["bytes_accessed"] for p in programs.values()
+            ),
+        }
+
     # ------------------------------------------------------------- admission
     def submit(
         self,
@@ -262,6 +297,11 @@ class ServingEngine:
         req, shed_reason = self.queue.submit(
             clean, request_id=request_id, deadline_s=deadline_s
         )
+        if shed_reason is None and _reqtrace.enabled():
+            # request tracing (obs/reqtrace.py): stamp admission. Mints
+            # here too when no frontend/supervisor minted earlier (the
+            # single-engine batch face), so every traced face gets spans.
+            _reqtrace.on_enqueue(req.request_id, req.enqueued_at)
         out = []
         for shed in self.queue.drain_shed():
             reason = shed_reason if shed is req else "deadline"
@@ -283,6 +323,8 @@ class ServingEngine:
         responses for requests shed while queued). Never raises from
         request content or device failure."""
         responses: List[ServeResponse] = []
+        t_pop = self.clock()  # dispatch-window fallback when no batcher set
+        # a context (direct process_pending callers: serve_all, tests)
         batch = self.queue.pop_batch(self.buckets[-1])
         # requests shed at pop time (expired while queued) answer typed
         for req in self.queue.drain_shed():
@@ -328,6 +370,14 @@ class ServingEngine:
                 )
             return responses
         self.breaker.record_success()
+        if _reqtrace.enabled():
+            bucket = self._bucket_for(len(batch))
+            _reqtrace.on_dispatch(
+                [r.request_id for r in batch],
+                bucket=bucket,
+                fill=len(batch) / bucket,
+                fallback_t0=t_pop,
+            )
         responses.extend(self._gated_responses(batch, logits, log_px))
         return responses
 

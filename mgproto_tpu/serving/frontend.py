@@ -42,6 +42,7 @@ import json
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
+from mgproto_tpu.obs import reqtrace as _reqtrace
 from mgproto_tpu.serving.replica import ReplicaSet
 from mgproto_tpu.serving.response import (
     OUTCOME_ABSTAIN,
@@ -330,6 +331,12 @@ class Frontend:
         rid = str(rec.get("id", f"h{self._seq}"))
         if rid in self._pending:  # duplicate in flight: keep ids unique
             rid = f"{rid}#{self._seq}"
+        if _reqtrace.enabled():
+            # request tracing starts at the HTTP boundary, stamped with the
+            # PLANE's clock (the same one the replicas/batchers run on), so
+            # the frontend span includes the pump/inbox wait the engine-side
+            # stages cannot see
+            _reqtrace.mint(rid, self.replicas.clock())
         if self._stopping():
             resp = shed_response(rid, REASON_SHUTDOWN)
             return http_status_for(resp), json.dumps(

@@ -42,6 +42,8 @@ BREAKER_OPEN_FRACTION = "serving_breaker_open_fraction"
 UPTIME_SECONDS = "serving_uptime_seconds"
 SWAPS = "serving_swap_total"
 SWAP_TRANSFERRED = "serving_swap_transferred_total"
+# --- performance observatory (ISSUE 8): per-stage request latency ---
+STAGE_SECONDS = "serving_stage_seconds"
 
 COUNTER_HELP = {
     REQUESTS: "requests by outcome (predict/abstain/reject/shed)",
@@ -83,6 +85,10 @@ HIST_HELP = {
     REQUEST_SECONDS: "per-request latency (admission to response), by outcome",
     BATCH_FILL_HIST:
         "occupied fraction of each padded serving batch (per dispatch)",
+    STAGE_SECONDS:
+        "per-request stage latency by stage (queue=admission wait + "
+        "batcher linger, device=dispatch time, total=arrival to response); "
+        "populated only while request tracing (obs/reqtrace.py) is enabled",
 }
 
 HIST_BUCKETS = {

@@ -33,6 +33,8 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional
 
+from mgproto_tpu.obs import reqtrace as _reqtrace
+from mgproto_tpu.obs.flightrec import get_recorder
 from mgproto_tpu.resilience import chaos as _chaos
 from mgproto_tpu.resilience.retry import backoff_delays
 from mgproto_tpu.serving import metrics as _m
@@ -212,6 +214,11 @@ class ReplicaSet:
         seq = self._admit_seq
         self._admit_seq += 1
         rid = request_id or f"g{seq}"
+        if _reqtrace.enabled():
+            # frontend-less faces (batch driver, load harness) start the
+            # request trace here; the HTTP frontend minted earlier and
+            # this is then a no-op (first mint wins)
+            _reqtrace.mint(rid, self.clock())
         target = self._pick()
         chaos = _chaos.get_active()
         if chaos is not None and target is not None:
@@ -219,9 +226,17 @@ class ReplicaSet:
             # would have landed on; the request itself reroutes
             if chaos.serve_replica_kill_due(seq):
                 target.alive = False
+                get_recorder().record(
+                    "chaos_replica_kill", replica=target.name, request=rid
+                )
+                _reqtrace.plane_event("replica_kill", replica=target.name)
                 target = self._pick()
             elif chaos.serve_replica_wedge_due(seq):
                 target.wedged = True
+                get_recorder().record(
+                    "chaos_replica_wedge", replica=target.name, request=rid
+                )
+                _reqtrace.plane_event("replica_wedge", replica=target.name)
                 target = self._pick()
         if target is None:
             return [shed_response(rid, REASON_NO_REPLICA)]
@@ -319,6 +334,19 @@ class ReplicaSet:
         the restart on the retry-backoff schedule."""
         reason = FAILURE_WEDGED if rep.alive else FAILURE_DEAD
         _m.counter(_m.REPLICA_RESTARTS).inc(reason=reason)
+        # flight recorder: a replica death is exactly the moment the recent
+        # event ring is worth keeping — record it, then dump (when a
+        # dump_dir is configured) so the post-mortem shows what the fleet
+        # was doing in the seconds before the heartbeat went stale
+        recorder = get_recorder()
+        recorder.record(
+            "replica_failure", replica=rep.name, reason=reason,
+            queued=len(rep.engine.queue) if rep.engine else 0,
+            restarts=rep.restarts,
+        )
+        _reqtrace.plane_event(
+            "replica_fail_detected", replica=rep.name, reason=reason
+        )
         out: List[ServeResponse] = []
         stranded = rep.engine.queue.drain_all() if rep.engine else []
         stranded.extend(rep.engine.queue.drain_shed() if rep.engine else [])
@@ -347,6 +375,7 @@ class ReplicaSet:
         rep.probe = None
         rep.state = STATE_BACKOFF
         rep.restart_at = now + self._restart_delay(rep.restarts)
+        recorder.maybe_dump(f"replica_{reason}")
         return out
 
     def _restart_delay(self, attempts: int) -> float:
@@ -367,6 +396,10 @@ class ReplicaSet:
         rep.restarts += 1
         try:
             rep.start()
+            get_recorder().record(
+                "replica_restart", replica=rep.name, attempts=rep.restarts
+            )
+            _reqtrace.plane_event("replica_restart", replica=rep.name)
         except Exception:
             # the factory/warmup failed (artifact gone, device sick): stay
             # in backoff at the next longer delay; the fleet keeps serving

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional
 
+from mgproto_tpu.obs import reqtrace as _reqtrace
 from mgproto_tpu.serving import metrics as _m
 
 OUTCOME_PREDICT = "predict"
@@ -47,19 +48,35 @@ class ServeResponse:
     degraded: bool = False
     reason: Optional[str] = None  # reject/shed cause
     latency_s: float = 0.0
+    # opt-in per-request timing breakdown (obs/reqtrace.py with
+    # include_timings=True): total_s / queue_s / device_s / pad_fraction /
+    # replica. None — and absent from to_dict() — everywhere else, so the
+    # wire format only grows for operators who asked for it.
+    timings: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if d.get("timings") is None:
+            d.pop("timings", None)
+        return d
 
 
 def record(resp: ServeResponse) -> ServeResponse:
-    """Account a response leaving the system (see module docstring)."""
+    """Account a response leaving the system (see module docstring). ALSO
+    the one request-tracing exit: when obs/reqtrace is enabled the stage
+    spans + histograms are emitted here, and the opt-in timing breakdown is
+    attached to the returned response — callers already use the return
+    value, so the trace can never double- or un-finish a request."""
     _m.counter(_m.REQUESTS).inc(outcome=resp.outcome)
     _m.histogram(_m.REQUEST_SECONDS).observe(
         max(resp.latency_s, 0.0), outcome=resp.outcome
     )
     if resp.degraded and resp.outcome == OUTCOME_PREDICT:
         _m.counter(_m.DEGRADED_REQUESTS).inc()
+    if _reqtrace.enabled():
+        timings = _reqtrace.finish(resp)
+        if timings is not None:
+            resp = dataclasses.replace(resp, timings=timings)
     return resp
 
 

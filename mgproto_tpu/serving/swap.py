@@ -35,6 +35,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from mgproto_tpu.obs import reqtrace as _reqtrace
+from mgproto_tpu.obs.flightrec import record_event
 from mgproto_tpu.resilience import chaos as _chaos
 from mgproto_tpu.serving import metrics as _m
 from mgproto_tpu.serving.replica import ReplicaSet
@@ -123,6 +125,8 @@ def stage_fleet(
         )
         if engine is None:
             _m.counter(_m.SWAPS).inc(result=SWAP_REJECTED, reason=reason)
+            record_event("swap_rejected", reason=reason, detail=detail)
+            _reqtrace.plane_event("swap_rejected", reason=reason)
             return [], SwapReport(ok=False, reason=reason, detail=detail)
         standbys.append(engine)
     return standbys, None
@@ -160,6 +164,12 @@ def flip_fleet(
     replica_set.engine_factory = standby_factory
     _m.counter(_m.SWAPS).inc(result=SWAP_COMMITTED)
     _m.counter(_m.SWAP_TRANSFERRED).inc(float(transferred))
+    record_event(
+        "swap_committed", replicas=swapped, transferred=transferred
+    )
+    _reqtrace.plane_event(
+        "swap_committed", replicas=swapped, transferred=transferred
+    )
     return SwapReport(
         ok=True,
         reason=SWAP_COMMITTED,

@@ -132,6 +132,17 @@ class StepMonitor:
             "trunk compute (host dispatch-clock estimate; 0 in sync mode)",
         )
         self._g_bank_overlap.set(0.0, phase=phase)
+        # compile-time cost gauges (record_cost_analysis below): created
+        # here WITHOUT a value so the names are registered (the
+        # check_metric_registry lint's contract) while runs that never
+        # attach a cost analysis still report "absent", not a fake zero
+        self._g_flops = r.gauge(
+            "step_flops", "compiled step FLOPs (XLA cost analysis)"
+        )
+        self._g_bytes = r.gauge(
+            "step_bytes_accessed",
+            "compiled step bytes accessed (XLA cost analysis)",
+        )
 
     # ------------------------------------------------------------- recompiles
     def watch(self, *targets: WatchTarget) -> "StepMonitor":
@@ -265,12 +276,7 @@ class StepMonitor:
             return
         flops = ca.get("flops")
         if flops and flops > 0:
-            self.registry.gauge(
-                "step_flops", "compiled step FLOPs (XLA cost analysis)"
-            ).set(float(flops), phase=self.phase)
+            self._g_flops.set(float(flops), phase=self.phase)
         nbytes = ca.get("bytes accessed")
         if nbytes and nbytes > 0:
-            self.registry.gauge(
-                "step_bytes_accessed",
-                "compiled step bytes accessed (XLA cost analysis)",
-            ).set(float(nbytes), phase=self.phase)
+            self._g_bytes.set(float(nbytes), phase=self.phase)

@@ -83,6 +83,37 @@ class Tracer:
                 else:
                     self.dropped += 1
 
+    def add_span(
+        self,
+        name: str,
+        ts: float,
+        dur: float = 0.0,
+        tid: int = 0,
+        **attrs,
+    ) -> None:
+        """Record a span with EXPLICIT timestamps (seconds, in the caller's
+        own clock domain) instead of wall-clocking a `with` block. The
+        serving-plane request tracer (obs/reqtrace.py) uses this to emit
+        per-request stage spans stamped with the plane's injectable clock —
+        including the load harness's virtual clock, where perf_counter
+        would be meaningless. `dur=0` renders as an instant marker."""
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self.dropped += 1
+                return
+            span_id = self._next_id
+            self._next_id += 1
+            self._spans.append(SpanRecord(
+                id=span_id,
+                name=str(name),
+                ts=float(ts),
+                dur=max(float(dur), 0.0),
+                depth=0,
+                parent=-1,
+                tid=int(tid),
+                attrs={k: _jsonable(v) for k, v in attrs.items()},
+            ))
+
     def spans(self) -> List[SpanRecord]:
         with self._lock:
             return list(self._spans)

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Lint: every metric name used in mgproto_tpu/ must be pre-registered.
+
+`mgproto-telemetry summarize` (and now the `check` regression gate) read
+the registry SNAPSHOT a run wrote — a metric that was incremented through a
+name nobody pre-registered in the telemetry session still snapshots, but a
+clean run that never hits that code path silently misses the series, the
+summarize section can't render its explicit zero, and a `check` baseline
+generated from the clean run can never gate it. The repo's convention
+(telemetry/session.py, resilience/metrics.py, serving/metrics.py) is
+therefore: every metric family is PRE-registered with an explicit zero.
+
+This lint enforces it statically. It walks every module under mgproto_tpu/
+and collects each `<registry>.counter(...)` / `.gauge(...)` /
+`.histogram(...)` call whose first argument is
+
+  * a string literal ("steps_total"), or
+  * an UPPER_CASE constant — resolved through the module's own assignments
+    and its imports of the metric-name modules (serving.metrics,
+    resilience.metrics, telemetry.session, data.loader);
+
+then instantiates a real TelemetrySession (plus `register_serving_metrics`,
+the serve-side family) and asserts every collected name exists in that
+registry. Dynamic names (f-strings like the `run_<key>` mirrors) are out of
+scope by construction — they cannot be pre-registered and summarize treats
+them as pass-through extras.
+
+Run from anywhere:
+
+    python scripts/check_metric_registry.py [repo_root]
+
+Exit 0 when clean, 1 with one `path:line: name` per offender. Wired into
+tier-1 via tests/test_observatory.py (with violation-detection coverage,
+like the other lint scripts).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional, Set, Tuple
+
+_METRIC_METHODS = ("counter", "gauge", "histogram")
+
+# generic plumbing where `name` is a variable by design (the registry
+# itself, and the helper modules whose public counter(name)/gauge(name)
+# functions forward a constant resolved at the CALL site)
+_SKIP_FILES = (
+    os.path.join("telemetry", "registry.py"),
+)
+
+
+def _module_constants(tree: ast.AST) -> Dict[str, str]:
+    """UPPER_CASE = "string" assignments at module level."""
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.isupper():
+                    out[t.id] = node.value.value
+    return out
+
+
+def _import_map(tree: ast.AST) -> Dict[str, str]:
+    """local alias -> dotted module, for mgproto_tpu modules only."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("mgproto_tpu"):
+                    out[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                full = f"{node.module}.{a.name}"
+                if full.startswith("mgproto_tpu"):
+                    out[a.asname or a.name] = full
+    return out
+
+
+class _Scanner:
+    def __init__(self, pkg_root: str):
+        self.pkg_root = pkg_root  # .../mgproto_tpu
+        self._const_cache: Dict[str, Dict[str, str]] = {}
+
+    def _module_path(self, dotted: str) -> Optional[str]:
+        rel = dotted.split(".")
+        if rel[0] != "mgproto_tpu":
+            return None
+        path = os.path.join(self.pkg_root, *rel[1:]) + ".py"
+        if os.path.isfile(path):
+            return path
+        init = os.path.join(self.pkg_root, *rel[1:], "__init__.py")
+        return init if os.path.isfile(init) else None
+
+    def constants_of(self, dotted: str) -> Dict[str, str]:
+        if dotted in self._const_cache:
+            return self._const_cache[dotted]
+        path = self._module_path(dotted)
+        consts: Dict[str, str] = {}
+        if path is not None:
+            with open(path) as f:
+                try:
+                    consts = _module_constants(ast.parse(f.read()))
+                except SyntaxError:
+                    pass
+        self._const_cache[dotted] = consts
+        return consts
+
+    def used_names(
+        self, path: str
+    ) -> Tuple[List[Tuple[int, str]], List[Tuple[int, str]]]:
+        """(resolved metric names, unresolvable constant refs) with lines."""
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        local = _module_constants(tree)
+        imports = _import_map(tree)
+        names: List[Tuple[int, str]] = []
+        unresolved: List[Tuple[int, str]] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f_ = node.func
+            method = None
+            if isinstance(f_, ast.Attribute) and f_.attr in _METRIC_METHODS:
+                method = f_.attr
+            elif isinstance(f_, ast.Name) and f_.id in _METRIC_METHODS:
+                method = f_.id
+            if method is None or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names.append((node.lineno, arg.value))
+            elif isinstance(arg, ast.Name) and arg.id.isupper():
+                if arg.id in local:
+                    names.append((node.lineno, local[arg.id]))
+                elif arg.id in imports:
+                    # `from x.session import EM_ACTIVE_GAUGE`-style import
+                    mod, _, const = imports[arg.id].rpartition(".")
+                    value = self.constants_of(mod).get(const)
+                    if value is not None:
+                        names.append((node.lineno, value))
+                    else:
+                        unresolved.append((node.lineno, arg.id))
+                else:
+                    unresolved.append((node.lineno, arg.id))
+            elif isinstance(arg, ast.Attribute) and isinstance(
+                arg.value, ast.Name
+            ) and arg.attr.isupper():
+                dotted = imports.get(arg.value.id)
+                value = (
+                    self.constants_of(dotted).get(arg.attr)
+                    if dotted else None
+                )
+                if value is not None:
+                    names.append((node.lineno, value))
+                else:
+                    unresolved.append(
+                        (node.lineno, f"{arg.value.id}.{arg.attr}")
+                    )
+            # anything else (f-strings, variables) is dynamic: out of scope
+        return names, unresolved
+
+
+def registered_names() -> Set[str]:
+    """Every metric name a real TelemetrySession (+ the serving family)
+    pre-registers — the ground truth summarize/check can see."""
+    from mgproto_tpu.serving.metrics import register_serving_metrics
+    from mgproto_tpu.telemetry.session import TelemetrySession
+
+    with tempfile.TemporaryDirectory() as tmp:
+        session = TelemetrySession(tmp, primary=True)
+        try:
+            register_serving_metrics(session.registry)
+            return {m.name for m in session.registry.metrics()}
+        finally:
+            session.close()
+
+
+def offenders(repo_root: str) -> List[Tuple[str, int, str]]:
+    pkg = os.path.join(repo_root, "mgproto_tpu")
+    scanner = _Scanner(pkg)
+    known = registered_names()
+    found: List[Tuple[str, int, str]] = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo_root)
+            if any(rel.endswith(skip) for skip in _SKIP_FILES):
+                continue
+            names, unresolved = scanner.used_names(path)
+            for lineno, name in names:
+                if name not in known:
+                    found.append((rel, lineno, f"unregistered metric "
+                                               f"{name!r}"))
+            for lineno, ref in unresolved:
+                found.append((rel, lineno,
+                              f"unresolvable metric-name constant {ref}"))
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    sys.path.insert(0, root)
+    found = offenders(root)
+    for path, lineno, why in found:
+        print(f"{path}:{lineno}: {why} (pre-register it in "
+              "telemetry/session.py, resilience/metrics.py or "
+              "serving/metrics.py so summarize/check can see it)")
+    if found:
+        return 1
+    print("check_metric_registry: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

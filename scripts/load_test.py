@@ -111,10 +111,17 @@ def run_load_test(
     malformed_rate: float = 0.0,
     nan_rate: float = 0.0,
     device_errors: Sequence[int] = (),
+    trace_out: Optional[str] = None,
 ) -> Dict:
     """Drive the storm; returns the result record (see module docstring).
     Importable — tests/test_load_plane.py runs the acceptance drill through
-    this exact function."""
+    this exact function.
+
+    `trace_out` exports the whole virtual-clock timeline as a Chrome trace:
+    per-request frontend/batcher/replica/engine stage spans, per-dispatch
+    coalescing spans, and kill/wedge/restart/swap markers — every timestamp
+    is VIRTUAL seconds, so the timeline is exactly the seeded schedule
+    (schema notes in evidence/README.md). Open in Perfetto/chrome://tracing."""
     import jax
 
     from mgproto_tpu.config import tiny_test_config
@@ -164,6 +171,16 @@ def run_load_test(
         calib = calibrate(trainer, state, id_batches)
         clock = VirtualClock()
         service_s = service_ms / 1000.0
+
+        tracer = None
+        if trace_out:
+            # request tracing on the VIRTUAL clock, into a private tracer
+            # (so the exported timeline holds only this storm's spans)
+            from mgproto_tpu.obs import reqtrace
+            from mgproto_tpu.telemetry.tracing import Tracer
+
+            tracer = Tracer()
+            reqtrace.enable(clock=clock, tracer=tracer)
 
         def factory():
             return ServingEngine.from_live(
@@ -324,8 +341,24 @@ def run_load_test(
             "steady_state_recompiles": rs.steady_recompiles,
             "virtual_duration_s": round(clock(), 3),
         }
+        if tracer is not None:
+            os.makedirs(os.path.dirname(trace_out) or ".", exist_ok=True)
+            tracer.export_chrome_trace(trace_out)
+            spans = tracer.spans()
+            result["trace"] = {
+                "path": os.path.abspath(trace_out),
+                "events": len(spans),
+                "spans_by_name": {
+                    name: sum(1 for s in spans if s["name"] == name)
+                    for name in sorted({s["name"] for s in spans})
+                },
+            }
         return result
     finally:
+        if trace_out:
+            from mgproto_tpu.obs import reqtrace
+
+            reqtrace.disable()
         chaos_mod.set_active(prev_chaos)
         set_current_registry(prev_registry)
 
@@ -355,6 +388,10 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--out", default="",
                    help="write the JSON line here (e.g. "
                         "evidence/load_test_baseline.json)")
+    p.add_argument("--trace", default="",
+                   help="export the virtual-clock timeline as a Chrome "
+                        "trace here (per-request stage spans, dispatch "
+                        "coalescing, kill/swap markers; open in Perfetto)")
     args = p.parse_args(argv)
 
     result = run_load_test(
@@ -373,6 +410,7 @@ def main(argv: Optional[list] = None) -> int:
         swap_good_at=args.swap_good_at,
         malformed_rate=args.malformed_rate,
         nan_rate=args.nan_rate,
+        trace_out=args.trace or None,
     )
     line = json.dumps(result, sort_keys=True)
     print(line)

@@ -1,0 +1,824 @@
+"""Performance observatory tests (ISSUE 8): profiler capture windows
+(step-range + anomaly triggers with cooldown/caps and the off-TPU
+cost-analysis degrade), stall-budget attribution (trace classification and
+the roofline fallback, buckets summing to ~100%), end-to-end request
+tracing (frontend->batcher->replica->engine spans on the plane clock,
+per-stage histograms, opt-in response timings, disabled-is-free), the
+flight recorder (ring semantics, dump-on-rollback e2e, dump-on-replica-
+death), the `mgproto-telemetry check` regression gate (exit codes against
+fresh and perturbed baselines), the latency-unit convention, and the
+metric-registry lint.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mgproto_tpu.obs import stall
+from mgproto_tpu.obs.flightrec import (
+    FlightRecorder,
+    get_recorder,
+    set_recorder,
+)
+from mgproto_tpu.obs.profiler import (
+    ProfilerWindow,
+    Triggers,
+    parse_step_range,
+    profile_block,
+)
+from mgproto_tpu.obs import reqtrace
+from mgproto_tpu.resilience import chaos as chaos_mod
+from mgproto_tpu.serving import metrics as sm
+from mgproto_tpu.serving.calibration import Calibration
+from mgproto_tpu.serving.replica import ReplicaSet
+from mgproto_tpu.telemetry.registry import (
+    MetricRegistry,
+    set_current_registry,
+)
+from mgproto_tpu.telemetry.tracing import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+IMG = 8
+NUM_CLASSES = 4
+FINGERPRINT = "fp-obs"
+
+
+@pytest.fixture(autouse=True)
+def fresh_observatory_state():
+    prev_reg = set_current_registry(MetricRegistry())
+    prev_chaos = chaos_mod.set_active(None)
+    prev_rec = set_recorder(FlightRecorder())
+    reqtrace.disable()
+    yield
+    reqtrace.disable()
+    set_recorder(prev_rec)
+    chaos_mod.set_active(prev_chaos)
+    set_current_registry(prev_reg)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------- plane fixtures
+def make_engine(clock, buckets=(1, 2, 4), capacity=8, **kw):
+    """Real ServingEngine over a constant jit (near-zero compile cost)."""
+    import jax.numpy as jnp
+
+    from mgproto_tpu.serving.engine import ServingEngine
+
+    def infer(images):
+        b = images.shape[0]
+        return {
+            "logits": jnp.tile(
+                jnp.arange(NUM_CLASSES, dtype=jnp.float32), (b, 1)
+            ),
+            "log_px": jnp.full((b,), 5.0, jnp.float32),
+        }
+
+    rng = np.random.RandomState(0)
+    calib = Calibration.from_scores(
+        rng.randn(64) * 2.0 + 3.0,
+        rng.rand(64, NUM_CLASSES),
+        fingerprint=FINGERPRINT,
+    )
+    return ServingEngine(
+        infer,
+        img_size=IMG,
+        num_classes=NUM_CLASSES,
+        calibration=calib,
+        expected_fingerprint=FINGERPRINT,
+        buckets=buckets,
+        queue_capacity=capacity,
+        clock=clock,
+        **kw,
+    )
+
+
+def make_plane(clock, replicas=2, **kw):
+    rs = ReplicaSet(
+        lambda: make_engine(clock),
+        replicas=replicas,
+        clock=clock,
+        heartbeat_timeout_s=0.3,
+        **kw,
+    )
+    rs.start()
+    return rs
+
+
+def payload():
+    return np.random.RandomState(1).rand(IMG, IMG, 3).astype(np.float32)
+
+
+# ------------------------------------------------------------ ProfilerWindow
+def test_parse_step_range():
+    assert parse_step_range("") is None
+    assert parse_step_range("120:130") == (120, 130)
+    assert parse_step_range("7") == (7, 8)
+    with pytest.raises(ValueError):
+        parse_step_range("10:5")
+
+
+def test_profiler_step_range_capture(tmp_path):
+    costs = {"flops": 123.0, "bytes_accessed": 456.0}
+    w = ProfilerWindow(
+        str(tmp_path), steps=(1, 2), capture_steps=1,
+        cost_provider=lambda: costs,
+    )
+    w.on_step(0.01)
+    assert not w.armed
+    w.on_step(0.01)  # step 1: in range -> arm
+    assert w.armed
+    w.on_step(0.01)  # capture_steps=1 elapsed -> disarm
+    assert not w.armed
+    assert len(w.captures) == 1
+    cap = w.captures[0]
+    assert cap["reason"] == "steps" and cap["fallback"] is True
+    meta = json.load(open(os.path.join(cap["dir"], "capture_meta.json")))
+    assert meta["reason"] == "steps" and meta["fallback"] is True
+    # the off-TPU degrade wrote the cost-analysis capture
+    written = json.load(open(os.path.join(cap["dir"], "cost_analysis.json")))
+    assert written == costs
+
+
+def test_profiler_spike_trigger_and_cooldown(tmp_path):
+    w = ProfilerWindow(
+        str(tmp_path), on_anomaly=True, capture_steps=1, max_captures=5,
+        cooldown_steps=10,
+        triggers=Triggers(spike_factor=3.0, min_steps=5),
+    )
+    for _ in range(8):
+        w.on_step(0.01)
+    assert not w.armed and not w.captures
+    w.on_step(0.2)  # 20x EMA
+    assert w.armed and w.captures[-1]["reason"] == "spike"
+    w.on_step(0.01)  # closes the window
+    assert not w.armed
+    w.on_step(0.2)  # inside cooldown: no new capture
+    assert len(w.captures) == 1
+
+
+def test_profiler_recompile_trigger(tmp_path):
+    class FakeMonitor:
+        recompile_count = 0
+
+    mon = FakeMonitor()
+    w = ProfilerWindow(
+        str(tmp_path), on_anomaly=True, capture_steps=1, monitor=mon,
+        triggers=Triggers(min_steps=3),
+    )
+    for _ in range(4):
+        w.on_step(0.01)
+    assert not w.captures
+    mon.recompile_count = 2  # a mid-run retrace
+    w.on_step(0.01)
+    assert w.captures and w.captures[-1]["reason"] == "recompile"
+
+
+def test_profiler_loader_wait_trigger_and_max_captures(tmp_path):
+    w = ProfilerWindow(
+        str(tmp_path), on_anomaly=True, capture_steps=1, max_captures=1,
+        cooldown_steps=0,
+        triggers=Triggers(min_steps=2, wait_fraction=0.5),
+    )
+    for _ in range(3):
+        w.on_step(0.01, wait_fraction=0.1)
+    w.on_step(0.01, wait_fraction=0.9)
+    assert [c["reason"] for c in w.captures] == ["loader_wait"]
+    w.on_step(0.01)  # disarm
+    w.on_step(0.01, wait_fraction=0.9)  # max_captures=1: no second capture
+    assert len(w.captures) == 1
+    w.close()  # idempotent / safe when disarmed
+
+
+def test_profile_block_writes_capture_meta(tmp_path):
+    out = str(tmp_path / "warmup")
+    with profile_block(out, reason="serve_warmup") as path:
+        assert path is not None
+    metas = [
+        os.path.join(r, f)
+        for r, _d, fs in os.walk(out) for f in fs
+        if f == "capture_meta.json"
+    ]
+    assert len(metas) == 1
+    assert json.load(open(metas[0]))["reason"] == "serve_warmup"
+
+
+def test_profiler_window_arms_through_train_epoch(tmp_path):
+    """The engine wiring: train_epoch drives window.on_step and the flight
+    recorder gets per-step events."""
+    import jax
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=2)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batches = [
+        (
+            rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3)
+            .astype(np.float32),
+            rng.randint(0, cfg.model.num_classes, (4,)).astype(np.int32),
+        )
+        for _ in range(2)
+    ]
+    w = ProfilerWindow(
+        str(tmp_path), steps=(0, 1), capture_steps=1,
+        cost_provider=lambda: {"ok": True},
+    )
+    rec = get_recorder()
+    before = rec.recorded_total
+    trainer.train_epoch(state, iter(batches), epoch=0, window=w)
+    assert len(w.captures) == 1 and w.captures[0]["reason"] == "steps"
+    steps = [e for e in rec.events() if e["kind"] == "step"]
+    assert len(steps) >= 2 and rec.recorded_total > before
+
+
+# ------------------------------------------------------------ FlightRecorder
+def test_flightrec_ring_and_dump(tmp_path):
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("step", i=i)
+    events = rec.events()
+    assert len(events) == 4  # ring kept only the newest
+    assert [e["i"] for e in events] == [6, 7, 8, 9]
+    assert rec.recorded_total == 10
+    assert rec.maybe_dump("crash") is None  # no dump_dir: zero IO
+    rec.dump_dir = str(tmp_path)
+    path = rec.maybe_dump("crash")
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["flight_recorder"] and lines[0]["reason"] == "crash"
+    assert lines[0]["events"] == 4 and len(lines) == 5
+    # numbered dumps: a second failure never overwrites the first capture
+    path2 = rec.maybe_dump("crash")
+    assert path2 != path and os.path.isfile(path) and os.path.isfile(path2)
+
+
+def test_flightrec_dump_on_replica_death(tmp_path):
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    set_recorder(rec)
+    chaos_mod.set_active(
+        chaos_mod.ChaosState(
+            chaos_mod.ChaosPlan(seed=0, serve_replica_kill_at=2)
+        )
+    )
+    clock = FakeClock()
+    rs = make_plane(clock)
+    out = []
+    for i in range(4):
+        out.extend(rs.submit(payload(), request_id=f"r{i}"))
+        out.extend(rs.poll())
+        clock.advance(0.05)
+    clock.advance(1.0)  # past heartbeat staleness
+    out.extend(rs.poll())  # detects the dead replica -> dump
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("flightrec_")]
+    assert len(dumps) == 1 and "replica_dead" in dumps[0]
+    lines = [json.loads(l) for l in open(tmp_path / dumps[0])]
+    kinds = {l.get("kind") for l in lines[1:]}
+    # the dump shows the kill injection, the dispatches leading up to it,
+    # and the failure detection itself
+    assert {"chaos_replica_kill", "replica_failure", "dispatch"} <= kinds
+
+
+@pytest.mark.chaos
+def test_flightrec_dump_on_divergence_rollback(tmp_path):
+    """E2E: a NaN-poisoned step rolls the run back AND dumps the ring."""
+    from mgproto_tpu.cli.train import run_training
+    from mgproto_tpu.config import DataConfig, tiny_test_config
+
+    root = str(tmp_path / "data")
+    rng = np.random.RandomState(0)
+    for c in range(4):
+        d = os.path.join(root, "train", f"{c:03d}.class_{c}")
+        os.makedirs(d)
+        for i in range(6):
+            arr = rng.randint(0, 255, size=(40, 40, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"img_{i}.jpg"))
+    import dataclasses
+
+    cfg = tiny_test_config()
+    cfg = cfg.replace(
+        data=DataConfig(
+            train_dir=os.path.join(root, "train"),
+            test_dir=os.path.join(root, "train"),
+            train_push_dir=os.path.join(root, "train"),
+            train_batch_size=8,
+            test_batch_size=8,
+            train_push_batch_size=8,
+            num_workers=2,
+        ),
+        schedule=dataclasses.replace(cfg.schedule, push_start=99),
+        model_dir=str(tmp_path / "run"),
+    )
+    telem_dir = str(tmp_path / "telem")
+    chaos = chaos_mod.ChaosState(
+        chaos_mod.ChaosPlan(seed=0, nan_at_step=3)
+    )
+    run_training(
+        cfg, telemetry_dir=telem_dir, target_accu=-1.0,
+        max_bad_steps=1, divergence_check_every=1, chaos=chaos,
+    )
+    dumps = [
+        f for f in os.listdir(telem_dir)
+        if f.startswith("flightrec_divergence_rollback")
+    ]
+    assert len(dumps) == 1
+    lines = [json.loads(l) for l in open(os.path.join(telem_dir, dumps[0]))]
+    assert lines[0]["reason"] == "divergence_rollback"
+    kinds = {l.get("kind") for l in lines[1:]}
+    assert {"step", "divergence", "rollback"} <= kinds
+
+
+# ----------------------------------------------------------- request tracing
+def test_request_trace_stages_timings_and_histograms():
+    clock = FakeClock()
+    tracer = Tracer()
+    sm.register_serving_metrics(
+        set_current_registry(MetricRegistry()) and None
+        or __import__("mgproto_tpu.telemetry.registry",
+                      fromlist=["default_registry"]).default_registry()
+    )
+    reqtrace.enable(clock=clock, tracer=tracer, include_timings=True)
+    rs = make_plane(clock)
+    responses = []
+    for i in range(6):
+        responses.extend(rs.submit(payload(), request_id=f"t{i}"))
+        clock.advance(0.01)
+        responses.extend(rs.poll())
+    clock.advance(0.1)  # past linger
+    responses.extend(rs.poll())
+    responses.extend(rs.drain())
+    served = [r for r in responses if r.outcome in ("predict", "abstain")]
+    assert served, [r.outcome for r in responses]
+    # opt-in timing breakdown on the response itself
+    t = served[0].timings
+    assert t is not None
+    assert set(t) >= {"total_s", "queue_s", "device_s", "pad_fraction"}
+    assert t["total_s"] >= t["queue_s"] >= 0.0
+    assert "timings" in served[0].to_dict()
+    # stage spans for every stage of the pipeline
+    names = {s["name"] for s in tracer.spans()}
+    assert {"frontend", "batcher", "replica", "engine", "dispatch"} <= names
+    # every span timestamp is in the VIRTUAL clock domain
+    assert all(0.0 <= s["ts"] <= clock() for s in tracer.spans())
+    # per-stage histograms landed in the registry
+    from mgproto_tpu.telemetry.registry import default_registry
+
+    snap = default_registry().snapshot()
+    stages = {
+        s["labels"]["stage"]
+        for s in snap[sm.STAGE_SECONDS]["series"]
+        if s.get("count")
+    }
+    assert {"queue", "device", "total"} <= stages
+    # nothing leaks: every minted request was finished
+    assert not reqtrace._STATE.pending
+
+
+def test_request_trace_summarize_stage_section():
+    from mgproto_tpu.cli.telemetry import _serving_section
+    from mgproto_tpu.telemetry.registry import default_registry
+
+    clock = FakeClock()
+    sm.register_serving_metrics(default_registry())
+    reqtrace.enable(clock=clock, tracer=Tracer())
+    rs = make_plane(clock, replicas=1)
+    rs.submit(payload(), request_id="a")
+    clock.advance(0.1)
+    rs.poll()
+    section = _serving_section(default_registry().snapshot())
+    assert section is not None and "stage_seconds" in section
+    assert "total" in section["stage_seconds"]
+    assert section["stage_seconds"]["total"]["p50"] is not None
+
+
+def test_request_trace_disabled_is_free():
+    clock = FakeClock()
+    rs = make_plane(clock, replicas=1)
+    rs.submit(payload(), request_id="a")
+    clock.advance(0.1)
+    out = rs.poll()
+    assert out and out[0].timings is None
+    assert "timings" not in out[0].to_dict()
+    assert not reqtrace.enabled()
+
+
+def test_request_trace_shed_has_frontend_span_only():
+    clock = FakeClock()
+    tracer = Tracer()
+    reqtrace.enable(clock=clock, tracer=tracer, include_timings=True)
+    rs = make_plane(clock, replicas=1)
+    out = rs.submit(payload(), request_id="dead", deadline_s=-1.0)
+    assert out and out[0].outcome == "shed"
+    spans = {s["name"]: s for s in tracer.spans()}
+    assert "frontend" in spans and spans["frontend"]["attrs"]["request"] == "dead"
+    assert "engine" not in spans
+    assert not reqtrace._STATE.pending  # shed finishes the trace too
+
+
+# --------------------------------------------------------- stall attribution
+def test_classify_op():
+    assert stall.classify_op("fusion.123.convolution_3x3") == "mxu_busy"
+    assert stall.classify_op("dot_general.7") == "mxu_busy"
+    assert stall.classify_op("fusion.42") == "hbm_bound"
+    assert stall.classify_op("dynamic-update-slice") == "hbm_bound"
+    assert stall.classify_op("InfeedDequeueTuple") == "host_infeed"
+    assert stall.classify_op("unknown_weird_op") == "hbm_bound"
+
+
+def _event(name, ts_us, dur_us, pid=1, tid=1):
+    return {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
+            "pid": pid, "tid": tid}
+
+
+def test_attribute_trace_buckets_and_bubble():
+    events = [
+        _event("convolution.1", 0, 400),
+        _event("fusion.2", 400, 200),  # elementwise -> hbm
+        _event("infeed.3", 700, 100),  # 100us gap before it -> bubble
+        # a second, quieter lane must NOT be picked as the device lane
+        _event("noise", 0, 10, tid=9),
+    ]
+    rep = stall.attribute_trace(events)
+    b = rep["buckets"]
+    assert b["mxu_busy"]["seconds"] == pytest.approx(400e-6)
+    assert b["hbm_bound"]["seconds"] == pytest.approx(200e-6)
+    assert b["host_infeed"]["seconds"] == pytest.approx(100e-6)
+    assert b["bubble"]["seconds"] == pytest.approx(100e-6)
+    assert sum(x["fraction"] for x in b.values()) == pytest.approx(1.0)
+    assert rep["device_lane"]["tid"] == 1
+
+
+def test_roofline_measured_partition_and_clamp():
+    # measured step larger than the model: residual becomes bubble
+    rep = stall.roofline_buckets(
+        flops=1e12, bytes_accessed=1e9, step_time_s=0.02,
+        host_infeed_s=0.001, peak_flops=1e14, hbm_bytes_per_s=1e12,
+    )
+    b = rep["buckets"]
+    total = sum(x["seconds"] for x in b.values())
+    assert total == pytest.approx(0.02)
+    assert sum(x["fraction"] for x in b.values()) == pytest.approx(1.0)
+    assert not rep["hbm_model_clamped"] and b["bubble"]["seconds"] > 0
+    # bytes model bigger than the measured residual: clamped, bubble 0
+    rep2 = stall.roofline_buckets(
+        flops=1e12, bytes_accessed=1e12, step_time_s=0.02,
+        peak_flops=1e14, hbm_bytes_per_s=1e12,
+    )
+    assert rep2["hbm_model_clamped"]
+    assert sum(
+        x["seconds"] for x in rep2["buckets"].values()
+    ) == pytest.approx(0.02)
+    # no measurement: modeled total, explicit flag
+    rep3 = stall.roofline_buckets(
+        flops=1e12, bytes_accessed=1e9, peak_flops=1e14,
+        hbm_bytes_per_s=1e12,
+    )
+    assert not rep3["step_time_measured"]
+    assert rep3["buckets"]["bubble"]["seconds"] == 0.0
+
+
+def test_trace_report_cost_fallback_tiny_cpu():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from trace_report import cost_analysis_report
+    finally:
+        sys.path.pop(0)
+    rep = cost_analysis_report(
+        batch=4, step_time_s=None, host_infeed_s=0.0,
+        peak_flops=197e12, hbm_bytes_per_s=819e9, attainable=None,
+        tiny=True,
+    )
+    assert rep["stall_report"] and rep["source"] == "cost_analysis"
+    assert set(rep["buckets"]) == set(stall.BUCKETS)
+    assert rep["fraction_sum"] == pytest.approx(1.0)
+    assert rep["flops"] > 0 and rep["bytes_accessed"] > 0
+
+
+def test_trace_report_script_trace_mode(tmp_path):
+    trace = {
+        "traceEvents": [
+            _event("convolution.1", 0, 500),
+            _event("fusion.9", 500, 300),
+        ]
+    }
+    path = str(tmp_path / "t.json")
+    json.dump(trace, open(path, "w"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         "--trace", path, "--flops", "1e9"],
+        capture_output=True, text=True, env={**os.environ,
+                                             "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["source"] == "trace"
+    assert rep["fraction_sum"] == pytest.approx(1.0)
+    assert rep["measured_mfu"] > 0
+
+
+def test_stall_report_evidence_committed():
+    """Acceptance: the committed flagship stall report exists, buckets sum
+    to ~100% of the measured step, and the MFU line items match the
+    BENCH/PERF story (55.8% measured against the ~88.6% ceiling)."""
+    path = os.path.join(REPO, "evidence", "stall_report_b256.json")
+    rep = json.loads(open(path).read().strip())
+    assert rep["stall_report"] and rep["config"] == "flagship"
+    assert rep["fraction_sum"] == pytest.approx(1.0, abs=1e-6)
+    assert rep["step_time_measured"]
+    assert rep["measured_mfu"] == pytest.approx(0.558, abs=0.02)
+    assert 0.8 < rep["attainable_mfu"] < 1.0
+
+
+# ---------------------------------------------------------- regression gate
+def _make_telemetry_dir(tmp_path, ips=100.0):
+    """A real TelemetrySession with a few observed steps."""
+    from mgproto_tpu.telemetry.session import TelemetrySession
+
+    d = str(tmp_path / f"telem_{ips:g}")
+    session = TelemetrySession(d, primary=True)
+    try:
+        for _ in range(8):
+            session.monitor.observe_step(
+                n_images=8, seconds=8.0 / ips, check_recompiles=False
+            )
+        session.flush(step=8)
+    finally:
+        session.close()
+    return d
+
+
+def test_check_baseline_roundtrip_and_perturbation(tmp_path, capsys):
+    from mgproto_tpu.cli.telemetry import check_main, main
+
+    d = _make_telemetry_dir(tmp_path)
+    baseline = str(tmp_path / "baseline.json")
+    assert check_main([d, "--baseline", baseline, "--write-baseline"]) == 0
+    rec = json.load(open(baseline))
+    assert rec["telemetry_check_baseline"]
+    keys = {e["key"] for e in rec["entries"]}
+    assert "steps.images_per_sec" in keys
+    # fresh baseline: the same run passes its own gates (exit 0)
+    assert check_main([d, "--baseline", baseline]) == 0
+    assert main(["check", d, "--baseline", baseline]) == 0  # subcommand path
+    # perturbed fixture: demand 10x the throughput -> regression (exit 1)
+    for e in rec["entries"]:
+        if e["key"] == "steps.images_per_sec":
+            e["value"] *= 10.0
+    json.dump(rec, open(baseline, "w"))
+    assert check_main([d, "--baseline", baseline]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "images_per_sec" in out
+
+
+def test_check_missing_metric_fails(tmp_path):
+    from mgproto_tpu.cli.telemetry import check_main
+
+    d = _make_telemetry_dir(tmp_path)
+    baseline = str(tmp_path / "b.json")
+    json.dump({
+        "telemetry_check_baseline": True,
+        "entries": [{"key": "serving.request_p99_seconds", "value": 0.1,
+                     "direction": "lower", "rel_tol": 0.3}],
+    }, open(baseline, "w"))
+    # this training-only run has no serving section: the gated metric
+    # vanished, which is itself a regression
+    assert check_main([d, "--baseline", baseline]) == 1
+
+
+def test_check_entry_directions():
+    from mgproto_tpu.cli.telemetry import check_entry
+
+    summary = {"steps": {"ips": 90.0, "t": 0.011, "zero": 0.0}}
+    higher = {"key": "steps.ips", "value": 100.0, "direction": "higher",
+              "rel_tol": 0.2}
+    assert check_entry(higher, summary)["ok"]  # 90 >= 80
+    higher["rel_tol"] = 0.05
+    assert not check_entry(higher, summary)["ok"]  # 90 < 95
+    lower = {"key": "steps.t", "value": 0.01, "direction": "lower",
+             "rel_tol": 0.25}
+    assert check_entry(lower, summary)["ok"]  # 0.011 <= 0.0125
+    lower["rel_tol"] = 0.05
+    assert not check_entry(lower, summary)["ok"]
+    eq = {"key": "steps.zero", "value": 0.0, "direction": "equal",
+          "rel_tol": 0.0}
+    assert check_entry(eq, summary)["ok"]
+
+
+def test_summarize_json_covers_rendered_sections(tmp_path, capsys):
+    """Satellite: `summarize --json` is the machine face of the SAME
+    summary the table renders — every rendered section key exists in the
+    JSON (check/CI consume it)."""
+    from mgproto_tpu.cli.telemetry import main, render_table, summarize
+
+    d = _make_telemetry_dir(tmp_path)
+    main(["summarize", d, "--json"])
+    parsed = json.loads(capsys.readouterr().out)
+    summary = summarize(d)
+    for key in ("steps", "recompiles", "artifacts", "telemetry_dir"):
+        assert key in parsed and key in summary
+    # the table is a pure renderer over the same dict
+    assert render_table(summary)
+    # bare-dir back-compat still summarizes
+    main([d, "--json"])
+    assert json.loads(capsys.readouterr().out)["telemetry_dir"] == \
+        parsed["telemetry_dir"]
+
+
+# ----------------------------------------------------- latency-unit audit
+def test_metric_names_use_canonical_seconds():
+    """Satellite: one canonical time unit (seconds) for every registered
+    metric — no ms/us names, and any time-flavored name says so with a
+    `_seconds` (or explicit non-time `_fraction`/`_ratio`) suffix.
+    Conversion to ms happens only at render time (load_test's *_ms output
+    fields, summarize's formatting)."""
+    import re
+    import tempfile
+
+    from mgproto_tpu.serving.metrics import register_serving_metrics
+    from mgproto_tpu.telemetry.session import TelemetrySession
+
+    with tempfile.TemporaryDirectory() as tmp:
+        session = TelemetrySession(tmp, primary=True)
+        try:
+            register_serving_metrics(session.registry)
+            names = [m.name for m in session.registry.metrics()]
+        finally:
+            session.close()
+    assert names
+    for name in names:
+        assert not re.search(r"(_ms|_millis|_micros|_us)(_|$)", name), (
+            f"{name}: milliseconds/microseconds in a metric name — the "
+            "canonical unit is seconds; convert at render time"
+        )
+        if re.search(r"(time|latency|wait|duration)", name) and not \
+                name.endswith(("_fraction", "_ratio")):
+            assert "seconds" in name, (
+                f"{name}: time-valued metric must carry a _seconds suffix"
+            )
+
+
+def test_serving_dataclass_time_fields_are_seconds():
+    import dataclasses
+
+    from mgproto_tpu.serving.batcher import BatcherConfig
+    from mgproto_tpu.serving.response import ServeResponse
+
+    for cls in (BatcherConfig, ServeResponse):
+        for f in dataclasses.fields(cls):
+            if any(tok in f.name for tok in
+                   ("linger", "latency", "cost", "timeout", "deadline")):
+                assert f.name.endswith(("_s", "_seconds")) or \
+                    f.name in ("cost_ema_alpha",), (
+                        f"{cls.__name__}.{f.name}: time field without a "
+                        "seconds suffix"
+                    )
+
+
+# --------------------------------------------------------- registry lint
+def test_check_metric_registry_clean():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_metric_registry import offenders
+    finally:
+        sys.path.pop(0)
+    assert offenders(REPO) == []
+
+
+def test_check_metric_registry_detects_violation(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_metric_registry import offenders
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "mgproto_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "from mgproto_tpu.telemetry.registry import default_registry\n"
+        "def f():\n"
+        "    default_registry().counter('totally_unregistered_total').inc()\n"
+        "    default_registry().gauge(UNKNOWN_CONSTANT).set(1)\n"
+    )
+    found = offenders(str(tmp_path))
+    whys = " | ".join(w for _p, _l, w in found)
+    assert "totally_unregistered_total" in whys
+    assert "UNKNOWN_CONSTANT" in whys
+
+
+# -------------------------------------------------- load-test trace export
+@pytest.mark.serving
+def test_load_test_trace_acceptance(tmp_path):
+    """Acceptance: a load-test run exports per-request spans spanning
+    frontend -> batcher -> replica -> engine in a valid Chrome trace."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from load_test import run_load_test
+    finally:
+        sys.path.pop(0)
+    trace_path = str(tmp_path / "lt.json")
+    result = run_load_test(
+        phases=((0.5, 40.0),), kill_at=8, trace_out=trace_path,
+    )
+    assert result["overall"]["zero_dropped"]
+    by_name = result["trace"]["spans_by_name"]
+    for stage in ("frontend", "batcher", "replica", "engine", "dispatch"):
+        assert by_name.get(stage, 0) > 0, (stage, by_name)
+    assert by_name.get("replica_kill", 0) == 1
+    events = json.load(open(trace_path))["traceEvents"]
+    assert len(events) == result["trace"]["events"]
+    assert all({"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+               for e in events)
+    # determinism: same seed, same storm -> identical span census
+    result2 = run_load_test(
+        phases=((0.5, 40.0),), kill_at=8,
+        trace_out=str(tmp_path / "lt2.json"),
+    )
+    assert result2["trace"]["spans_by_name"] == by_name
+
+# ------------------------------------------------- post-review regressions
+def test_profiler_step_range_is_one_window(tmp_path):
+    """An explicit --profile_steps A:B range is ONE contiguous capture —
+    never fragmented into capture_steps-long pieces, never re-armed."""
+    w = ProfilerWindow(
+        str(tmp_path), steps=(2, 6), capture_steps=3, max_captures=2,
+        cost_provider=lambda: {"ok": True},
+    )
+    armed_at = []
+    for step in range(9):
+        w.on_step(0.01)
+        if w.armed:
+            armed_at.append(step)
+    assert len(w.captures) == 1  # one window for the whole range
+    assert w.captures[0]["reason"] == "steps"
+    assert armed_at == [2, 3, 4, 5]  # open across the range, closed at 6
+    # a bare step ('7' -> (7, 8)) captures exactly one step even with a
+    # longer anomaly capture_steps configured
+    w2 = ProfilerWindow(str(tmp_path / "one"), steps=(1, 2), capture_steps=5)
+    for step in range(4):
+        w2.on_step(0.01)
+        assert w2.armed == (step == 1)
+    assert len(w2.captures) == 1
+
+
+def test_reqtrace_cleared_context_uses_fallback():
+    """A dispatch context left by a pump that never reached on_dispatch
+    (breaker open, empty pop, device error) is cleared by the batcher's
+    finally, so a later context-less dispatch keeps its own timing."""
+    clock = FakeClock()
+    st = reqtrace.enable(clock=clock, tracer=Tracer())
+    reqtrace.dispatch_context("stale-replica", "bucket_full", 5.0)
+    reqtrace.clear_dispatch_context()
+    clock.t = 100.0
+    reqtrace.mint("r1", now=99.0)
+    reqtrace.on_enqueue("r1", 99.0)
+    reqtrace.on_dispatch(["r1"], bucket=4, fill=0.25, fallback_t0=99.5)
+    rec = st.pending["r1"]
+    assert rec.dispatch == 99.5  # the fallback, not the stale 5.0
+    assert rec.device_s == pytest.approx(0.5)
+    assert rec.replica == ""  # not the stale replica lane
+
+
+def test_reqtrace_pending_overflow_evicts_oldest(monkeypatch):
+    """Overflow drops the OLDEST pending record (a leak ages out), never
+    new traffic — tracing stays live in a long-lived serve process."""
+    monkeypatch.setattr(reqtrace, "_MAX_PENDING", 2)
+    clock = FakeClock()
+    st = reqtrace.enable(clock=clock)
+    reqtrace.mint("a")
+    reqtrace.mint("b")
+    reqtrace.mint("c")  # evicts "a"
+    assert set(st.pending) == {"b", "c"}
+    assert st.dropped == 1
+
+
+def test_serve_warmup_costs_written(tmp_path):
+    """--profile_warmup's off-TPU degrade: after warmup the capture dir
+    gains a cost_analysis.json with per-bucket XLA flops/bytes."""
+    from mgproto_tpu.cli.serve import _write_warmup_costs
+
+    engine = make_engine(FakeClock())
+    engine.warmup()
+    _write_warmup_costs(str(tmp_path), engine)
+    costs = json.load(open(tmp_path / "cost_analysis.json"))
+    assert costs["buckets"] == [1, 2, 4]
+    assert set(costs["programs"]) == {"b1", "b2", "b4"}
+    for p in costs["programs"].values():
+        assert "flops" in p and "bytes_accessed" in p
+    _write_warmup_costs("", engine)  # no capture dir: a clean no-op
+    _write_warmup_costs(str(tmp_path), None)
