@@ -195,6 +195,22 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="do not install SIGTERM/SIGINT graceful-preemption "
                         "handlers (default: installed; first signal "
                         "checkpoints + exits 0, second kills)")
+    # pod fault tolerance (ISSUE 9; README 'Pod fault tolerance')
+    p.add_argument("--barrier_timeout_s", type=float, default=300.0,
+                   help="multi-host failure agreement: host-side agreement "
+                        "collectives run through a heartbeat-file barrier "
+                        "over model_dir; a peer missing past this timeout "
+                        "makes survivors dump the flight recorder, write "
+                        "PEER_LOST.json and exit 75 so launch_pod.sh "
+                        "relaunches from the last committed checkpoint "
+                        "(<= 0 disables; single-process runs ignore it)")
+    p.add_argument("--ckpt_format", default="auto",
+                   choices=["auto", "sharded", "replicated"],
+                   help="checkpoint format: 'sharded' = coordinated "
+                        "per-host shard files + COMMIT marker (elastic "
+                        "restore onto any mesh), 'replicated' = the "
+                        "single-file orbax format funneled through host 0, "
+                        "'auto' = sharded when multi-host")
     p.add_argument("--profile_dir", default="",
                    help="write a jax.profiler trace of one epoch here")
     # performance observatory (obs/profiler.py): step-scoped capture

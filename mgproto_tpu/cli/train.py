@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import os
+import sys
 from typing import Optional
 
 import jax
@@ -105,6 +106,8 @@ def run_training(
     profile_steps: str = "",
     profile_on_anomaly: bool = False,
     profile_out: str = "",
+    barrier_timeout_s: float = 300.0,
+    ckpt_format: str = "auto",
 ):
     """Run the full schedule; returns (final_state, last_test_accuracy).
 
@@ -116,7 +119,16 @@ def run_training(
     optional resilience.ChaosState for fault-injection drills (its one-shot
     bookkeeping intentionally survives across invocations, so a resumed
     run does not re-inject). A preemption (signal or chaos) checkpoints and
-    returns early — check `resilience.get_handler().requested()`."""
+    returns early — check `resilience.get_handler().requested()`.
+
+    Pod fault tolerance (ISSUE 9): under multi-host, `barrier_timeout_s`
+    arms the guarded-barrier failure agreement over model_dir (a dead or
+    wedged peer raises `BarrierTimeoutError` out of here after survivors
+    write PEER_LOST.json and dump the flight recorder — main() turns that
+    into exit code `PEER_LOST_EXIT_CODE` for the launch_pod.sh relaunch
+    loop); `ckpt_format` picks the checkpoint protocol ('auto' = the
+    coordinated sharded format when multi-host, the replicated orbax format
+    otherwise)."""
     # resolve --resume FIRST: a typo'd path must fail fast, before any
     # data-pipeline or device work happens. 'auto' resumes only from
     # manifest-verified checkpoints (torn saves and .tmp dirs never qualify)
@@ -151,14 +163,42 @@ def run_training(
         )
 
     os.makedirs(cfg.model_dir, exist_ok=True)
-    log = Logger(os.path.join(cfg.model_dir, "train.log"))
+    from mgproto_tpu.parallel.multihost import (
+        PEER_LOST_FILE,
+        clear_barrier,
+        configure_barrier,
+        is_primary_host,
+    )
+
+    primary = is_primary_host()
+    multihost = jax.process_count() > 1
+    if primary:
+        # this incarnation owns the previous one's failure marker: a stale
+        # PEER_LOST.json would make the relaunch watchdog loop forever
+        try:
+            os.unlink(os.path.join(cfg.model_dir, PEER_LOST_FILE))
+        except OSError:
+            pass
+    if ckpt_format not in ("auto", "sharded", "replicated"):
+        raise ValueError(f"unknown ckpt_format {ckpt_format!r}")
+    ckpt_sharded = {"auto": None, "sharded": True, "replicated": False}[
+        ckpt_format
+    ]
+    # model_dir is SHARED under multi-host (the sharded checkpoint protocol
+    # requires it); run-wide artifacts are host-0's, so non-primary hosts
+    # write their log/metrics under a host-tagged name instead of
+    # interleaving into host 0's files (ISSUE 9 side-effects audit)
+    host_tag = "" if primary else f".h{jax.process_index()}"
+    log = Logger(os.path.join(cfg.model_dir, f"train.log{host_tag}"))
     if legacy_resume_note:
         log(legacy_resume_note)
     for note in adoption_notes:
         # adoption ran before the Logger existed; the overrides it made are
         # exactly the decisions a run's own log must record
         log(note)
-    metrics = MetricsWriter(os.path.join(cfg.model_dir, "metrics.jsonl"))
+    metrics = MetricsWriter(
+        os.path.join(cfg.model_dir, f"metrics.jsonl{host_tag}")
+    )
 
     # HBM-budget auto-tuner (perf/planner.py): pick the run's (batch,
     # remat, prefetch, augment, async_bank) from the compiled-module memory
@@ -217,6 +257,18 @@ def run_training(
     log(f"devices: {jax.device_count()}  mesh: {dict(trainer.mesh.shape)}")
     log(f"steps/epoch: {steps_per_epoch}")
 
+    # telemetry: registry + tracing spans + step/health monitors, sunk to
+    # <telemetry_dir> on host 0 only (see telemetry/session.py). Created
+    # BEFORE the restore below so restore-time events (elastic_restores_
+    # total) land in the registry this run actually sinks. The jit handles
+    # are watched through a provider because ShardedTrainer builds its
+    # sharded jits lazily.
+    telem = make_session(
+        telemetry_dir or os.path.join(cfg.model_dir, "telemetry"), telemetry
+    )
+    if telem:
+        telem.monitor.watch(lambda: trainer.jit_handles)
+
     # a restore target skips the pretrained trunk load (about to be overwritten)
     state = trainer.init_state(
         jax.random.PRNGKey(cfg.seed), for_restore=bool(resume_path)
@@ -228,6 +280,9 @@ def run_training(
         state = trainer.prepare(restore_checkpoint(resume_path, state))
         if meta.get("stage") == "prune":
             log(f"run already complete ({resume_path}); nothing to resume")
+            if telem:
+                telem.close()
+            clear_barrier()
             metrics.close()
             log.close()
             return state, float(meta.get("accuracy", 0.0))
@@ -244,7 +299,8 @@ def run_training(
         else:
             start_epoch = int(meta.get("epoch", -1)) + 1
             log(f"resumed {resume_path} -> epoch {start_epoch}")
-        preemption.clear_marker(cfg.model_dir)
+        if primary:  # run-wide marker: host 0's to clear (side-effects audit)
+            preemption.clear_marker(cfg.model_dir)
 
     img_dir = os.path.join(cfg.model_dir, "img")
     # persisted so eval/interpret adopt the training-time trunk numerics
@@ -268,15 +324,7 @@ def run_training(
     push_ds = push_loader.dataset
     accu = 0.0
 
-    # telemetry: registry + tracing spans + step/health monitors, sunk to
-    # <telemetry_dir> on host 0 only (see telemetry/session.py). The jit
-    # handles are watched through a provider because ShardedTrainer builds
-    # its sharded jits lazily.
-    telem = make_session(
-        telemetry_dir or os.path.join(cfg.model_dir, "telemetry"), telemetry
-    )
     if telem:
-        telem.monitor.watch(lambda: trainer.jit_handles)
         # run-config context next to the metric artifacts (summarize "meta")
         telem.write_meta({
             **run_meta,
@@ -333,8 +381,15 @@ def run_training(
     chaos_installed = chaos is not None
     if chaos_installed:
         prev_chaos = chaos_mod.set_active(chaos)
-    multihost = jax.process_count() > 1
 
+    if multihost and barrier_timeout_s and barrier_timeout_s > 0:
+        # failure agreement: host-side collectives (preemption/epoch sync,
+        # checkpoint commit) run through the guarded barrier from here on.
+        # Configured HERE, after every fallible setup step (flag
+        # validation, restore, autotune, pipeline build), so an exception
+        # on the way in can never leak a configured process-global guard —
+        # the try/finally below is the single owner of clear_barrier()
+        configure_barrier(cfg.model_dir, barrier_timeout_s)
     log("start training")
     preempted = False
     rollbacks = 0
@@ -358,7 +413,7 @@ def run_training(
                     train_loader, test_loader, push_loader, push_ds,
                     ood_loaders, log, metrics, telem, run_meta, img_dir,
                     render_push, target_accu, guard, skip_batches,
-                    window=window,
+                    window=window, ckpt_sharded=ckpt_sharded,
                 )
             except DivergenceError as e:
                 rollbacks += 1
@@ -410,11 +465,10 @@ def run_training(
                         "batch_in_epoch": guard.batches_done,
                         "reason": handler.reason or "",
                     },
+                    sharded=ckpt_sharded,
                 )
                 res_metrics.counter(res_metrics.PREEMPTION_SAVES).inc()
-                from mgproto_tpu.parallel.multihost import is_primary_host
-
-                if is_primary_host():
+                if primary:
                     preemption.write_marker(
                         cfg.model_dir, path, reason=handler.reason or "",
                         extra={"epoch": epoch,
@@ -437,7 +491,9 @@ def run_training(
 
             if telem:
                 telem.end_epoch(state, epoch=epoch, step=int(state.step))
-            if keep_last > 0:
+            if keep_last > 0 and primary:
+                # retention deletes from the SHARED model_dir: one deleter,
+                # or hosts race each other's rmtree (side-effects audit)
                 apply_retention(cfg.model_dir, keep_last, keep_best)
             epoch += 1
 
@@ -463,16 +519,21 @@ def run_training(
             )
             save_state_w_condition(
                 cfg.model_dir, state, last_epoch, "prune", accu, target_accu,
-                metadata=run_meta,
+                metadata=run_meta, sharded=ckpt_sharded,
             )
             log("training done")
-    except BaseException:
+    except BaseException as e:
         # unhandled crash (incl. the exhausted-rollback re-raise): the ring
         # of recent steps/events is the post-mortem — dump it before the
-        # exception propagates
-        recorder.maybe_dump("crash")
+        # exception propagates. A barrier timeout already dumped itself as
+        # "peer_lost" (parallel/multihost._on_barrier_timeout).
+        from mgproto_tpu.parallel.multihost import BarrierTimeoutError
+
+        if not isinstance(e, BarrierTimeoutError):
+            recorder.maybe_dump("crash")
         raise
     finally:
+        clear_barrier()
         if window is not None:
             window.close()  # never leave a device trace open
         set_recorder(prev_recorder)
@@ -493,7 +554,7 @@ def _run_epoch(
     cfg, trainer, state, epoch, start_epoch, profile_dir,
     train_loader, test_loader, push_loader, push_ds, ood_loaders,
     log, metrics, telem, run_meta, img_dir, render_push, target_accu,
-    guard=None, skip_batches=0, window=None,
+    guard=None, skip_batches=0, window=None, ckpt_sharded=None,
 ):
     """One epoch of the reference main.py flow (train / test / conditional
     push), under an `epoch` tracing span so the stage spans nest.
@@ -578,7 +639,7 @@ def _run_epoch(
         metrics.write(int(state.step), {"epoch": epoch, **test_results})
         save_state_w_condition(
             cfg.model_dir, state, epoch, "nopush", accu, target_accu,
-            metadata=run_meta,
+            metadata=run_meta, sharded=ckpt_sharded,
         )
 
         if epoch >= cfg.schedule.push_start and epoch in cfg.schedule.push_epochs():
@@ -599,7 +660,7 @@ def _run_epoch(
             )
             save_state_w_condition(
                 cfg.model_dir, state, epoch, "push", accu, target_accu,
-                metadata=run_meta,
+                metadata=run_meta, sharded=ckpt_sharded,
             )
 
     return state, accu
@@ -614,6 +675,14 @@ chaos-injection env knobs (fault drills; all off by default):
   MGPROTO_CHAOS_NAN_AT_STEP     NaN-poison the batch of this global step
   MGPROTO_CHAOS_PREEMPT_AT_STEP simulate SIGTERM at this global step
   MGPROTO_CHAOS_CKPT_FAILS      fail the first N checkpoint writes
+  MGPROTO_CHAOS_KILL_HOST_AT    this process DIES hard (os._exit) when the
+                                batch for this global step is drawn — pod
+                                host-crash drill (survivors must exit 75
+                                via the guarded-barrier timeout)
+  MGPROTO_CHAOS_WEDGE_HOST_AT   same, but the process HANGS (stuck host)
+  MGPROTO_CHAOS_HOST_INDEX      restrict kill/wedge to this
+                                jax.process_index() (-1: any process whose
+                                environment carries the knob)
 serving-side knobs (MGPROTO_CHAOS_SERVE_*): see `mgproto-serve --help`
 """
 
@@ -634,24 +703,42 @@ def main(argv: Optional[list] = None) -> None:
         preemption.install_handlers()
     chaos_plan = chaos_mod.plan_from_env()
     chaos_state = chaos_mod.ChaosState(chaos_plan) if chaos_plan else None
-    run_training(
-        cfg,
-        resume=args.resume,
-        profile_dir=args.profile_dir,
-        target_accu=args.target_accu,
-        telemetry_dir=args.telemetry_dir,
-        telemetry=not args.no_telemetry,
-        max_bad_steps=args.max_bad_steps,
-        divergence_check_every=args.divergence_check_every,
-        max_rollbacks=args.max_rollbacks,
-        keep_last=args.keep_last,
-        keep_best=args.keep_best,
-        chaos=chaos_state,
-        auto_tune=args.auto_tune,
-        profile_steps=args.profile_steps,
-        profile_on_anomaly=args.profile_on_anomaly,
-        profile_out=args.profile_out,
+    from mgproto_tpu.parallel.multihost import (
+        PEER_LOST_EXIT_CODE,
+        BarrierTimeoutError,
     )
+
+    try:
+        run_training(
+            cfg,
+            resume=args.resume,
+            profile_dir=args.profile_dir,
+            target_accu=args.target_accu,
+            telemetry_dir=args.telemetry_dir,
+            telemetry=not args.no_telemetry,
+            max_bad_steps=args.max_bad_steps,
+            divergence_check_every=args.divergence_check_every,
+            max_rollbacks=args.max_rollbacks,
+            keep_last=args.keep_last,
+            keep_best=args.keep_best,
+            chaos=chaos_state,
+            auto_tune=args.auto_tune,
+            profile_steps=args.profile_steps,
+            profile_on_anomaly=args.profile_on_anomaly,
+            profile_out=args.profile_out,
+            barrier_timeout_s=args.barrier_timeout_s,
+            ckpt_format=args.ckpt_format,
+        )
+    except BarrierTimeoutError as e:
+        # failure agreement: the marker + flight-recorder dump are already
+        # on disk. Exit HARD with the distinct status the pod launcher's
+        # watchdog answers with relaunch-from-last-commit — a graceful
+        # sys.exit would hang in jax.distributed's atexit teardown waiting
+        # for the very peer that just died.
+        sys.stderr.write(f"peer lost: {e}\n")
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(PEER_LOST_EXIT_CODE)
     # a preempted run exits 0: the scheduler sees a clean shutdown and the
     # marker file + checkpoint make the next invocation resume bit-exactly
 

@@ -630,6 +630,7 @@ class Trainer:
         it."""
         from mgproto_tpu.data.loader import device_prefetch
         from mgproto_tpu.obs.flightrec import record_event
+        from mgproto_tpu.parallel.multihost import heartbeat_tick
         from mgproto_tpu.telemetry.monitor import tree_transfer_bytes
 
         self.reset_bank_pipeline()
@@ -681,6 +682,11 @@ class Trainer:
                 "step", epoch=epoch, i=step_i,
                 seconds=round(step_s, 6), wait_s=round(wait_s, 6),
             )
+            # liveness signal for the guarded-barrier protocol: a peer that
+            # misses a barrier with a FRESH heartbeat is wedged mid-step,
+            # one with a stale heartbeat is dead. No-op unless a barrier
+            # guard is configured (multi-host runs with --barrier_timeout_s)
+            heartbeat_tick()
             step_i += 1
             if window is not None:
                 window.on_step(step_s, wait_fraction=wait_frac)

@@ -18,13 +18,31 @@ branches are exercised in CI by tests/test_multiprocess.py: two coordinated
 put_batch, fetch_replicated, a sharded train step, and the loader's
 shard_index>0 path end to end.
 
+Failure agreement (ISSUE 9): a bare collective DEADLOCKS every survivor
+when one peer dies or wedges — the canonical pod failure mode. The guarded
+barrier below (`configure_barrier` + `guarded_barrier`) wraps the host-side
+agreement points (`allgather_sum`/`any_across_hosts`, the epoch-end sync,
+the sharded-checkpoint commit) with a heartbeat-file/timeout protocol over
+the shared model_dir filesystem: every process touches a per-barrier file
+and polls for its peers; a peer missing past `timeout_s` makes survivors
+dump the flight recorder, write a PEER_LOST marker, and raise
+`BarrierTimeoutError`, which the train driver turns into a clean exit with
+`PEER_LOST_EXIT_CODE` — scripts/launch_pod.sh's watchdog loop answers that
+code (or the marker appearing on the shared FS) by relaunching everyone
+from the last committed checkpoint. Unconfigured (or single-process), every
+guard call is a no-op, so library users pay nothing.
+
 Reference: none — the reference is single-process (SURVEY.md §2.3); this is
 the scaffolding its NCCL/torch.distributed story never grew.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -52,29 +70,57 @@ def host_local_rows(arr: jax.Array) -> np.ndarray:
 
 def allgather_rows(x: np.ndarray) -> np.ndarray:
     """Concatenate equal-shaped per-process host arrays across all processes
-    (row-major in process order). Single process: identity."""
+    (row-major in process order). A host-side agreement collective (the
+    per-epoch eval/push gathers ride on it), so it is guarded like
+    `allgather_sum`: a dead peer surfaces as `BarrierTimeoutError` instead
+    of deadlocking every survivor in the bare collective. Single process:
+    identity."""
     if jax.process_count() == 1:
         return x
+    guarded_barrier("allgather_rows")
     from jax.experimental import multihost_utils
 
     stacked = multihost_utils.process_allgather(np.asarray(x))
     return np.concatenate(list(stacked), axis=0)
 
 
+def _f64_to_wire(x: float) -> np.ndarray:
+    """Encode a float64 scalar as its 8 raw bytes (uint8). The allgather
+    wire dtype is pinned to uint8 because `process_allgather` stages host
+    arrays through the device: under the default x32 mode a float64 array
+    silently downcasts to float32 on device, so large counters (image
+    totals past 2^24) lose exact integer precision. uint8 survives any
+    jax dtype policy bit-for-bit."""
+    return np.frombuffer(np.float64(x).tobytes(), dtype=np.uint8).copy()
+
+
+def _f64_from_wire(row: np.ndarray) -> float:
+    return float(np.frombuffer(
+        np.ascontiguousarray(row, dtype=np.uint8).tobytes(), np.float64
+    )[0])
+
+
 def allgather_sum(x: float) -> float:
-    """Sum a host-side scalar across processes. Single process: identity."""
+    """Sum a host-side scalar across processes (float64 end to end — the
+    wire is raw bytes, see `_f64_to_wire`). A host-side agreement
+    collective: when a barrier guard is configured it is guarded, so a dead
+    peer surfaces as `BarrierTimeoutError` instead of a deadlock. Single
+    process: identity."""
     if jax.process_count() == 1:
         return float(x)
+    guarded_barrier("allgather_sum")
     from jax.experimental import multihost_utils
 
-    return float(np.sum(multihost_utils.process_allgather(np.float64(x))))
+    stacked = np.asarray(multihost_utils.process_allgather(_f64_to_wire(x)))
+    return float(sum(_f64_from_wire(row) for row in stacked))
 
 
 def any_across_hosts(flag: bool) -> bool:
     """True when ANY process passes True — the preemption agreement: a
     SIGTERM lands on ONE host, but every host must stop after the SAME step
     or the next collective deadlocks. A collective itself (every process
-    must call it at the same cadence); single process: identity."""
+    must call it at the same cadence; guarded through `allgather_sum` when
+    a barrier guard is configured); single process: identity."""
     if jax.process_count() == 1:
         return bool(flag)
     return allgather_sum(1.0 if flag else 0.0) > 0.0
@@ -113,3 +159,272 @@ def fetch_replicated(tree: Any, mesh=None) -> Any:
             raise ValueError("fetch_replicated needs the mesh for sharded input")
         tree = _replicating_identity(mesh)(tree)
     return jax.device_get(tree)
+
+
+# --------------------------------------------------------------------------
+# Guarded barrier: failure agreement instead of deadlock (ISSUE 9 tentpole).
+# --------------------------------------------------------------------------
+
+PEER_LOST_FILE = "PEER_LOST.json"
+# the distinct exit status a survivor leaves with after writing the marker:
+# scripts/launch_pod.sh's watchdog loop treats it (or the marker file
+# appearing on the shared FS) as "relaunch everyone from the last commit".
+# 75 = EX_TEMPFAIL: the run is retryable, the state is safe on disk.
+PEER_LOST_EXIT_CODE = 75
+
+BARRIER_SUBDIR = ".barrier"
+_HEARTBEAT_PREFIX = "hb.h"
+
+
+class BarrierTimeoutError(RuntimeError):
+    """A peer missed a guarded barrier past the timeout (dead or wedged
+    host). Survivors have already dumped the flight recorder and written
+    the PEER_LOST marker; the driver should exit PEER_LOST_EXIT_CODE so the
+    pod launcher relaunches from the last committed checkpoint."""
+
+    def __init__(self, name: str, missing: List[int], timeout_s: float):
+        super().__init__(
+            f"barrier {name!r}: processes {missing} missing after "
+            f"{timeout_s:.1f}s (dead or wedged peer); survivors exit for "
+            "relaunch-from-last-commit"
+        )
+        self.name = name
+        self.missing = missing
+        self.timeout_s = timeout_s
+
+
+@dataclasses.dataclass
+class BarrierGuard:
+    """File-based barrier + heartbeat state over a shared directory.
+
+    Every process touches `<name>.<seq>.h<pid>` and polls until all
+    `num_processes` files of that (name, seq) exist; `seq` is a per-name
+    local counter, aligned across processes because the host loop is SPMD
+    (every process reaches every guarded call in the same order). Heartbeat
+    files (`hb.h<pid>`) are touched at step cadence by the training loop so
+    a timeout report can say how stale each missing peer is.
+
+    The barrier directory is namespaced by a per-incarnation session token
+    (see `configure_barrier`): a relaunch after a PEER_LOST exit must never
+    see the dead incarnation's barrier files — seq counters restart at 0,
+    so stale files would satisfy (or corrupt) the new run's barriers."""
+
+    barrier_dir: str
+    marker_dir: str
+    timeout_s: float
+    process_id: int
+    num_processes: int
+    poll_s: float = 0.05
+    heartbeat_min_interval_s: float = 0.5
+    _seq: Dict[str, int] = dataclasses.field(default_factory=dict)
+    _last_heartbeat: float = 0.0
+
+    def _file(self, name: str, seq: int, pid: int) -> str:
+        safe = "".join(c if (c.isalnum() or c in "._-") else "_" for c in name)
+        return os.path.join(
+            self.barrier_dir, f"{safe}.{seq:06d}.h{pid:05d}"
+        )
+
+
+_BARRIER: Optional[BarrierGuard] = None
+
+
+def _agree_session_token() -> str:
+    """A session token every live process agrees on: host 0's wall clock at
+    configure time, broadcast over the device collective (all processes are
+    alive at bring-up — that is when this runs). Namespacing the barrier
+    directory with it keeps a relaunch from reading the dead incarnation's
+    barrier files."""
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(
+        [time.time_ns() & 0x7FFFFFFFFFFFFFFF], dtype=np.int64
+    )
+    agreed = multihost_utils.broadcast_one_to_all(local)
+    return f"{int(agreed[0]):x}"
+
+
+def configure_barrier(
+    model_dir: str,
+    timeout_s: float,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    poll_s: float = 0.05,
+    session: Optional[str] = None,
+) -> Optional[BarrierGuard]:
+    """Install the process-global barrier guard over `model_dir` (which
+    multi-host training already requires to be a shared filesystem — the
+    checkpoints live there). `timeout_s <= 0` disables guarding (barriers
+    no-op; collectives run bare). `session` names this incarnation's
+    barrier subdirectory; by default a real multi-process run agrees on one
+    via a broadcast (tests simulating peers pass it explicitly). Returns
+    the installed guard (None when disabled)."""
+    global _BARRIER
+    if timeout_s is None or timeout_s <= 0:
+        _BARRIER = None
+        return None
+    if session is None:
+        # MGPROTO_BARRIER_SESSION: a launcher-minted shared incarnation id
+        # (the CPU pod harness; a k8s job uid) — skips the bring-up
+        # broadcast entirely
+        session = os.environ.get("MGPROTO_BARRIER_SESSION") or (
+            _agree_session_token()
+            if process_id is None and jax.process_count() > 1
+            else "s0"
+        )
+    guard = BarrierGuard(
+        barrier_dir=os.path.join(model_dir, BARRIER_SUBDIR, session),
+        marker_dir=model_dir,
+        timeout_s=float(timeout_s),
+        process_id=(
+            jax.process_index() if process_id is None else int(process_id)
+        ),
+        num_processes=(
+            jax.process_count() if num_processes is None else int(num_processes)
+        ),
+        poll_s=poll_s,
+    )
+    os.makedirs(guard.barrier_dir, exist_ok=True)
+    _BARRIER = guard
+    return guard
+
+
+def barrier_guard() -> Optional[BarrierGuard]:
+    return _BARRIER
+
+
+def clear_barrier() -> None:
+    """Uninstall the guard (run_training's finally block)."""
+    global _BARRIER
+    _BARRIER = None
+
+
+def heartbeat_tick() -> None:
+    """Touch this process's heartbeat file (rate-limited). Called from the
+    train-step loop and on barrier entry, so a peer's heartbeat age in the
+    PEER_LOST diagnosis records WHEN it last made host-loop progress: an
+    age near the barrier wait means it was alive until moments before the
+    timeout (died or wedged mid-step just now), a much older age means it
+    stopped long before, and None means it never reached the loop (lost at
+    bring-up). It cannot distinguish dead from wedged — a wedged host's
+    loop stops ticking exactly like a dead one's. No-op unless a guard is
+    configured."""
+    g = _BARRIER
+    if g is None:
+        return
+    now = time.monotonic()
+    if now - g._last_heartbeat < g.heartbeat_min_interval_s:
+        return
+    g._last_heartbeat = now
+    path = os.path.join(
+        g.barrier_dir, f"{_HEARTBEAT_PREFIX}{g.process_id:05d}"
+    )
+    try:
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+    except OSError:
+        pass  # liveness signal is best-effort; never fail a step over it
+
+
+def peer_heartbeat_ages() -> Dict[int, Optional[float]]:
+    """Seconds since each peer's last heartbeat (None = never seen).
+    Diagnostic payload for the PEER_LOST marker."""
+    g = _BARRIER
+    if g is None:
+        return {}
+    ages: Dict[int, Optional[float]] = {}
+    now = time.time()
+    for pid in range(g.num_processes):
+        path = os.path.join(g.barrier_dir, f"{_HEARTBEAT_PREFIX}{pid:05d}")
+        try:
+            ages[pid] = max(0.0, now - os.path.getmtime(path))
+        except OSError:
+            ages[pid] = None
+    return ages
+
+
+def _on_barrier_timeout(g: BarrierGuard, name: str, missing: List[int]):
+    """Survivor path: marker + flight-recorder dump + counter, then raise.
+    Imports are local so this module stays cheap for non-failure paths."""
+    from mgproto_tpu.obs.flightrec import get_recorder, record_event
+    from mgproto_tpu.resilience import metrics as _m
+
+    ages = peer_heartbeat_ages()
+    _m.counter(_m.MISSED_BARRIERS).inc(barrier=name)
+    _m.counter(_m.PEER_LOST).inc()
+    record_event(
+        "barrier_timeout", barrier=name, missing=missing,
+        heartbeat_ages={str(k): v for k, v in ages.items()},
+    )
+    marker = os.path.join(g.marker_dir, PEER_LOST_FILE)
+    payload = {
+        "barrier": name,
+        "missing_processes": missing,
+        "survivor": g.process_id,
+        "timeout_s": g.timeout_s,
+        "heartbeat_ages_s": {str(k): v for k, v in ages.items()},
+        "time": time.time(),
+        "exit_code": PEER_LOST_EXIT_CODE,
+    }
+    try:
+        tmp = marker + f".tmp{g.process_id}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, marker)
+    except OSError:
+        pass  # the raise below still carries the diagnosis
+    get_recorder().maybe_dump("peer_lost")
+    raise BarrierTimeoutError(name, missing, g.timeout_s)
+
+
+def guarded_barrier(name: str) -> None:
+    """Block until every process reaches this named barrier, or raise
+    `BarrierTimeoutError` after `timeout_s` listing the missing peers.
+    No-op when unconfigured or effectively single-process. Must be called
+    in the same order by every process (SPMD host loop) — same contract as
+    the collectives it protects."""
+    g = _BARRIER
+    if g is None or g.num_processes <= 1:
+        return
+    seq = g._seq.get(name, 0)
+    g._seq[name] = seq + 1
+    heartbeat_tick()
+    mine = g._file(name, seq, g.process_id)
+    with open(mine, "w") as f:
+        f.write(str(time.time()))
+    deadline = time.monotonic() + g.timeout_s
+    while True:
+        missing = [
+            pid for pid in range(g.num_processes)
+            if not os.path.exists(g._file(name, seq, pid))
+        ]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            _on_barrier_timeout(g, name, missing)
+        time.sleep(g.poll_s)
+    # barrier `seq` completed globally: every peer has SEEN all files of
+    # this seq, so our own files from earlier seqs can never be awaited
+    # again — reap them to bound the shared directory's growth
+    for old in range(max(0, seq - 2), seq):
+        try:
+            os.unlink(g._file(name, old, g.process_id))
+        except OSError:
+            pass
+
+
+def checkpoint_barrier(tag: str) -> None:
+    """Cross-host agreement point of the sharded checkpoint protocol: all
+    shard files must be visible on the shared FS before host 0 commits, and
+    no host may proceed past the commit before it exists. Guarded (timeout
+    -> failure agreement) when a barrier guard is configured; otherwise a
+    bare `sync_global_devices` — a save must still be coordinated even when
+    the operator disabled the timeout protocol. Single process: no-op."""
+    if jax.process_count() <= 1:
+        return
+    if _BARRIER is not None:
+        guarded_barrier(f"ckpt.{tag}")
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(f"mgproto_ckpt_{tag}")

@@ -48,6 +48,15 @@ MGPROTO_CHAOS_SERVE_WEDGE_AT (admitted-request indices that kill/wedge the
 replica the request routes to, one-shot each) and
 MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT (poison the first N hot-swap
 attempts with a trust-stripped artifact; the swap must fail closed).
+
+Multi-host pod faults (ISSUE 9): MGPROTO_CHAOS_KILL_HOST_AT /
+MGPROTO_CHAOS_WEDGE_HOST_AT make one PROCESS die hard (os._exit) or hang
+when the batch for that global step is drawn — the canonical pod failures
+the guarded barrier (parallel/multihost.py) must answer with failure
+agreement instead of deadlock; MGPROTO_CHAOS_HOST_INDEX targets a specific
+jax.process_index() (-1 = any process whose environment carries the knob —
+the two-process harness sets it on the victim only). One-shot each, hooked
+in `resilience.guard.EpochGuard.wrap_batches`.
 """
 
 from __future__ import annotations
@@ -62,6 +71,12 @@ import numpy as np
 
 class ChaosError(IOError):
     """The injected fault type (an IOError so real-IO retry paths fire)."""
+
+
+# the status a chaos-killed process dies with (os._exit — no cleanup, like a
+# real crash). Distinct from PEER_LOST_EXIT_CODE: the launcher relaunches on
+# BOTH, but a post-mortem must tell the victim from the survivors.
+HOST_KILL_EXIT_CODE = 86
 
 
 @dataclasses.dataclass
@@ -103,6 +118,17 @@ class ChaosPlan:
     # data is stripped (an operator pushing an uncalibrated artifact); the
     # swap MUST reject it fail-closed while the old model keeps serving
     serve_swap_bad_artifact: int = 0
+    # multi-host pod faults (ISSUE 9): when the batch for this global step
+    # is drawn, the targeted process DIES hard (os._exit — a host crash) or
+    # WEDGES (hangs mid-loop — a stuck host). Survivors must reach failure
+    # agreement through the guarded barrier (parallel/multihost.py) instead
+    # of deadlocking in the next collective. One-shot each.
+    kill_host_at: Optional[int] = None
+    wedge_host_at: Optional[int] = None
+    # which jax.process_index() the kill/wedge targets; -1 = any process
+    # whose env carries the knob (the two-process harness sets the knob in
+    # the victim's environment only)
+    host_index: int = -1
 
     def any_active(self) -> bool:
         return (
@@ -117,6 +143,8 @@ class ChaosPlan:
             or self.serve_replica_kill_at is not None
             or self.serve_wedge_at is not None
             or self.serve_swap_bad_artifact > 0
+            or self.kill_host_at is not None
+            or self.wedge_host_at is not None
         )
 
 
@@ -140,6 +168,8 @@ class ChaosState:
         self._replica_kill_fired = False
         self._wedge_fired = False
         self._bad_swaps_left = int(plan.serve_swap_bad_artifact)
+        self._host_kill_fired = False
+        self._host_wedge_fired = False
 
     def _count(self, kind: str) -> None:
         from mgproto_tpu.obs.flightrec import record_event
@@ -298,6 +328,40 @@ class ChaosState:
         self._count("serve_device_error")
         return True
 
+    # ------------------------------------------------------- multi-host faults
+    def _host_fault_due(
+        self, fired_attr: str, at: Optional[int], global_step: int,
+        process_index: int, kind: str,
+    ) -> bool:
+        if at is None:
+            return False
+        if self.plan.host_index >= 0 and process_index != self.plan.host_index:
+            return False
+        with self._lock:
+            if getattr(self, fired_attr) or int(global_step) < int(at):
+                return False
+            setattr(self, fired_attr, True)
+        self._count(kind)
+        return True
+
+    def host_kill_due(self, global_step: int, process_index: int) -> bool:
+        """True exactly once, on the targeted process, when the batch for
+        `kill_host_at` is drawn: the caller (resilience.guard) hard-exits —
+        a simulated host crash mid-pod. Survivors reach failure agreement
+        via the guarded barrier's timeout."""
+        return self._host_fault_due(
+            "_host_kill_fired", self.plan.kill_host_at, global_step,
+            process_index, "host_kill",
+        )
+
+    def host_wedge_due(self, global_step: int, process_index: int) -> bool:
+        """Same, but the process WEDGES (hangs without exiting) — a stuck
+        host whose heartbeat goes stale while the barrier times out."""
+        return self._host_fault_due(
+            "_host_wedge_fired", self.plan.wedge_host_at, global_step,
+            process_index, "host_wedge",
+        )
+
     # ---------------------------------------------------------- checkpoint IO
     def checkpoint_should_fail(self) -> bool:
         with self._lock:
@@ -373,5 +437,8 @@ def plan_from_env(environ=None) -> Optional[ChaosPlan]:
         serve_swap_bad_artifact=_get(
             "MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT", int, 0
         ),
+        kill_host_at=_get("MGPROTO_CHAOS_KILL_HOST_AT", int, None),
+        wedge_host_at=_get("MGPROTO_CHAOS_WEDGE_HOST_AT", int, None),
+        host_index=_get("MGPROTO_CHAOS_HOST_INDEX", int, -1),
     )
     return plan if plan.any_active() else None

@@ -116,6 +116,25 @@ class EpochGuard:
         def _gen():
             for i, batch in enumerate(batches):
                 global_step = self._base_step + i
+                # multi-host pod faults (ISSUE 9): the victim process dies
+                # or wedges HERE, before the batch reaches the device, so
+                # survivors' next guarded collective times out instead of a
+                # device collective deadlocking (the barrier is host-side;
+                # see EpochGuard.after_step's check ordering)
+                pid = jax.process_index()
+                if self.chaos.host_kill_due(global_step, pid):
+                    import os
+
+                    from mgproto_tpu.resilience.chaos import (
+                        HOST_KILL_EXIT_CODE,
+                    )
+
+                    os._exit(HOST_KILL_EXIT_CODE)  # a crash, not a shutdown
+                if self.chaos.host_wedge_due(global_step, pid):
+                    import time
+
+                    while True:  # a stuck host: alive, silent, not stepping
+                        time.sleep(3600)
                 if self.chaos.preempt_due(global_step) and (
                     self.preemption is not None
                 ):
@@ -137,10 +156,26 @@ class EpochGuard:
         self._bad_total = self._bad_total + nf
 
         if self._steps % self.check_every == 0:
-            self._poll_streak()
-            if self._check_preempt():
-                self.preempted = True
-                return True
+            if self.multihost:
+                # ORDER is load-bearing under multi-host: the preemption
+                # agreement routes through the guarded barrier (pure
+                # host-side file IO that can TIME OUT on a dead peer),
+                # while the streak poll device_gets a step output — which,
+                # with a peer gone, blocks in the step's cross-host
+                # collective forever. Checking agreement first gives the
+                # barrier its chance to convert a dead/wedged peer into
+                # BarrierTimeoutError before anything syncs the device.
+                if self._check_preempt():
+                    self.preempted = True
+                    return True
+                self._poll_streak()
+            else:
+                # single host: divergence takes precedence over preemption
+                # (a rollback anchors first; the flag survives the replay)
+                self._poll_streak()
+                if self._check_preempt():
+                    self.preempted = True
+                    return True
         elif self.preemption is not None and not self.multihost:
             # single-host preemption costs nothing to check every step
             if self.preemption.requested():
@@ -159,9 +194,17 @@ class EpochGuard:
         if self._bad_total is None:
             return 0
         if not self.preempted:
-            self._poll_streak()
-            if self._check_preempt():
-                self.preempted = True
+            if self.multihost:
+                # agreement before device sync, as in after_step: the
+                # barrier must get its timeout chance before _poll_streak/
+                # _flush_bad block on a collective a dead peer never joins
+                if self._check_preempt():
+                    self.preempted = True
+                self._poll_streak()
+            else:
+                self._poll_streak()
+                if self._check_preempt():
+                    self.preempted = True
         return self._flush_bad()
 
     # ------------------------------------------------------------- internals

@@ -24,6 +24,9 @@ ROLLBACKS = "train_rollbacks_total"
 PREEMPTION_SAVES = "preemption_saves_total"
 CKPT_WRITE_FAILURES = "checkpoint_write_failures_total"
 CHAOS_INJECTIONS = "chaos_injections_total"
+MISSED_BARRIERS = "missed_barriers_total"
+PEER_LOST = "peer_lost_total"
+ELASTIC_RESTORES = "elastic_restores_total"
 
 HELP = {
     RETRIES: "retry attempts by scope (loader/checkpoint/distributed_init)",
@@ -34,6 +37,9 @@ HELP = {
     PREEMPTION_SAVES: "preemption-triggered checkpoint saves",
     CKPT_WRITE_FAILURES: "failed checkpoint write attempts (retried)",
     CHAOS_INJECTIONS: "faults injected by the chaos harness, by kind",
+    MISSED_BARRIERS: "guarded barriers a peer missed past the timeout, by barrier",
+    PEER_LOST: "survivor exits after barrier-timeout failure agreement",
+    ELASTIC_RESTORES: "sharded restores onto a different chip/host count than the save",
 }
 
 ALL_COUNTERS = tuple(HELP)

@@ -43,6 +43,7 @@ def retry_call(
     jitter: float = 0.5,
     deadline_s: Optional[float] = None,
     retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    no_retry_on: Tuple[Type[BaseException], ...] = (),
     scope: str = "generic",
     on_retry: Optional[Callable] = None,
     sleep: Callable[[float], None] = time.sleep,
@@ -53,7 +54,11 @@ def retry_call(
     `retry_on` with exponential backoff (base_delay * 2^k, capped at
     max_delay, jittered). `deadline_s` bounds TOTAL wall time: a retry whose
     backoff would land past the deadline re-raises instead of sleeping.
-    `on_retry(attempt, exc, delay)` observes each performed retry."""
+    `no_retry_on` carves exceptions OUT of `retry_on` — failures that
+    retrying can only make worse (a barrier timeout already burned its full
+    window reaching failure agreement; re-running it would stall the exit
+    the pod launcher is waiting on). `on_retry(attempt, exc, delay)`
+    observes each performed retry."""
     start = time.monotonic()
     delays = backoff_delays(retries, base_delay, max_delay, jitter, rng=rng)
     attempt = 0
@@ -61,6 +66,8 @@ def retry_call(
         try:
             return fn(*args, **kwargs)
         except retry_on as e:
+            if no_retry_on and isinstance(e, no_retry_on):
+                raise
             attempt += 1
             if attempt > retries:
                 raise
@@ -84,6 +91,7 @@ def retryable(
     jitter: float = 0.5,
     deadline_s: Optional[float] = None,
     retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    no_retry_on: Tuple[Type[BaseException], ...] = (),
     scope: str = "generic",
     on_retry: Optional[Callable] = None,
     sleep: Callable[[float], None] = time.sleep,
@@ -102,6 +110,7 @@ def retryable(
                 jitter=jitter,
                 deadline_s=deadline_s,
                 retry_on=retry_on,
+                no_retry_on=no_retry_on,
                 scope=scope,
                 on_retry=on_retry,
                 sleep=sleep,

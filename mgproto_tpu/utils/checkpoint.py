@@ -21,6 +21,22 @@ with a readable diff, not an orbax stack trace) and against the restored
 step AFTER. Writes retry through `resilience.retry` (transient FS errors on
 preemptible fleets), and `apply_retention` keeps the last N + best-accuracy
 checkpoints so long runs don't fill the disk.
+
+Sharded coordinated checkpoints (ISSUE 9 tentpole): the replicated format
+above funnels the whole state through one host — a bandwidth wall and a
+single point of failure at pod scale. With `sharded=True` (the multi-host
+default; `--ckpt_format` is the escape hatch) every process writes ONLY the
+array shards it owns (`Shard.replica_id == 0` dedupes replicated leaves) as
+`shard_<pid>.npz` + `shard_<pid>.idx.json` into the checkpoint directory, a
+cross-host barrier (`parallel.multihost.checkpoint_barrier`) confirms all
+hosts finished, and host 0 alone publishes the global COMMIT marker — the
+one and only publish point. Every listing here treats a sharded directory
+without COMMIT as ABSENT, so a crash at any mid-save moment can never
+produce a half-checkpoint that `--resume auto` would trust. Restore is
+ELASTIC: shards are reassembled per leaf on the host and placed against the
+RESTORE TARGET's shardings (`jax.make_array_from_callback`), so a
+checkpoint committed on N chips restores bit-exactly onto an M-chip mesh
+(counted in `elastic_restores_total` when N != M).
 """
 
 from __future__ import annotations
@@ -29,7 +45,7 @@ import json
 import os
 import re
 import shutil
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
@@ -38,6 +54,9 @@ _NAME_RE = re.compile(r"^(\d+)([a-z_]+)(\d+\.\d+)$")
 MANIFEST_FILE = "mgproto_manifest.json"
 MANIFEST_FORMAT = 1
 TMP_SUFFIX = ".tmp"
+COMMIT_FILE = "COMMIT"
+_SHARD_NPZ = "shard_{pid:05d}.npz"
+_SHARD_IDX = "shard_{pid:05d}.idx.json"
 
 
 def _checkpointer():
@@ -85,6 +104,47 @@ def _tree_manifest(host_state: Any) -> dict:
     }
 
 
+def _tree_manifest_meta(state: Any) -> dict:
+    """Sharded-save manifest: same schema as `_tree_manifest` but built from
+    leaf METADATA only — at pod scale the leaves are not fully addressable
+    and must never be materialized on one host. Records the saving mesh's
+    size so an elastic restore can tell it changed."""
+    import numpy as np
+
+    leaves = []
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:  # plain python scalar leaf; NEVER asarray a jax
+            dtype = np.asarray(leaf).dtype  # Array here — not addressable
+        leaves.append({
+            "path": jax.tree_util.keystr(keypath),
+            "shape": list(shape),
+            "dtype": str(dtype),
+        })
+    step = getattr(state, "step", None)
+    return {
+        "format": MANIFEST_FORMAT,
+        "sharded": True,
+        "num_hosts": jax.process_count(),
+        "num_devices": jax.device_count(),
+        "num_leaves": len(leaves),
+        # step is replicated, so its addressable shard exists on every host
+        "step": None if step is None else _scalar_value(step),
+        "leaves": leaves,
+    }
+
+
+def _scalar_value(leaf: Any) -> int:
+    """A replicated scalar's host value, read from a LOCAL shard — a plain
+    device_get of a global array spanning other hosts' devices raises."""
+    import numpy as np
+
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        return int(np.asarray(leaf.addressable_shards[0].data))
+    return int(jax.device_get(leaf))
+
+
 def load_manifest(path: str) -> Optional[dict]:
     """The checkpoint's manifest, or None when absent (pre-manifest save).
     Raises CheckpointIntegrityError on an unreadable/wrong-format manifest
@@ -114,8 +174,10 @@ def _verify_manifest(manifest: dict, target: Any, path: str) -> None:
     want = {}
     for keypath, leaf in jax.tree_util.tree_flatten_with_path(target)[0]:
         shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
-        dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
-        want[jax.tree_util.keystr(keypath)] = (shape, dtype)
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:  # lazy: asarray would fetch a global jax.Array
+            dtype = np.asarray(leaf).dtype
+        want[jax.tree_util.keystr(keypath)] = (shape, str(dtype))
     got = {e["path"]: (tuple(e["shape"]), e["dtype"])
            for e in manifest["leaves"]}
     if got == want:
@@ -138,51 +200,477 @@ def _verify_manifest(manifest: dict, target: Any, path: str) -> None:
     )
 
 
+def _host_chunks(leaf: Any):
+    """The (global_index, host_array) chunks THIS process must persist for
+    one leaf. jax Arrays contribute exactly their `replica_id == 0`
+    addressable shards — across all processes that is a non-overlapping
+    exact cover of the global array, so replicated leaves are written once
+    (by whichever host owns replica 0) and sharded leaves are written where
+    they live. Plain host leaves are written whole by the primary host."""
+    import numpy as np
+
+    if isinstance(leaf, jax.Array):
+        for s in leaf.addressable_shards:
+            if s.replica_id == 0:
+                yield s.index, np.asarray(s.data)
+        return
+    from mgproto_tpu.parallel.multihost import is_primary_host
+
+    if is_primary_host():
+        arr = np.asarray(leaf)
+        yield tuple(slice(None) for _ in arr.shape), arr
+
+
+def _index_to_json(index, shape) -> list:
+    """A shard's global index as [[start, stop], ...] (per dimension)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, _ = sl.indices(int(dim))
+        out.append([int(start), int(stop)])
+    return out
+
+
+def _index_from_json(spans) -> tuple:
+    return tuple(slice(int(a), int(b)) for a, b in spans)
+
+
+def _spans_intersect(a, b) -> bool:
+    """Whether two [(start, stop), ...] rectangles overlap (per-dim open
+    interval test; scalars — empty span tuples — always intersect)."""
+    return all(s1 < e2 and s2 < e1 for (s1, e1), (s2, e2) in zip(a, b))
+
+
+def _write_host_shards(path: str, state: Any, pid: int) -> None:
+    """Persist this process's chunks of every leaf as one npz + one index
+    sidecar, each atomic (tmp+rename), the sidecar LAST — restores iterate
+    sidecars, so a torn npz-without-sidecar is invisible."""
+    import numpy as np
+
+    arrays: Dict[str, Any] = {}
+    chunks = []
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    for leaf_i, (_keypath, leaf) in enumerate(flat):
+        for chunk_i, (index, data) in enumerate(_host_chunks(leaf)):
+            key = f"c{leaf_i}_{chunk_i}"
+            arrays[key] = data
+            chunks.append({
+                "leaf": leaf_i,
+                "key": key,
+                "index": _index_to_json(index, _global_shape(leaf)),
+            })
+    npz = os.path.join(path, _SHARD_NPZ.format(pid=pid))
+    idx = os.path.join(path, _SHARD_IDX.format(pid=pid))
+    with open(npz + TMP_SUFFIX, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(npz + TMP_SUFFIX, npz)
+    _atomic_json(idx, {"process": pid, "chunks": chunks})
+
+
+def _global_shape(leaf: Any):
+    import numpy as np
+
+    return tuple(getattr(leaf, "shape", np.shape(leaf)))
+
+
+def _atomic_json(path: str, payload: dict) -> None:
+    tmp = path + TMP_SUFFIX
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _save_sharded(
+    path: str, state: Any, name: str, metadata: Optional[dict]
+) -> None:
+    """One attempt of the coordinated sharded save protocol. Called in
+    lockstep by EVERY process (the barriers keep retries aligned). All
+    writes land in a `<name>.tmp` STAGING directory (invisible to every
+    listing), so overwriting an existing checkpoint of the same name —
+    repeated preempt saves of one epoch — never destroys the committed
+    original until its replacement is fully committed:
+
+      1. host 0 clears any stale staging directory at this name
+      2. barrier — all hosts see a clean staging dir
+      3. every host writes its shard npz + index sidecar into staging
+      4. barrier — all shard files visible on the shared FS
+      5. host 0 writes manifest + metadata, then the COMMIT marker, all
+         in staging (the chaos checkpoint-failure knob injects a
+         simulated crash just before the commit)
+      6. barrier, then host 0 alone SWAPS staging into place (removing
+         any previous same-name checkpoint at the last instant)
+      7. barrier, then EVERY host verifies from the shared FS that THIS
+         attempt published: staging gone AND COMMIT present (a stale
+         same-name checkpoint's COMMIT alone can't fake success — only
+         the swap removes staging), then one final barrier so no host
+         starts a retry (clearing staging) before every peer has read
+         the outcome — a failed commit raises on all hosts consistently,
+         never on host 0 alone
+    """
+    import time
+
+    from mgproto_tpu.parallel.multihost import (
+        checkpoint_barrier,
+        is_primary_host,
+    )
+    from mgproto_tpu.resilience.chaos import get_active
+
+    primary = is_primary_host()
+    staging = path + TMP_SUFFIX
+    if primary:
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging, exist_ok=True)
+    checkpoint_barrier(f"{name}.begin")
+    os.makedirs(staging, exist_ok=True)
+    _write_host_shards(staging, state, jax.process_index())
+    checkpoint_barrier(f"{name}.shards")
+    commit_error: Optional[Exception] = None
+    if primary:
+        try:
+            _atomic_json(os.path.join(staging, MANIFEST_FILE),
+                         _tree_manifest_meta(state))
+            if metadata is not None:
+                _atomic_json(
+                    os.path.join(staging, "mgproto_meta.json"), metadata
+                )
+            chaos = get_active()
+            if chaos is not None and chaos.checkpoint_should_fail():
+                # simulated crash after the shard writes, before the commit
+                raise IOError(
+                    f"chaos: injected checkpoint write failure ({name})"
+                )
+            _atomic_json(os.path.join(staging, COMMIT_FILE), {
+                "committed_at": time.time(),
+                "num_hosts": jax.process_count(),
+                "num_devices": jax.device_count(),
+            })
+        except Exception as e:  # join the barrier first; raise after
+            commit_error = e
+    checkpoint_barrier(f"{name}.commit")
+    if commit_error is None and primary:
+        try:
+            # the swap: the only moment the previous committed checkpoint
+            # of this name ceases to exist, microseconds before its fully
+            # committed replacement appears (two syscalls — the same window
+            # the replicated format's rename publish accepts)
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            os.rename(staging, path)
+        except Exception as e:  # join the publish barrier first — a swap
+            commit_error = e  # failure must not strand peers in it
+    checkpoint_barrier(f"{name}.publish")
+    # every host verifies from the SHARED FS, not from local exception
+    # state: when a same-name checkpoint was already committed by an
+    # earlier save (repeated preempt saves of one epoch), `path/COMMIT`
+    # alone cannot distinguish this attempt's commit from the stale one —
+    # but a failed attempt always leaves its staging directory behind (the
+    # swap is the only thing that removes it), so staging-present means
+    # this attempt did not publish. All hosts agree, so retry_call's next
+    # attempt re-enters in lockstep and the barriers stay aligned.
+    failure: Optional[Exception] = commit_error
+    if failure is None and (
+        os.path.isdir(staging)
+        or not os.path.exists(os.path.join(path, COMMIT_FILE))
+    ):
+        failure = IOError(
+            f"sharded checkpoint {path} was not committed by the primary "
+            "host; treating the save as failed on every host"
+        )
+    # second agreement point: nobody starts the next attempt (which clears
+    # staging, the failure signal above) until every host has finished
+    # reading this attempt's outcome
+    checkpoint_barrier(f"{name}.verified")
+    if failure is not None:
+        raise failure
+
+
+def _shard_sidecars(path: str) -> List[str]:
+    """This checkpoint directory's shard index sidecars, in process order."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    return sorted(
+        os.path.join(path, n) for n in names
+        if n.startswith("shard_") and n.endswith(".idx.json")
+    )
+
+
+def has_shard_files(path: str) -> bool:
+    """True when `path` holds per-host shard artifacts (a sharded-protocol
+    save, committed or not)."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return any(
+        n.startswith("shard_") and (n.endswith(".npz") or n.endswith(".idx.json"))
+        for n in names
+    )
+
+
+def is_committed(path: str) -> bool:
+    """True when the sharded protocol's COMMIT marker exists (irrelevant for
+    replicated-format saves, whose publish point is the directory rename)."""
+    return os.path.exists(os.path.join(path, COMMIT_FILE))
+
+
+def _restore_sharded(path: str, target: Any, manifest: dict) -> Any:
+    """Elastic restore of a committed sharded checkpoint onto `target`'s
+    topology. Each leaf is reassembled on the host from the saved chunk
+    cover (the shared FS holds all shard files) — but each process reads
+    ONLY the chunks intersecting its own addressable spans of the target —
+    then placed against the TARGET leaf's sharding via
+    `jax.make_array_from_callback`; the callback slices the assembled
+    array per addressable shard, so the checkpoint's device/host count
+    never constrains the restore mesh. An exact-cover check over the
+    needed region catches torn/missing chunks before anything is placed.
+    Counted in `elastic_restores_total` when the topology changed."""
+    import numpy as np
+
+    if not is_committed(path):
+        raise CheckpointIntegrityError(
+            f"sharded checkpoint {path} has no COMMIT marker (crashed "
+            "mid-save); it must not be restored"
+        )
+    flat, treedef = jax.tree_util.tree_flatten(target)
+    # the spans THIS process actually needs: the union of the target
+    # leaf's addressable shard indices (`make_array_from_callback` only
+    # ever asks for those). On an N-host pod each host then reads only the
+    # chunk bytes its own placement touches instead of N full copies of
+    # the checkpoint flowing through the shared FS — the single-host
+    # funnel the sharded format exists to avoid. Replicated leaves are
+    # needed whole everywhere; sharded leaves only where they will live.
+    needed: Dict[int, list] = {}
+    for leaf_i, leaf in enumerate(flat):
+        shape = _global_shape(leaf)
+        if isinstance(leaf, jax.Array):
+            needed[leaf_i] = [
+                sp for sp in {
+                    tuple(map(tuple, _index_to_json(s.index, shape)))
+                    for s in leaf.addressable_shards
+                }
+            ]
+        else:
+            needed[leaf_i] = [tuple((0, int(d)) for d in shape)]
+    # per-leaf chunk lists from every process's sidecar, intersected with
+    # the needed spans; an npz holding nothing this host needs is never
+    # opened, and npz zip members are read per-key
+    per_leaf: Dict[int, list] = {i: [] for i in range(len(flat))}
+    for sidecar in _shard_sidecars(path):
+        with open(sidecar) as f:
+            idx = json.load(f)
+        wanted = []
+        for chunk in idx["chunks"]:
+            leaf_i = int(chunk["leaf"])
+            spans = tuple((int(a), int(b)) for a, b in chunk["index"])
+            if any(_spans_intersect(spans, n) for n in needed[leaf_i]):
+                wanted.append(
+                    (leaf_i, chunk["key"], _index_from_json(chunk["index"]))
+                )
+        if not wanted:
+            continue
+        npz = np.load(sidecar[: -len(".idx.json")] + ".npz")
+        for leaf_i, key, index in wanted:
+            per_leaf[leaf_i].append((index, npz[key]))
+    restored = []
+    for leaf_i, leaf in enumerate(flat):
+        shape = _global_shape(leaf)
+        dtype = np.dtype(manifest["leaves"][leaf_i]["dtype"])
+        # one buffer PER NEEDED SPAN (the target's addressable shard
+        # rectangles), never the global array: host restore memory stays
+        # proportional to the host's own shards — allocating the full
+        # global leaf on every host would be the single-host funnel this
+        # format exists to avoid, and at bank scale would OOM every host
+        # simultaneously. Saved chunks never overlap (replica_id==0 is a
+        # partition of the global array), so per-span filled-element
+        # counting is an exact cover check over the needed region.
+        buffers: Dict[tuple, Any] = {}
+        filled: Dict[tuple, int] = {}
+        for span in needed[leaf_i]:
+            buffers[span] = np.empty([b - a for a, b in span], dtype)
+            filled[span] = 0
+        for index, data in per_leaf[leaf_i]:
+            cspan = tuple(
+                sl.indices(int(dim))[:2] for sl, dim in zip(index, shape)
+            )
+            for span in needed[leaf_i]:
+                if not _spans_intersect(cspan, span):
+                    continue
+                inter = tuple(
+                    (max(cs, ns), min(ce, ne))
+                    for (cs, ce), (ns, ne) in zip(cspan, span)
+                )
+                bsl = tuple(
+                    slice(a - ns, b - ns)
+                    for (a, b), (ns, _) in zip(inter, span)
+                )
+                csl = tuple(
+                    slice(a - cs, b - cs)
+                    for (a, b), (cs, _) in zip(inter, cspan)
+                )
+                buffers[span][bsl] = data[csl]
+                filled[span] += int(
+                    np.prod([b - a for a, b in inter], dtype=np.int64)
+                )
+        total_needed = got = 0
+        for span in needed[leaf_i]:
+            total_needed += int(
+                np.prod([b - a for a, b in span], dtype=np.int64)
+            )
+            got += filled[span]
+        if got != total_needed:
+            raise CheckpointIntegrityError(
+                f"sharded checkpoint {path}: leaf {leaf_i} "
+                f"({manifest['leaves'][leaf_i]['path']}) chunks cover "
+                f"{got} of {total_needed} needed elements"
+            )
+        if isinstance(leaf, jax.Array):
+            def _shard_data(idx, _b=buffers, _shape=shape):
+                # idx comes from the same sharding the needed spans were
+                # computed from, so normalization makes it an exact key
+                key = tuple(
+                    sl.indices(int(dim))[:2]
+                    for sl, dim in zip(idx, _shape)
+                )
+                return _b[key]
+
+            restored.append(jax.make_array_from_callback(
+                shape, leaf.sharding, _shard_data
+            ))
+        else:
+            restored.append(buffers[tuple((0, int(d)) for d in shape)])
+    saved_devices = int(manifest.get("num_devices", jax.device_count()))
+    saved_hosts = int(manifest.get("num_hosts", jax.process_count()))
+    if (saved_devices, saved_hosts) != (
+        jax.device_count(), jax.process_count()
+    ):
+        from mgproto_tpu.obs.flightrec import record_event
+        from mgproto_tpu.resilience import metrics as _m
+
+        _m.counter(_m.ELASTIC_RESTORES).inc()
+        record_event(
+            "elastic_restore", path=path,
+            saved_devices=saved_devices, saved_hosts=saved_hosts,
+            restore_devices=jax.device_count(),
+            restore_hosts=jax.process_count(),
+        )
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
 def save_checkpoint(
     ckpt_dir: str,
     state: Any,
     name: str,
     metadata: Optional[dict] = None,
     retries: int = 2,
+    sharded: Optional[bool] = None,
 ) -> str:
     """Write `state` (any pytree of arrays) to `ckpt_dir/name`, atomically.
 
-    The pytree, its integrity manifest, and any metadata all land in
-    `<name>.tmp` first; the final rename is the publish point, so a kill at
-    ANY earlier moment leaves only a `.tmp` directory that every listing
-    here skips. Failed attempts (counted in
-    `checkpoint_write_failures_total`) are retried with backoff."""
+    `sharded=None` resolves by process count: multi-host runs use the
+    coordinated per-host shard protocol (`_save_sharded` — COMMIT marker is
+    the publish point), single-process runs the replicated orbax format
+    (tmp+rename is the publish point). Explicit True/False is always
+    honored (`--ckpt_format`). Either way a kill at ANY mid-save moment
+    leaves nothing any listing here trusts. Failed attempts (counted in
+    `checkpoint_write_failures_total`) are retried with backoff — under the
+    sharded protocol every process retries in lockstep, so the barriers
+    stay aligned."""
     from mgproto_tpu.resilience import metrics as _m
     from mgproto_tpu.resilience.chaos import get_active
     from mgproto_tpu.resilience.retry import retry_call
 
+    if sharded is None:
+        sharded = jax.process_count() > 1
     path = os.path.abspath(os.path.join(ckpt_dir, name))
     tmp = path + TMP_SUFFIX
 
-    def _write() -> None:
+    def _write_sharded() -> None:
         try:
-            if os.path.isdir(tmp):
-                shutil.rmtree(tmp)
-            host_state = jax.device_get(state)
-            _checkpointer().save(tmp, host_state, force=True)
-            with open(os.path.join(tmp, MANIFEST_FILE), "w") as f:
-                json.dump(_tree_manifest(host_state), f)
-            if metadata is not None:
-                with open(os.path.join(tmp, "mgproto_meta.json"), "w") as f:
-                    json.dump(metadata, f)
-            chaos = get_active()
-            if chaos is not None and chaos.checkpoint_should_fail():
-                # simulated kill between tmp write and publish rename
-                raise IOError(f"chaos: injected checkpoint write failure ({name})")
-            if os.path.isdir(path):
-                shutil.rmtree(path)  # force=True overwrite semantics
-            os.rename(tmp, path)
+            _save_sharded(path, state, name, metadata)
         except Exception:
             _m.counter(_m.CKPT_WRITE_FAILURES).inc()
             raise
 
-    retry_call(_write, retries=retries, base_delay=0.1, max_delay=2.0,
-               scope="checkpoint")
+    def _write() -> None:
+        try:
+            from mgproto_tpu.parallel.multihost import (
+                checkpoint_barrier,
+                is_primary_host,
+            )
+
+            # replicated escape hatch under multi-host: ONE writer (the
+            # state must be fully replicated to be addressable on host 0);
+            # every host joins the publish barrier, then verifies the
+            # rename landed — a primary-side failure raises on ALL hosts,
+            # so retry_call's attempts stay in lockstep and the barriers
+            # aligned (same shape as the sharded commit step)
+            write_error: Optional[Exception] = None
+            if is_primary_host():
+                try:
+                    if os.path.isdir(tmp):
+                        shutil.rmtree(tmp)
+                    host_state = jax.device_get(state)
+                    _checkpointer().save(tmp, host_state, force=True)
+                    with open(os.path.join(tmp, MANIFEST_FILE), "w") as f:
+                        json.dump(_tree_manifest(host_state), f)
+                    if metadata is not None:
+                        with open(
+                            os.path.join(tmp, "mgproto_meta.json"), "w"
+                        ) as f:
+                            json.dump(metadata, f)
+                    chaos = get_active()
+                    if chaos is not None and chaos.checkpoint_should_fail():
+                        # simulated kill between tmp write and publish rename
+                        raise IOError(
+                            f"chaos: injected checkpoint write failure "
+                            f"({name})"
+                        )
+                    if os.path.isdir(path):
+                        shutil.rmtree(path)  # force=True overwrite semantics
+                    os.rename(tmp, path)
+                except Exception as e:  # join the barrier first; raise after
+                    write_error = e
+                    try:
+                        # the tmp dir doubles as the cross-host failure
+                        # signal: with a stale same-name checkpoint already
+                        # at `path`, peers cannot tell this attempt's
+                        # publish from the old one — tmp-present can. A
+                        # successful rename removed it; guarantee it exists
+                        # on any failure (orbax may fail before creating
+                        # it). If even this write fails, peers fall back to
+                        # the barrier timeout.
+                        os.makedirs(tmp, exist_ok=True)
+                    except OSError:
+                        pass
+            checkpoint_barrier(f"{name}.publish")
+            failure: Optional[Exception] = write_error
+            if failure is None and (
+                os.path.isdir(tmp) or not os.path.isdir(path)
+            ):
+                failure = IOError(
+                    f"checkpoint {path} was not published by the primary "
+                    "host; treating the save as failed on every host"
+                )
+            # agreement before retry: the next attempt's rmtree(tmp) clears
+            # the failure signal peers just read
+            checkpoint_barrier(f"{name}.verified")
+            if failure is not None:
+                raise failure
+        except Exception:
+            _m.counter(_m.CKPT_WRITE_FAILURES).inc()
+            raise
+
+    # a barrier timeout is NOT retryable: the dead peer cannot join the
+    # retry's fresh barriers either — each attempt would burn another full
+    # timeout window (and re-write the PEER_LOST marker) before the exit
+    # the pod launcher is waiting on. Propagate failure agreement at once.
+    from mgproto_tpu.parallel.multihost import BarrierTimeoutError
+
+    retry_call(_write_sharded if sharded else _write, retries=retries,
+               base_delay=0.1, max_delay=2.0, scope="checkpoint",
+               no_retry_on=(BarrierTimeoutError,))
     return path
 
 
@@ -196,16 +684,30 @@ def restore_checkpoint(path: str, target: Any) -> Any:
     When the checkpoint carries a manifest it is verified against `target`
     BEFORE orbax runs (structure mismatches fail readably) and against the
     restored step AFTER (a truncated array payload cannot masquerade as a
-    clean resume point)."""
+    clean resume point).
+
+    A sharded-protocol checkpoint (manifest `sharded: true`) dispatches to
+    the elastic reassembly path instead of orbax — restored leaves land
+    directly on `target`'s shardings, whatever mesh the save ran on."""
     path = os.path.abspath(path)
     manifest = load_manifest(path)
     if manifest is not None:
         _verify_manifest(manifest, target, path)
-    restored = _checkpointer().restore(path, item=target)
+    if manifest is not None and manifest.get("sharded"):
+        restored = _restore_sharded(path, target, manifest)
+    elif manifest is None and has_shard_files(path):
+        # shard files but no manifest: a save that crashed before the
+        # manifest write — never feed it to orbax's opaque error path
+        raise CheckpointIntegrityError(
+            f"{path} holds uncommitted shard files and no manifest "
+            "(crashed mid-save); it cannot be restored"
+        )
+    else:
+        restored = _checkpointer().restore(path, item=target)
     if manifest is not None and manifest.get("step") is not None:
         restored_step = getattr(restored, "step", None)
         if restored_step is not None:
-            got = int(jax.device_get(restored_step))
+            got = _scalar_value(restored_step)
             if got != int(manifest["step"]):
                 raise CheckpointIntegrityError(
                     f"checkpoint {path}: restored step {got} != manifest "
@@ -248,15 +750,21 @@ def save_state_w_condition(
     accuracy: float,
     target_accuracy: float,
     metadata: Optional[dict] = None,
+    sharded: Optional[bool] = None,
 ) -> Optional[str]:
     """Parity with reference utils/save.py:5-12: save only when accuracy
-    clears the threshold; name encodes epoch/stage/accuracy."""
+    clears the threshold; name encodes epoch/stage/accuracy. `sharded`
+    forwards to `save_checkpoint` (the `--ckpt_format` plumbing) — the
+    accuracy gate is host-symmetric (the test pass is SPMD), so under
+    multi-host every process takes the same save/skip branch and the
+    coordinated protocol's barriers stay aligned."""
     if accuracy <= target_accuracy:
         return None
     meta = dict(metadata or {})
     meta.update(epoch=epoch, stage=stage, accuracy=accuracy)
     return save_checkpoint(
-        ckpt_dir, state, checkpoint_name(epoch, stage, accuracy), metadata=meta
+        ckpt_dir, state, checkpoint_name(epoch, stage, accuracy),
+        metadata=meta, sharded=sharded,
     )
 
 
@@ -269,10 +777,18 @@ _STAGE_ORDER = {"preempt": -1, "nopush": 0, "push": 1, "prune": 2}
 
 def _manifest_state(path: str) -> str:
     """'ok' (valid manifest), 'missing' (pre-manifest legacy save), or
-    'bad' (torn/corrupt manifest — never trust the checkpoint)."""
+    'bad' (torn/corrupt manifest — never trust the checkpoint).
+
+    A sharded-protocol directory (manifest says so, or shard files are
+    present) is 'bad' until its COMMIT marker exists: the marker is that
+    format's one publish point, so a mid-save crash — before OR after the
+    manifest write — can never leave a checkpoint any listing trusts."""
     try:
         manifest = load_manifest(path)
     except CheckpointIntegrityError:
+        return "bad"
+    sharded = bool((manifest or {}).get("sharded")) or has_shard_files(path)
+    if sharded and not is_committed(path):
         return "bad"
     return "ok" if manifest is not None else "missing"
 
@@ -321,7 +837,16 @@ def apply_retention(
     """Delete old checkpoints, keeping the newest `keep_last` by (epoch,
     stage) order plus the `keep_best` highest-accuracy ones (the eval
     artifacts the reference's threshold saves were for). `keep_last <= 0`
-    disables retention. Returns the deleted paths."""
+    disables retention. Returns the deleted paths.
+
+    Trust and deletion are two sides of one listing: `list_checkpoints`
+    skips uncommitted sharded directories, so retention can never count
+    them toward `keep_last` — and in particular can never delete the last
+    COMMITTED checkpoint in favor of a half-written one. Those orphaned
+    shard directories (a crashed save that a later same-name save did not
+    overwrite) are instead PRUNED here, since nothing can ever resume from
+    them and at pod scale each holds a full model's worth of bytes.
+    Multi-host: call on the primary host only (cli/train gates it)."""
     if keep_last <= 0:
         return []
     ckpts = list_checkpoints(ckpt_dir)
@@ -334,6 +859,27 @@ def apply_retention(
         if c[3] not in keep:
             shutil.rmtree(c[3], ignore_errors=True)
             removed.append(c[3])
+    # orphaned saves: (a) `<name>.tmp` staging dirs of crashed attempts —
+    # a live save always clears its own staging before writing, so any
+    # still here belongs to a DEAD attempt; (b) bare-name sharded dirs
+    # without COMMIT (a crash inside the final swap, or a lost marker) —
+    # the trusted listing refused them, nothing can ever resume from them.
+    trusted = {c[3] for c in ckpts}
+    for name in os.listdir(ckpt_dir):
+        path = os.path.join(ckpt_dir, name)
+        if not os.path.isdir(path) or path in trusted:
+            continue
+        if name.endswith(TMP_SUFFIX):
+            if parse_checkpoint_name(name[: -len(TMP_SUFFIX)]):
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+        elif (
+            parse_checkpoint_name(name)
+            and has_shard_files(path)
+            and not is_committed(path)
+        ):
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
     return removed
 
 

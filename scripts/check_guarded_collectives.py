@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Lint: host-loop code must not reach around the guarded barrier.
+
+A bare host-side collective (`jax.experimental.multihost_utils` —
+process_allgather, sync_global_devices, broadcast_one_to_all) DEADLOCKS
+every survivor when one pod host dies or wedges. ISSUE 9 wraps the
+sanctioned agreement points in `parallel/multihost.py` with the guarded
+barrier (heartbeat files + timeout -> PEER_LOST failure agreement), so the
+host loops in `mgproto_tpu/engine/` and `mgproto_tpu/cli/` may only reach
+cross-host agreement THROUGH that module's helpers (`allgather_sum`,
+`allgather_rows`, `fetch_replicated`, `checkpoint_barrier`, ...) — never by
+importing `multihost_utils` themselves, and never by re-wrapping the
+agreement primitive `any_across_hosts` (its policy callers —
+`preemption.requested_any_host`, `EpochGuard` — live in resilience/, which
+owns the recovery semantics).
+
+AST-based (companion to check_no_blocking_sleep.py). Flags, in every module
+under mgproto_tpu/engine/ and mgproto_tpu/cli/:
+
+  * any import of `jax.experimental.multihost_utils` (plain, from-import,
+    or aliased) and any attribute use of a name bound to it;
+  * any import or call of `any_across_hosts`.
+
+Run from anywhere:
+
+    python scripts/check_guarded_collectives.py [repo_root]
+
+Exit 0 when clean, 1 with one `path:line` per offender otherwise. Wired
+into tier-1 via tests/test_sharded_checkpoint.py (with violation-detection
+coverage, like the other lint scripts).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+_PACKAGES = ("engine", "cli")
+_BANNED_NAME = "any_across_hosts"
+_MHU = "jax.experimental.multihost_utils"
+
+
+def _offenders_in(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+    mhu_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _MHU:
+                    yield node.lineno, f"imports {_MHU}"
+                    mhu_aliases.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == _MHU:
+                yield node.lineno, f"from-imports {_MHU}"
+            elif node.module == "jax.experimental":
+                for a in node.names:
+                    if a.name == "multihost_utils":
+                        yield node.lineno, f"imports {_MHU}"
+                        mhu_aliases.add(a.asname or a.name)
+            for a in node.names:
+                if a.name == _BANNED_NAME:
+                    yield (
+                        node.lineno,
+                        f"imports {_BANNED_NAME} (use the guarded helpers "
+                        "in parallel/multihost.py or "
+                        "preemption.requested_any_host)",
+                    )
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in mhu_aliases
+        ):
+            yield node.lineno, f"calls {_MHU}.{node.attr} directly"
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == _BANNED_NAME
+        ):
+            yield node.lineno, f"calls {_BANNED_NAME} directly"
+
+
+def offenders(repo_root: str) -> List[Tuple[str, int, str]]:
+    found = []
+    for pkg in _PACKAGES:
+        root = os.path.join(repo_root, "mgproto_tpu", pkg)
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path) as f:
+                    try:
+                        tree = ast.parse(f.read(), filename=path)
+                    except SyntaxError as e:
+                        found.append((
+                            os.path.relpath(path, repo_root), e.lineno or 0,
+                            "unparseable module",
+                        ))
+                        continue
+                for lineno, why in _offenders_in(tree):
+                    found.append(
+                        (os.path.relpath(path, repo_root), lineno, why)
+                    )
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = offenders(root)
+    for path, lineno, why in found:
+        print(f"{path}:{lineno}: {why} (a bare collective deadlocks on a "
+              "dead peer; route through parallel/multihost.py's guarded "
+              "helpers)")
+    if found:
+        return 1
+    print("check_guarded_collectives: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

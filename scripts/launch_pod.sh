@@ -6,9 +6,24 @@
 # 'data'. This is the multi-host story the reference lacks entirely
 # (SURVEY.md §2.3: single process, single GPU).
 #
+# Fault tolerance (ISSUE 9): each worker runs a RELAUNCH LOOP. When a host
+# dies or wedges, the survivors' guarded barrier (parallel/multihost.py)
+# times out after --barrier_timeout_s, dumps the flight recorder, writes
+# PEER_LOST.json into the (shared) model_dir, and exits with the distinct
+# status 75 (PEER_LOST_EXIT_CODE). The loop below answers ANY nonzero exit
+# — 75, the chaos kill status 86, or a real crash (segfault 139 / OOM-kill
+# 137, the codes a genuinely dying worker actually produces) — by
+# relaunching `--resume auto`, which restores the last COMMITTED sharded
+# checkpoint (utils/checkpoint.py: no COMMIT marker, no resume). Exit 0
+# (done or graceful preemption) and the argparse usage error (2) break the
+# loop.
+#
 # Usage: scripts/launch_pod.sh <tpu-name> <zone> <data_root> [extra args...]
+# Knobs: MGPROTO_MAX_RELAUNCHES (default 20) bounds the loop so a
+# deterministic crash cannot flap forever; MGPROTO_REMOTE_DIR overrides the
+# repo location on the workers.
 # Requires: gcloud configured for the pod's project, code + data present on
-# every worker (or on a shared filesystem).
+# every worker, model_dir on a filesystem shared across workers.
 set -euo pipefail
 
 TPU_NAME="${1:?usage: launch_pod.sh <tpu-name> <zone> <data_root> [args...]}"
@@ -19,15 +34,62 @@ shift 3 || true
 # repo location ON THE WORKERS (may differ from the launching machine's
 # checkout); override with MGPROTO_REMOTE_DIR
 REPO_DIR="${MGPROTO_REMOTE_DIR:-$(cd "$(dirname "$0")/.." && pwd)}"
+MODEL_DIR="./saved_models-pod"
+MAX_RELAUNCHES="${MGPROTO_MAX_RELAUNCHES:-20}"
 
 # %q-quote every component so spaces/globs/quotes survive the remote shell's
 # re-parse on each worker
-REMOTE_CMD="$(printf '%q ' cd "$REPO_DIR")&& $(printf '%q ' \
+TRAIN_CMD="$(printf '%q ' \
     python -m mgproto_tpu.cli.train \
     --distributed \
     --data_root "$DATA_ROOT" \
-    --model_dir ./saved_models-pod \
+    --model_dir "$MODEL_DIR" \
     "$@")"
+
+# the per-worker watchdog: first launch runs the args as given; every
+# relaunch appends --resume auto (idempotent when the caller passed it).
+# The train run is launched in the BACKGROUND and the watchdog polls the
+# shared-FS PEER_LOST.json next to it: a marker NEWER than this launch's
+# stamp file means the survivors already agreed a peer is lost — if our
+# local run is still alive it is the wedged victim (or a survivor stuck in
+# a bare device collective the guard can't time out), so it gets SIGKILLed
+# into the relaunch path instead of hanging the pod forever. The stamp
+# (touched on the same shared FS before each launch, so mtimes compare
+# consistently) keeps a fresh relaunch from being killed by the PREVIOUS
+# incident's marker; the relaunched run itself clears the marker at
+# bring-up (cli/train.py).
+# ANY nonzero exit relaunches (bounded by MGPROTO_MAX_RELAUNCHES), not just
+# the protocol codes 75/86: a segfault/OOM-kill (139/137) on THIS worker is
+# exactly the case where the survivors will exit 75 a barrier-timeout later
+# and expect everyone back at bring-up — a watchdog that quit on the real
+# crash code would wedge the whole relaunched pod. The one exception is the
+# argparse usage error (rc 2): a bad flag fails identically every attempt.
+MODEL_DIR_Q="$(printf '%q' "$MODEL_DIR")"
+REMOTE_CMD="$(printf '%q ' cd "$REPO_DIR") && \
+attempt=0; resume=; \
+marker=$MODEL_DIR_Q/PEER_LOST.json; \
+stamp=$MODEL_DIR_Q/.watchdog.\$(hostname); \
+mkdir -p $MODEL_DIR_Q; \
+while :; do \
+  touch \"\$stamp\"; \
+  $TRAIN_CMD \$resume & tpid=\$!; \
+  while kill -0 \"\$tpid\" 2>/dev/null; do \
+    if [ -f \"\$marker\" ] && [ \"\$marker\" -nt \"\$stamp\" ]; then \
+      echo \"pod-watchdog: peer-lost marker on shared FS — killing local run\"; \
+      kill -9 \"\$tpid\" 2>/dev/null; break; \
+    fi; \
+    sleep 5; \
+  done; \
+  rc=0; wait \"\$tpid\" || rc=\$?; \
+  if [ \"\$rc\" -eq 0 ]; then echo \"pod-watchdog: clean exit\"; break; fi; \
+  if [ \"\$rc\" -eq 2 ]; then \
+    echo \"pod-watchdog: usage error — not retryable\"; exit \"\$rc\"; fi; \
+  attempt=\$((attempt+1)); \
+  if [ \"\$attempt\" -gt $(printf '%q' "$MAX_RELAUNCHES") ]; then \
+    echo \"pod-watchdog: relaunch budget exhausted\"; exit \"\$rc\"; fi; \
+  echo \"pod-watchdog: rc=\$rc — relaunch \$attempt from last commit\"; \
+  resume='--resume auto'; \
+done"
 
 exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
     --command "$REMOTE_CMD"

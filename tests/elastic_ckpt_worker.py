@@ -1,0 +1,97 @@
+"""Worker for the elastic-resume parity test (ISSUE 9): one single-process
+jax run pinned to a given virtual CPU device count.
+
+Run as:  python tests/elastic_ckpt_worker.py <devices> <ckpt_dir> <mode>
+
+mode 'save'    — pin <devices> chips, build a deterministic sharded state,
+                 commit a sharded checkpoint, print its digest
+mode 'restore' — pin <devices> chips (a DIFFERENT count than the save),
+                 restore onto this mesh, print the digest; the parent
+                 asserts save-on-4 -> restore-on-{2,8} digests match
+                 bit-exactly and that `elastic_restores_total` counted it
+
+Deliberately light: no flax/trainer imports — the parity being proven is
+the checkpoint layer's (save mesh never constrains the restore mesh), and
+tier-1 wall-clock is a budget.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    devices, ckpt_dir, mode = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices}"
+    )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == devices, jax.device_count()
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mgproto_tpu.resilience import metrics as res_metrics
+    from mgproto_tpu.utils.checkpoint import (
+        find_latest_checkpoint,
+        pytree_digest,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    model = 2 if devices % 2 == 0 else 1
+    mesh = Mesh(
+        np.array(jax.devices()).reshape(devices // model, model),
+        ("data", "model"),
+    )
+
+    def make(shape, spec, base):
+        full = (
+            np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+            + base
+        )
+        return jax.device_put(full, NamedSharding(mesh, spec))
+
+    state = {
+        "params": make((6, 5), P(), 0.0),
+        "rows": make((8, 3), P("data"), 100.0),
+        "bank": make((4, 4, 2), P("model"), 200.0),
+        "step": jax.device_put(
+            np.asarray(7, np.int32), NamedSharding(mesh, P())
+        ),
+    }
+
+    if mode == "save":
+        save_checkpoint(ckpt_dir, state, "0nopush0.5000",
+                        metadata={"epoch": 0}, sharded=True)
+        print(f"DIGEST {pytree_digest(state)}", flush=True)
+    elif mode == "restore":
+        latest = find_latest_checkpoint(ckpt_dir)
+        assert latest is not None, "no committed checkpoint visible"
+        target = jax.tree_util.tree_map(
+            lambda l: jax.device_put(
+                np.zeros(l.shape, jax.device_get(l).dtype), l.sharding
+            ),
+            state,
+        )
+        restored = restore_checkpoint(latest, target)
+        # the restored leaves live on THIS mesh
+        for leaf in jax.tree_util.tree_leaves(restored):
+            assert isinstance(leaf, jax.Array)
+        elastic = res_metrics.counter(res_metrics.ELASTIC_RESTORES).value()
+        assert elastic == 1, f"elastic_restores_total={elastic}"
+        print(f"DIGEST {pytree_digest(restored)}", flush=True)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

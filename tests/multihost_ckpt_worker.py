@@ -1,0 +1,236 @@
+"""Worker for the pod fault-tolerance protocol drills (ISSUE 9): one
+jax.distributed CPU process of a two-process "pod".
+
+Run as:  python tests/multihost_ckpt_worker.py <pid> <nprocs> <port> \
+             <model_dir> <mode>
+
+Drives the REAL cross-process halves of the coordinated sharded checkpoint
+protocol and the guarded-barrier failure agreement with genuinely
+distributed global arrays (jax.make_array_from_callback — metadata + local
+placement only; this container's CPU jax cannot run cross-process
+COMPUTATIONS, which is why the drill exercises the protocol layer and the
+single-process tests carry the full-training digest parity). Modes:
+
+  roundtrip  coordinated sharded save -> committed visibility -> elastic
+             restore, plus the host-0-only side-effects audit (each host
+             writes ONLY its shard files; manifest/meta/COMMIT are host
+             0's)
+  kill       a committed save, then the victim process (pid 1) dies hard
+             mid "step loop" (MGPROTO_CHAOS_KILL_HOST_AT through the real
+             chaos knob); the survivor's guarded barrier must time out,
+             write PEER_LOST.json, dump the flight recorder, and exit 75
+  wedge      same, but the victim hangs (stale heartbeat, process alive);
+             the parent kills it after the survivor exits 75
+  resume     a fresh incarnation after `kill`: the last COMMITTED
+             checkpoint restores bit-exactly (per-shard content check)
+
+Each check prints a CHECK line; the parent asserts on them plus the exit
+codes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _global_value(shape, base):
+    """Deterministic global content: value[i,j,...] = base + flat index."""
+    import numpy as np
+
+    return (
+        np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape) + base
+    )
+
+
+def _make_state(mesh, base):
+    """A global pytree mixing the shardings a TrainState carries, built
+    WITHOUT collectives: each process materializes only its shards."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def make(shape, spec, b):
+        full = _global_value(shape, b)
+        return jax.make_array_from_callback(
+            shape, NamedSharding(mesh, spec), lambda idx: full[idx]
+        )
+
+    return {
+        "params": make((6, 5), P(), base + 0.0),
+        "rows": make((8, 3), P("data"), base + 100.0),
+        "bank": make((4, 4, 2), P("model"), base + 200.0),
+        "step": jax.make_array_from_callback(
+            (), NamedSharding(mesh, P()),
+            lambda idx: np.asarray(int(base), np.int32),
+        ),
+    }
+
+
+def _check_local_shards(state, base):
+    """Every addressable shard of every leaf matches the deterministic
+    global content — a restore check that needs no collective."""
+    import numpy as np
+
+    specs = {"params": 0.0, "rows": 100.0, "bank": 200.0}
+    for name, offset in specs.items():
+        leaf = state[name]
+        full = _global_value(leaf.shape, base + offset)
+        for s in leaf.addressable_shards:
+            np.testing.assert_array_equal(np.asarray(s.data), full[s.index])
+    assert int(np.asarray(state["step"].addressable_shards[0].data)) == base
+
+
+def main() -> None:
+    pid, nprocs, port, model_dir, mode = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+        sys.argv[5],
+    )
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.process_count() == nprocs
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mgproto_tpu.obs.flightrec import FlightRecorder, set_recorder
+    from mgproto_tpu.parallel import multihost
+    from mgproto_tpu.resilience.chaos import (
+        HOST_KILL_EXIT_CODE,
+        ChaosState,
+        plan_from_env,
+    )
+    from mgproto_tpu.utils.checkpoint import (
+        COMMIT_FILE,
+        MANIFEST_FILE,
+        find_latest_checkpoint,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    devs = np.array(jax.devices()).reshape(2 * nprocs, 2)
+    mesh = Mesh(devs, ("data", "model"))
+
+    # flight recorder dumps land where run_training puts them
+    set_recorder(FlightRecorder(
+        dump_dir=os.path.join(model_dir, "telemetry")
+    ))
+    # the guarded barrier IS the coordination fabric here (the production
+    # multi-host path with --barrier_timeout_s; session shared via
+    # MGPROTO_BARRIER_SESSION from the parent — no bring-up collective)
+    multihost.configure_barrier(model_dir, timeout_s=2.5, poll_s=0.02)
+
+    ckpt_name = "0nopush0.5000"
+
+    if mode in ("roundtrip", "kill", "wedge"):
+        state = _make_state(mesh, base=1)
+        path = save_checkpoint(
+            model_dir, state, ckpt_name, metadata={"epoch": 0},
+        )  # sharded=None -> multi-host -> coordinated sharded protocol
+        assert find_latest_checkpoint(model_dir) == path
+        print(f"CHECK save_committed ok pid={pid}", flush=True)
+
+        # host-0-only side-effects audit: every process wrote EXACTLY its
+        # own shard pair; manifest/meta/COMMIT belong to host 0
+        mine = {f"shard_{pid:05d}.npz", f"shard_{pid:05d}.idx.json"}
+        names = set(os.listdir(path))
+        assert mine <= names, names
+        with open(os.path.join(path, f"shard_{pid:05d}.idx.json")) as f:
+            assert json.load(f)["process"] == pid
+        with open(os.path.join(path, MANIFEST_FILE)) as f:
+            manifest = json.load(f)
+        assert manifest["sharded"] and manifest["num_hosts"] == nprocs
+        assert os.path.exists(os.path.join(path, COMMIT_FILE))
+        print(f"CHECK per_host_writes ok pid={pid}", flush=True)
+
+    if mode == "roundtrip":
+        # replica-0 dedupe: host 1 persists ONLY the leaf sharded over
+        # 'data' (its rows); the replicated params/step and the
+        # model-sharded-but-data-replicated bank all have their replica-0
+        # shards on host 0 (dict order: bank=0, params=1, rows=2, step=3)
+        with open(os.path.join(
+            model_dir, ckpt_name, "shard_00001.idx.json"
+        )) as f:
+            other = json.load(f)
+        leaves_written_by_1 = {c["leaf"] for c in other["chunks"]}
+        assert leaves_written_by_1 == {2}, leaves_written_by_1
+        target = _make_state(mesh, base=0)
+        restored = restore_checkpoint(
+            os.path.join(model_dir, ckpt_name), target
+        )
+        _check_local_shards(restored, base=1)
+        print(f"CHECK restore_elastic ok pid={pid}", flush=True)
+
+        # side-effects audit: nothing but checkpoint shards is per-host —
+        # no PREEMPTED.json, no second manifest, no host-1 COMMIT attempt
+        assert not os.path.exists(os.path.join(model_dir, "PREEMPTED.json"))
+        print(f"CHECK side_effects ok pid={pid}", flush=True)
+
+    if mode in ("kill", "wedge"):
+        plan = plan_from_env()
+        assert plan is not None, "parent must set the MGPROTO_CHAOS_* knobs"
+        chaos = ChaosState(plan)
+        try:
+            for step in range(20):
+                multihost.heartbeat_tick()
+                if chaos.host_kill_due(step, jax.process_index()):
+                    os._exit(HOST_KILL_EXIT_CODE)
+                if chaos.host_wedge_due(step, jax.process_index()):
+                    import time
+
+                    while True:  # stuck host: alive, silent, not stepping
+                        time.sleep(3600)
+                # the step-cadence agreement point (what any_across_hosts
+                # guards in the train loop)
+                multihost.guarded_barrier("step")
+        except multihost.BarrierTimeoutError as e:
+            marker = os.path.join(model_dir, multihost.PEER_LOST_FILE)
+            with open(marker) as f:
+                payload = json.load(f)
+            assert payload["missing_processes"] == [1], payload
+            ages = payload["heartbeat_ages_s"]
+            if mode == "wedge":
+                # the wedged peer heartbeat EXISTS but went stale
+                assert ages["1"] is not None, payload
+            dumps = os.listdir(os.path.join(model_dir, "telemetry"))
+            assert any(d.startswith("flightrec_peer_lost") for d in dumps)
+            # the committed checkpoint survived the failure untouched
+            assert find_latest_checkpoint(model_dir) is not None
+            print(f"CHECK peer_lost ok pid={pid} barrier={e.name}",
+                  flush=True)
+            sys.stdout.flush()
+            os._exit(multihost.PEER_LOST_EXIT_CODE)
+        raise AssertionError("victim never died / barrier never timed out")
+
+    if mode == "resume":
+        # fresh incarnation (new MGPROTO_BARRIER_SESSION from the parent):
+        # the dead incarnation's barrier files and PEER_LOST marker must
+        # not confuse it, and the last COMMITTED checkpoint restores
+        latest = find_latest_checkpoint(model_dir)
+        assert latest is not None and latest.endswith(ckpt_name), latest
+        target = _make_state(mesh, base=0)
+        restored = restore_checkpoint(latest, target)
+        _check_local_shards(restored, base=1)
+        multihost.guarded_barrier("resume_sync")  # both peers alive again
+        print(f"CHECK resume ok pid={pid}", flush=True)
+
+    print(f"WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

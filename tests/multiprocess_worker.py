@@ -58,6 +58,12 @@ def main() -> None:
     assert allgather_sum(float(pid + 1)) == float(
         sum(range(1, nprocs + 1))
     )
+    # wire-dtype hazard (ISSUE 9): the old np.float64 allgather silently
+    # downcast to f32 on device under x32 — large counters past 2^24 lost
+    # exact integer precision. The raw-bytes wire must sum these exactly.
+    big = float(2**24 + 1 + pid)
+    want = float(sum(2**24 + 1 + p for p in range(nprocs)))
+    assert allgather_sum(big) == want, (allgather_sum(big), want)
     print(f"CHECK allgather ok pid={pid}", flush=True)
 
     # --- put_batch (make_array_from_process_local_data) + host_local_rows

@@ -1,0 +1,64 @@
+"""Worker for the host-kill -> relaunch -> resume digest-parity drill
+(tests/test_pod_chaos.py): one full `run_training` invocation on the
+virtual 8-device CPU mesh with the SHARDED checkpoint format forced.
+
+Run as:  python tests/pod_train_worker.py <data_root> <model_dir> <mode>
+
+mode 'run'    — train from scratch; with MGPROTO_CHAOS_KILL_HOST_AT set the
+                process dies hard (exit 86) when that global step's batch
+                is drawn, leaving only committed sharded checkpoints behind
+mode 'resume' — `--resume auto` from the last committed checkpoint and run
+                to completion; prints the final-state digest for the parent
+                to compare against an uninterrupted clean run
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    data_root, model_dir, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    from mgproto_tpu.hermetic import pin_cpu_devices
+
+    pin_cpu_devices(8)  # identical device topology to the tier-1 conftest
+
+    import dataclasses
+
+    from mgproto_tpu.cli.train import run_training
+    from mgproto_tpu.config import DataConfig, tiny_test_config
+    from mgproto_tpu.resilience import chaos as chaos_mod
+    from mgproto_tpu.utils.checkpoint import pytree_digest
+
+    cfg = tiny_test_config()
+    cfg = cfg.replace(
+        data=DataConfig(
+            train_dir=os.path.join(data_root, "train"),
+            test_dir=os.path.join(data_root, "test"),
+            train_push_dir=os.path.join(data_root, "train"),
+            train_batch_size=8,
+            test_batch_size=8,
+            train_push_batch_size=8,
+            num_workers=2,
+        ),
+        schedule=dataclasses.replace(cfg.schedule, push_start=99),
+        model_dir=model_dir,
+    )
+    plan = chaos_mod.plan_from_env()
+    chaos = chaos_mod.ChaosState(plan) if plan else None
+    state, _accu = run_training(
+        cfg,
+        resume="auto" if mode == "resume" else "",
+        telemetry=False,
+        target_accu=-1.0,  # save every epoch: the relaunch anchors
+        ckpt_format="sharded",
+        chaos=chaos,
+    )
+    print(f"DIGEST {pytree_digest(state)}", flush=True)
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Host-kill acceptance drill (ISSUE 9): a training process killed HARD at
+step N (MGPROTO_CHAOS_KILL_HOST_AT — os._exit, no cleanup, the pod host
+crash) must leave only COMMITTED sharded checkpoints behind, and a relaunch
+with `--resume auto` must reproduce the uninterrupted clean run's final
+state digest bit-exactly.
+
+This is the single-process full-training half of the pod story; the
+two-process barrier/failure-agreement drills live in
+tests/test_multiprocess.py (this container's CPU jax cannot run
+cross-process computations, so the full train loop cannot span processes
+here).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mgproto_tpu.cli.train import run_training
+from mgproto_tpu.resilience.chaos import HOST_KILL_EXIT_CODE
+from mgproto_tpu.utils.checkpoint import (
+    find_latest_checkpoint,
+    has_shard_files,
+    is_committed,
+    load_metadata,
+    pytree_digest,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "pod_train_worker.py")
+
+
+def _make_folder(root, num_classes=4, per_class=6, size=40, seed=0):
+    rng = np.random.RandomState(seed)
+    for c in range(num_classes):
+        d = os.path.join(root, f"{c:03d}.class_{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.randint(0, 255, size=(size, size, 3), dtype=np.uint8)
+            arr = np.clip(arr * 0.3 + c * 50, 0, 255)
+            Image.fromarray(arr.astype(np.uint8)).save(
+                os.path.join(d, f"img_{i}.jpg")
+            )
+
+
+@pytest.fixture(scope="module")
+def data_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("pod_data"))
+    _make_folder(os.path.join(root, "train"))  # 24 imgs -> 3 steps @ batch 8
+    _make_folder(os.path.join(root, "test"), per_class=3, seed=1)
+    return root
+
+
+def _worker(data_root, model_dir, mode, extra_env=None, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-u", WORKER, data_root, model_dir, mode],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=timeout,
+    )
+
+
+def test_host_kill_relaunch_resume_digest_parity(data_root, tmp_path):
+    # ------------------------------------------------------------- clean run
+    # in-process (the pytest interpreter IS the same 8-device CPU topology
+    # the worker pins), sharded format — the trajectory the drill must match
+    import dataclasses
+
+    from mgproto_tpu.config import DataConfig, tiny_test_config
+
+    cfg = tiny_test_config()
+    cfg = cfg.replace(
+        data=DataConfig(
+            train_dir=os.path.join(data_root, "train"),
+            test_dir=os.path.join(data_root, "test"),
+            train_push_dir=os.path.join(data_root, "train"),
+            train_batch_size=8,
+            test_batch_size=8,
+            train_push_batch_size=8,
+            num_workers=2,
+        ),
+        schedule=dataclasses.replace(cfg.schedule, push_start=99),
+        model_dir=str(tmp_path / "clean"),
+    )
+    clean_state, _ = run_training(
+        cfg, telemetry=False, target_accu=-1.0, ckpt_format="sharded"
+    )
+    clean_digest = pytree_digest(clean_state)
+    clean_latest = find_latest_checkpoint(cfg.model_dir)
+    assert clean_latest is not None
+    assert has_shard_files(clean_latest) and is_committed(clean_latest)
+
+    # ------------------------------------------------- host crash at step 4
+    chaos_dir = str(tmp_path / "chaos")
+    proc = _worker(
+        data_root, chaos_dir, "run",
+        extra_env={"MGPROTO_CHAOS_KILL_HOST_AT": "4"},
+    )
+    assert proc.returncode == HOST_KILL_EXIT_CODE, (
+        proc.stdout[-3000:] + proc.stderr[-2000:]
+    )
+    assert "DIGEST" not in proc.stdout  # it really died mid-run
+    # only the COMMITTED epoch-0 checkpoint is visible — the crash at any
+    # later moment never published anything partial
+    latest = find_latest_checkpoint(chaos_dir)
+    assert latest is not None, os.listdir(chaos_dir)
+    assert has_shard_files(latest) and is_committed(latest)
+    meta = load_metadata(latest)
+    assert meta["stage"] == "nopush" and meta["epoch"] == 0
+
+    # -------------------------------------------- relaunch from last commit
+    proc = _worker(data_root, chaos_dir, "resume")
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    digest = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("DIGEST "):
+            digest = line.split()[1]
+    assert digest == clean_digest, (
+        "kill -> relaunch -> resume did not reproduce the clean run"
+    )

@@ -1,0 +1,493 @@
+"""Pod-scale fault tolerance units (ISSUE 9): the coordinated sharded
+checkpoint protocol (COMMIT-gated visibility, elastic restore, retention
+over mixed committed/uncommitted/legacy directories), the guarded-barrier
+failure agreement (timeout -> PEER_LOST marker + flight-recorder dump),
+the allgather wire-dtype fix, and the check_guarded_collectives lint.
+
+The cross-process halves (kill -> relaunch -> digest parity, wedge ->
+barrier-timeout exit, save-on-4 -> restore-on-{2,8}) live in
+tests/test_multiprocess.py; everything here is single-process tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from mgproto_tpu.parallel import multihost
+from mgproto_tpu.parallel.mesh import make_mesh
+from mgproto_tpu.resilience import metrics as res_metrics
+from mgproto_tpu.resilience.chaos import ChaosPlan, ChaosState, set_active
+from mgproto_tpu.telemetry.registry import MetricRegistry, set_current_registry
+from mgproto_tpu.utils.checkpoint import (
+    COMMIT_FILE,
+    MANIFEST_FILE,
+    TMP_SUFFIX,
+    CheckpointIntegrityError,
+    apply_retention,
+    find_latest_checkpoint,
+    has_shard_files,
+    is_committed,
+    list_checkpoints,
+    pytree_digest,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def registry():
+    reg = MetricRegistry()
+    prev = set_current_registry(reg)
+    yield reg
+    set_current_registry(prev)
+
+
+def _sharded_state(mesh, seed=0):
+    """A small pytree mixing the shardings a TrainState carries: replicated
+    params, data-sharded rows, class(model)-sharded bank, scalar step."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 3)
+    tree = {
+        "params": jax.device_put(
+            jax.random.normal(ks[0], (6, 5)), NamedSharding(mesh, P())
+        ),
+        "rows": jax.device_put(
+            jax.random.normal(ks[1], (8, 3)), NamedSharding(mesh, P("data"))
+        ),
+        "bank": jax.device_put(
+            jax.random.normal(ks[2], (4, 4, 2)),
+            NamedSharding(mesh, P("model")),
+        ),
+        "step": jax.device_put(
+            jax.numpy.asarray(7, jax.numpy.int32), NamedSharding(mesh, P())
+        ),
+    }
+    return tree
+
+
+def _zeros_like_target(tree):
+    return jax.tree_util.tree_map(
+        lambda l: jax.device_put(
+            np.zeros(l.shape, jax.device_get(l).dtype), l.sharding
+        ),
+        tree,
+    )
+
+
+# ----------------------------------------------------- sharded save/restore
+def test_sharded_roundtrip_bit_exact(tmp_path):
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    d0 = pytree_digest(state)
+    path = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                           metadata={"epoch": 0}, sharded=True)
+    names = set(os.listdir(path))
+    assert COMMIT_FILE in names and MANIFEST_FILE in names
+    assert has_shard_files(path) and is_committed(path)
+    restored = restore_checkpoint(path, _zeros_like_target(state))
+    assert pytree_digest(restored) == d0
+    # manifest records the sharded protocol + saving topology
+    with open(os.path.join(path, MANIFEST_FILE)) as f:
+        manifest = json.load(f)
+    assert manifest["sharded"] is True
+    assert manifest["num_devices"] == jax.device_count()
+    assert manifest["num_hosts"] == 1
+    # step rides on TrainState's attribute; a plain-dict pytree records None
+    assert manifest["step"] is None
+
+
+def test_sharded_restore_onto_different_mesh_layout(tmp_path):
+    """Same device count, different (data, model) split: the restore target's
+    shardings win — the save mesh never constrains the restore."""
+    state = _sharded_state(make_mesh(data=4, model=2))
+    d0 = pytree_digest(state)
+    path = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                           sharded=True)
+    target = _zeros_like_target(_sharded_state(make_mesh(data=2, model=4)))
+    restored = restore_checkpoint(path, target)
+    assert pytree_digest(restored) == d0
+    for leaf in jax.tree_util.tree_leaves(restored):
+        assert isinstance(leaf, jax.Array)
+
+
+def test_mid_save_crash_leaves_no_visible_checkpoint(tmp_path, registry):
+    """Chaos checkpoint-write failure fires between the shard writes and the
+    COMMIT marker: the save dies in its STAGING directory (shards present,
+    no COMMIT), nothing ever appears at the real checkpoint name, no
+    listing trusts the wreckage, restore refuses it, and the failure is
+    counted."""
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    set_active(ChaosState(ChaosPlan(checkpoint_write_failures=1)))
+    try:
+        with pytest.raises(IOError, match="chaos"):
+            save_checkpoint(str(tmp_path), state, "1nopush0.6000",
+                            retries=0, sharded=True)
+    finally:
+        set_active(None)
+    crashed = str(tmp_path / "1nopush0.6000")
+    staging = crashed + TMP_SUFFIX
+    assert not os.path.isdir(crashed)  # the real name never materialized
+    assert has_shard_files(staging) and not is_committed(staging)
+    assert find_latest_checkpoint(str(tmp_path)) is None
+    assert list_checkpoints(str(tmp_path)) == []
+    with pytest.raises(CheckpointIntegrityError, match="COMMIT|uncommitted"):
+        restore_checkpoint(staging, _zeros_like_target(state))
+    assert registry.counter(res_metrics.CKPT_WRITE_FAILURES).value() == 1
+
+
+def test_commit_marker_is_the_publish_point(tmp_path):
+    """Deleting COMMIT from an otherwise-complete sharded checkpoint makes
+    it absent everywhere — manifest or not."""
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    path = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                           sharded=True)
+    assert find_latest_checkpoint(str(tmp_path)) == path
+    os.unlink(os.path.join(path, COMMIT_FILE))
+    assert find_latest_checkpoint(str(tmp_path)) is None
+    # ... even for a save that crashed before the manifest write
+    os.unlink(os.path.join(path, MANIFEST_FILE))
+    assert find_latest_checkpoint(str(tmp_path)) is None
+    with pytest.raises(CheckpointIntegrityError, match="uncommitted"):
+        restore_checkpoint(path, _zeros_like_target(state))
+
+
+def test_elastic_restore_counter_on_topology_change(tmp_path, registry):
+    """A manifest recording a different device/host count than the restore
+    environment counts as an elastic restore (and still restores
+    bit-exactly — the assembly path is topology-blind)."""
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    d0 = pytree_digest(state)
+    path = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                           sharded=True)
+    mpath = os.path.join(path, MANIFEST_FILE)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["num_devices"] = 4  # pretend the save ran on a 4-chip mesh
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    restored = restore_checkpoint(path, _zeros_like_target(state))
+    assert pytree_digest(restored) == d0
+    assert registry.counter(res_metrics.ELASTIC_RESTORES).value() == 1
+
+
+def test_torn_chunk_cover_is_refused(tmp_path):
+    """A shard npz+sidecar pair that vanished after commit (FS loss) fails
+    the exact-cover check instead of silently restoring garbage."""
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    path = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                           sharded=True)
+    for name in os.listdir(path):
+        if name.startswith("shard_"):
+            os.unlink(os.path.join(path, name))
+    with pytest.raises(CheckpointIntegrityError, match="cover"):
+        restore_checkpoint(path, _zeros_like_target(state))
+
+
+def test_replicated_escape_hatch_still_roundtrips(tmp_path):
+    """sharded=False keeps the single-file orbax format (the --ckpt_format
+    escape hatch), and the two formats coexist in one listing."""
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    d0 = pytree_digest(state)
+    rep = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                          sharded=False)
+    assert not has_shard_files(rep)
+    restored = restore_checkpoint(rep, _zeros_like_target(state))
+    assert pytree_digest(restored) == d0
+    sh = save_checkpoint(str(tmp_path), state, "1nopush0.6000", sharded=True)
+    assert [c[3] for c in list_checkpoints(str(tmp_path))] == [rep, sh]
+    assert find_latest_checkpoint(str(tmp_path)) == sh
+
+
+# ------------------------------------------------------------------ retention
+def test_retention_mixed_committed_uncommitted_legacy(tmp_path, registry):
+    """Retention over a directory holding committed sharded saves, a
+    mid-save orphan, and a legacy manifest-less save: it must never count
+    (or delete) the orphan as a kept checkpoint, must prune it, and must
+    keep the newest committed checkpoint."""
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    old = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                          sharded=True)
+    newest = save_checkpoint(str(tmp_path), state, "1nopush0.4000",
+                             sharded=True)
+    # legacy: a replicated save with its manifest stripped (pre-manifest era)
+    legacy = save_checkpoint(str(tmp_path), state, "2nopush0.3000",
+                             sharded=False)
+    os.unlink(os.path.join(legacy, MANIFEST_FILE))
+    # orphan: a crashed sharded save AT A HIGHER EPOCH than every commit
+    set_active(ChaosState(ChaosPlan(checkpoint_write_failures=1)))
+    try:
+        with pytest.raises(IOError):
+            save_checkpoint(str(tmp_path), state, "3nopush0.9000",
+                            retries=0, sharded=True)
+    finally:
+        set_active(None)
+    orphan = str(tmp_path / "3nopush0.9000") + TMP_SUFFIX
+    assert os.path.isdir(orphan)  # the crash strands its staging directory
+
+    removed = apply_retention(str(tmp_path), keep_last=1, keep_best=0)
+    # keep_last=1 keeps the newest TRUSTED checkpoint (the legacy save) —
+    # the orphan, though higher-epoch, was never a candidate; it is pruned
+    assert os.path.isdir(legacy)
+    assert not os.path.isdir(orphan) and orphan in removed
+    assert not os.path.isdir(old) and not os.path.isdir(newest)
+    # strict resume listing: the legacy save has no manifest, so the
+    # strict answer is None — but retention never deleted a committed
+    # checkpoint in favor of the orphan
+    assert find_latest_checkpoint(str(tmp_path)) is None
+
+
+def test_same_name_resave_failure_retries_to_commit(tmp_path, registry):
+    """Re-saving over an already-COMMITTED checkpoint of the same name
+    (repeated preempt saves of one epoch) with the first attempt's commit
+    chaos-failed: the stale COMMIT marker must not fake success — the
+    retry must run and republish, and the final state must be the NEW
+    save's bytes."""
+    mesh = make_mesh(data=4, model=2)
+    first = _sharded_state(mesh, seed=0)
+    p = save_checkpoint(str(tmp_path), first, "0nopush0.5000", sharded=True)
+    assert is_committed(p)
+    second = _sharded_state(mesh, seed=1)
+    set_active(ChaosState(ChaosPlan(checkpoint_write_failures=1)))
+    try:
+        p2 = save_checkpoint(str(tmp_path), second, "0nopush0.5000",
+                             retries=2, sharded=True)
+    finally:
+        set_active(None)
+    assert p2 == p and is_committed(p2)
+    assert registry.counter(res_metrics.CKPT_WRITE_FAILURES).value() == 1
+    restored = restore_checkpoint(p2, _zeros_like_target(second))
+    assert pytree_digest(restored) == pytree_digest(second)
+    # no staging debris from the failed attempt survives the retry
+    assert not os.path.isdir(p2 + TMP_SUFFIX)
+
+
+def test_pod_watchdog_retries_real_crash_codes():
+    """launch_pod.sh's relaunch loop must retry ANY nonzero exit (a real
+    crash is 139/137, never the protocol codes), stopping only on 0 and
+    the argparse usage error 2 — a watchdog that quits on the crashed
+    worker's own exit code wedges the whole relaunched pod."""
+    with open(os.path.join(REPO, "scripts", "launch_pod.sh")) as f:
+        script = f.read()
+    # the only non-retryable codes are 0 (clean) and 2 (usage error)
+    assert '"$rc" -eq 0' in script.replace("\\", "")
+    assert '"$rc" -eq 2' in script.replace("\\", "")
+    # no allowlist of retryable codes: 75/86 must not gate the relaunch
+    assert '-ne 75' not in script and '-ne 86' not in script
+
+
+def test_retention_never_deletes_last_committed(tmp_path):
+    """keep_last=1 with the newest parseable name being an uncommitted
+    orphan: the last COMMITTED checkpoint survives."""
+    mesh = make_mesh(data=4, model=2)
+    state = _sharded_state(mesh)
+    committed = save_checkpoint(str(tmp_path), state, "0nopush0.5000",
+                                sharded=True)
+    set_active(ChaosState(ChaosPlan(checkpoint_write_failures=1)))
+    try:
+        with pytest.raises(IOError):
+            save_checkpoint(str(tmp_path), state, "5nopush0.9999",
+                            retries=0, sharded=True)
+    finally:
+        set_active(None)
+    apply_retention(str(tmp_path), keep_last=1, keep_best=1)
+    assert find_latest_checkpoint(str(tmp_path)) == committed
+
+
+# ------------------------------------------------------------ guarded barrier
+@pytest.fixture
+def barrier_guard_fixture(tmp_path):
+    yield str(tmp_path)
+    multihost.clear_barrier()
+
+
+def test_guarded_barrier_passes_when_peer_arrives(barrier_guard_fixture):
+    model_dir = barrier_guard_fixture
+    g = multihost.configure_barrier(
+        model_dir, timeout_s=5.0, process_id=0, num_processes=2,
+        poll_s=0.01, session="t",
+    )
+
+    def peer():
+        time.sleep(0.15)
+        with open(g._file("sync", 0, 1), "w") as f:
+            f.write("x")
+
+    t = threading.Thread(target=peer)
+    t.start()
+    multihost.guarded_barrier("sync")  # returns once the peer file lands
+    t.join()
+    assert not os.path.exists(os.path.join(model_dir,
+                                           multihost.PEER_LOST_FILE))
+
+
+def test_guarded_barrier_timeout_writes_marker_and_dumps(
+    barrier_guard_fixture, tmp_path, registry
+):
+    from mgproto_tpu.obs.flightrec import FlightRecorder, set_recorder
+
+    model_dir = barrier_guard_fixture
+    dump_dir = str(tmp_path / "dumps")
+    prev = set_recorder(FlightRecorder(dump_dir=dump_dir))
+    try:
+        g = multihost.configure_barrier(
+            model_dir, timeout_s=0.3, process_id=0, num_processes=2,
+            poll_s=0.01, session="t",
+        )
+        multihost.heartbeat_tick()  # our own heartbeat exists; peer's never
+        with pytest.raises(multihost.BarrierTimeoutError) as e:
+            multihost.guarded_barrier("sync")
+        assert e.value.missing == [1]
+        marker = os.path.join(model_dir, multihost.PEER_LOST_FILE)
+        with open(marker) as f:
+            payload = json.load(f)
+        assert payload["missing_processes"] == [1]
+        assert payload["exit_code"] == multihost.PEER_LOST_EXIT_CODE
+        assert payload["heartbeat_ages_s"]["1"] is None  # never seen
+        assert payload["heartbeat_ages_s"]["0"] is not None
+        dumps = os.listdir(dump_dir)
+        assert any(n.startswith("flightrec_peer_lost") for n in dumps)
+        assert registry.counter(res_metrics.MISSED_BARRIERS).value(
+            barrier="sync") == 1
+        assert registry.counter(res_metrics.PEER_LOST).value() == 1
+        assert g is multihost.barrier_guard()
+    finally:
+        set_recorder(prev)
+
+
+def test_guarded_barrier_noop_when_unconfigured(barrier_guard_fixture):
+    multihost.clear_barrier()
+    multihost.guarded_barrier("anything")  # must not raise or write
+    multihost.heartbeat_tick()
+    assert multihost.peer_heartbeat_ages() == {}
+
+
+def test_barrier_session_namespacing(barrier_guard_fixture):
+    """A relaunch (new session token) must not see the dead incarnation's
+    barrier files: same name+seq, different session directory."""
+    model_dir = barrier_guard_fixture
+    g1 = multihost.configure_barrier(
+        model_dir, timeout_s=1.0, process_id=0, num_processes=2,
+        poll_s=0.01, session="incarnation1",
+    )
+    # dead incarnation left a satisfied barrier behind
+    for pid in (0, 1):
+        with open(g1._file("sync", 0, pid), "w") as f:
+            f.write("x")
+    g2 = multihost.configure_barrier(
+        model_dir, timeout_s=0.2, process_id=0, num_processes=2,
+        poll_s=0.01, session="incarnation2",
+    )
+    assert g1.barrier_dir != g2.barrier_dir
+    with pytest.raises(multihost.BarrierTimeoutError):
+        multihost.guarded_barrier("sync")  # stale files must NOT satisfy it
+
+
+# ------------------------------------------------------------ wire dtype fix
+def test_allgather_wire_dtype_roundtrip_exact():
+    """The allgather wire is raw float64 bytes: values that a device-side
+    f32 downcast would corrupt (large counters, odd integers past 2^24)
+    survive bit-for-bit. The cross-process sum itself is asserted exact in
+    tests/test_multiprocess.py."""
+    from mgproto_tpu.parallel.multihost import _f64_from_wire, _f64_to_wire
+
+    for v in (0.0, 1.0, float(2**24 + 1), float(2**53 - 1), 1.23456789e300,
+              -7.0, 3.141592653589793):
+        wire = _f64_to_wire(v)
+        assert wire.dtype == np.uint8 and wire.shape == (8,)
+        assert _f64_from_wire(wire) == v
+    # the f32 downcast REALLY loses these — the hazard being pinned away
+    assert float(np.float32(2**24 + 1)) != float(2**24 + 1)
+
+
+def test_allgather_sum_single_process_identity():
+    assert multihost.allgather_sum(float(2**24 + 1)) == float(2**24 + 1)
+    assert multihost.any_across_hosts(True) is True
+    assert multihost.any_across_hosts(False) is False
+
+
+# ------------------------------------------------------- chaos host faults
+def test_chaos_host_kill_wedge_knobs_parse_and_fire_once():
+    from mgproto_tpu.resilience.chaos import plan_from_env
+
+    plan = plan_from_env({
+        "MGPROTO_CHAOS_KILL_HOST_AT": "4",
+        "MGPROTO_CHAOS_WEDGE_HOST_AT": "6",
+        "MGPROTO_CHAOS_HOST_INDEX": "1",
+    })
+    assert plan.kill_host_at == 4 and plan.wedge_host_at == 6
+    assert plan.host_index == 1 and plan.any_active()
+    st = ChaosState(plan)
+    # wrong process: never fires
+    assert not st.host_kill_due(10, process_index=0)
+    # right process, before the step: not yet
+    assert not st.host_kill_due(3, process_index=1)
+    # fires exactly once
+    assert st.host_kill_due(4, process_index=1)
+    assert not st.host_kill_due(5, process_index=1)
+    assert st.host_wedge_due(6, process_index=1)
+    assert not st.host_wedge_due(7, process_index=1)
+
+
+# ----------------------------------------------------------------- lint
+def test_check_guarded_collectives_clean_on_repo():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_guarded_collectives.py"), REPO],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_guarded_collectives_detects_violations(tmp_path):
+    pkg = tmp_path / "mgproto_tpu" / "engine"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "from jax.experimental import multihost_utils\n"
+        "from mgproto_tpu.parallel.multihost import any_across_hosts\n"
+        "def f(x):\n"
+        "    multihost_utils.sync_global_devices('x')\n"
+        "    return any_across_hosts(x)\n"
+    )
+    (tmp_path / "mgproto_tpu" / "cli").mkdir()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_guarded_collectives.py"),
+         str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    out = proc.stdout
+    assert "multihost_utils" in out and "any_across_hosts" in out
+    assert "bad.py:1" in out and "bad.py:4" in out
+
+
+# --------------------------------------------------- summarize counter names
+def test_new_resilience_counters_registered_for_summarize():
+    """barrier_timeouts / peer_lost / elastic_restores ride the existing
+    ALL_COUNTERS summarize section — pre-registered zeros on every run."""
+    for name in ("missed_barriers_total", "peer_lost_total",
+                 "elastic_restores_total"):
+        assert name in res_metrics.ALL_COUNTERS
+    reg = MetricRegistry()
+    res_metrics.register_resilience_metrics(reg)
+    snap = reg.snapshot()
+    for name in ("missed_barriers_total", "peer_lost_total",
+                 "elastic_restores_total"):
+        assert name in snap
