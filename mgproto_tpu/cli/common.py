@@ -204,6 +204,19 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                         "PEER_LOST.json and exit 75 so launch_pod.sh "
                         "relaunches from the last committed checkpoint "
                         "(<= 0 disables; single-process runs ignore it)")
+    # fleet observatory (ISSUE 10): straggler detection under multi-host —
+    # the guarded barrier's arrival skew, EMA'd as a fraction of step time;
+    # a host that is the persistent last-arriver arms a targeted profiler
+    # capture on ITSELF only (obs/fleet.py)
+    p.add_argument("--straggler_threshold", type=float, default=0.25,
+                   help="skew-fraction EMA (arrival skew / step time) above "
+                        "which a persistent last-arriver host is flagged "
+                        "as a straggler and captures a trace of itself "
+                        "(<= 0 disables detection; the skew gauge still "
+                        "updates; single-process runs ignore it)")
+    p.add_argument("--straggler_patience", type=int, default=5,
+                   help="consecutive last-arriver barriers above the "
+                        "threshold before the straggler trigger fires")
     p.add_argument("--ckpt_format", default="auto",
                    choices=["auto", "sharded", "replicated"],
                    help="checkpoint format: 'sharded' = coordinated "

@@ -10,6 +10,16 @@ where did the wall time go (per-span totals). Accepts the run's model_dir
 too (falls back to its telemetry/ subdirectory). `--json` emits the summary
 as one JSON object for scripts; the default is an aligned text table.
 
+Fleet view (ISSUE 10): `mgproto-telemetry fleet <dir>` merges host 0's
+canonical stream with every `.h<pid>` sidecar (telemetry/session.py writes
+one per process under multi-host) into a per-host table — img/s, step p99,
+loader wait, barrier-wait fraction, arrival-skew fraction, heartbeat gaps,
+restarts, per-chip allgather bytes, flight-recorder dumps — plus fleet
+aggregates (slowest host, max skew, per-chip traffic: the weak-scaling
+instrument panel). `check` gains fleet gate entries against a committed
+baseline (`--write-baseline --fleet-gates`, e.g.
+evidence/fleet_baseline.json from the two-process dryrun drill).
+
 Host-side and jax-free: summarizing must work on a laptop with nothing but
 the run directory.
 """
@@ -23,16 +33,23 @@ from typing import Any, Dict, List, Optional
 
 from mgproto_tpu.telemetry.registry import percentile_from_buckets
 from mgproto_tpu.telemetry.session import (
+    ALLGATHER_BYTES_COUNTER,
     AUTOTUNE_REJECTED_COUNTER,
     BANK_OVERLAP_GAUGE,
+    BARRIER_WAIT_HIST,
+    COLLECTIVE_WAIT_HIST,
     DATA_SHM_SLABS_GAUGE,
     DATA_WAIT_GAUGE,
     EM_ACTIVE_GAUGE,
     EM_FALLBACK_COUNTER,
     HEALTH_FILE,
+    HEARTBEAT_AGE_GAUGE,
+    HOST_DEVICES_GAUGE,
     META_FILE,
     METRICS_FILE,
     PROM_FILE,
+    SKEW_GAUGE,
+    STRAGGLER_COUNTER,
     TRACE_FILE,
 )
 
@@ -357,6 +374,11 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
         name: _series_value(last, name)
         for name in ALL_COUNTERS
     }
+    # fleet health (ISSUE 10): heartbeat decay is visible here BEFORE a
+    # barrier timeout kills the run, next to the skew/straggler story
+    resilience[HEARTBEAT_AGE_GAUGE] = _series_value(last, HEARTBEAT_AGE_GAUGE)
+    resilience[SKEW_GAUGE] = _series_value(last, SKEW_GAUGE)
+    resilience[STRAGGLER_COUNTER] = _series_value(last, STRAGGLER_COUNTER)
     if any(v is not None for v in resilience.values()):
         summary["resilience"] = resilience
 
@@ -520,6 +542,228 @@ def render_table(summary: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# ----------------------------------------------------------------- fleet view
+# `mgproto-telemetry fleet <dir>`: the pod-scale counterpart of summarize.
+# Host 0 writes the canonical metrics.jsonl; every other process writes a
+# `.h<pid>` sidecar into the SAME (shared-FS) telemetry dir. The fleet view
+# joins them into one per-host table plus the aggregates ROADMAP item 1's
+# weak-scaling runs are read through: who is slowest, how skewed are
+# arrivals, how much barrier wait each host pays, and whether per-chip
+# allgather traffic stays flat as the fleet grows.
+
+def _host_metric_files(d: str) -> Dict[int, str]:
+    """{host index: metrics stream path}: the unsuffixed host-0 file plus
+    every `metrics.jsonl.h<pid>` sidecar."""
+    out: Dict[int, str] = {}
+    base = os.path.join(d, METRICS_FILE)
+    if os.path.isfile(base):
+        out[0] = base
+    prefix = METRICS_FILE + ".h"
+    try:
+        names = os.listdir(d)
+    except OSError:
+        names = []
+    for name in names:
+        if name.startswith(prefix) and name[len(prefix):].isdigit():
+            out[int(name[len(prefix):])] = os.path.join(d, name)
+    return out
+
+
+def _flightrec_dumps_by_host(d: str) -> Dict[int, List[str]]:
+    """Flight-recorder dump files grouped by host (`flightrec_*.jsonl` is
+    host 0's; `flightrec_*.h<pid>.jsonl` a sidecar's)."""
+    out: Dict[int, List[str]] = {}
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("flightrec_") and name.endswith(".jsonl")):
+            continue
+        stem, host = name[: -len(".jsonl")], 0
+        if ".h" in stem:
+            tail = stem.rsplit(".h", 1)[1]
+            if tail.isdigit():
+                host = int(tail)
+        out.setdefault(host, []).append(name)
+    return out
+
+
+def _hist_totals(last: Dict, name: str):
+    """(sum_seconds, count) of a histogram merged across label sets."""
+    h = _hist_series(last, name)
+    if not h or not h["count"]:
+        return 0.0, 0
+    return float(h["sum"]), int(h["count"])
+
+
+def _host_row(last: Dict) -> Dict[str, Any]:
+    """One host's line of the fleet table, from its latest snapshot."""
+    row: Dict[str, Any] = {
+        "images_per_sec": _series_value(last, "images_per_sec"),
+        "step_time_ema_seconds": _series_value(last, "step_time_ema_seconds"),
+        "loader_wait_fraction": _series_value(last, DATA_WAIT_GAUGE),
+        "host_step_skew_fraction": _series_value(last, SKEW_GAUGE),
+        "peer_heartbeat_age_seconds": _series_value(
+            last, HEARTBEAT_AGE_GAUGE
+        ),
+        "straggler_suspected": _series_value(last, STRAGGLER_COUNTER),
+        "restarts": (
+            (_series_value(last, "loader_worker_restarts_total") or 0.0)
+            + (_series_value(last, "train_rollbacks_total") or 0.0)
+        ),
+    }
+    hist = _hist_series(last, "step_time_seconds")
+    step_wall = 0.0
+    if hist and hist["count"]:
+        row["step_time_p99_seconds"] = percentile_from_buckets(hist, 99.0)
+        step_wall = float(hist["sum"])
+    barrier_s, barrier_n = _hist_totals(last, BARRIER_WAIT_HIST)
+    collective_s, _ = _hist_totals(last, COLLECTIVE_WAIT_HIST)
+    row["barrier_wait_seconds_sum"] = barrier_s
+    row["barrier_waits"] = barrier_n
+    row["collective_wait_seconds_sum"] = collective_s
+    # fraction of stepped wall time this host spent waiting at barriers —
+    # high on the FAST hosts when one peer straggles
+    row["barrier_wait_fraction"] = (
+        min(1.0, barrier_s / step_wall) if step_wall > 0 else 0.0
+    )
+    # barrier-ADJUSTED step time ("self time"): a straggler's peers absorb
+    # its delay as barrier wait inside their own step wall, so the raw
+    # step EMAs of a skewed fleet converge to the same number — subtracting
+    # each host's mean barrier wait per step is what actually ranks who is
+    # slow (the slowest_host aggregate sorts by this)
+    ema = row["step_time_ema_seconds"]
+    steps = _series_value(last, "steps_total")
+    if isinstance(ema, (int, float)):
+        per_step_wait = barrier_s / steps if steps else 0.0
+        row["self_step_time_seconds"] = max(float(ema) - per_step_wait, 0.0)
+    ag_bytes = _series_value(last, ALLGATHER_BYTES_COUNTER) or 0.0
+    devices = _series_value(last, HOST_DEVICES_GAUGE) or 1.0
+    row["allgather_bytes_total"] = ag_bytes
+    row["allgather_bytes_by_collective"] = _series_by_label(
+        last, ALLGATHER_BYTES_COUNTER, "collective"
+    )
+    row["allgather_bytes_per_chip"] = ag_bytes / max(devices, 1.0)
+    return row
+
+
+def fleet_summary(telemetry_dir: str) -> Dict[str, Any]:
+    """Per-host rows + fleet aggregates as one JSON-able dict."""
+    d = resolve_dir(telemetry_dir)
+    files = _host_metric_files(d)
+    dumps = _flightrec_dumps_by_host(d)
+    hosts: Dict[str, Dict[str, Any]] = {}
+    for pid in sorted(files):
+        snapshots = _read_jsonl(files[pid])
+        last = snapshots[-1].get("metrics", {}) if snapshots else {}
+        row = _host_row(last)
+        row["snapshots"] = len(snapshots)
+        row["flightrec_dumps"] = dumps.get(pid, [])
+        hosts[str(pid)] = row
+
+    def _vals(key):
+        return [
+            (pid, row[key]) for pid, row in hosts.items()
+            if isinstance(row.get(key), (int, float))
+        ]
+
+    fleet: Dict[str, Any] = {"hosts": len(hosts)}
+    emas = _vals("step_time_ema_seconds")
+    if emas:
+        fleet["slowest_step_time_ema_seconds"] = max(
+            v for _, v in emas
+        )
+        fleet["fastest_step_time_ema_seconds"] = min(v for _, v in emas)
+    # rank slowness by barrier-adjusted self time (see _host_row): the raw
+    # EMAs of a skewed fleet all include waiting for the straggler
+    selfs = _vals("self_step_time_seconds") or emas
+    if selfs:
+        fleet["slowest_host"] = int(max(selfs, key=lambda kv: kv[1])[0])
+    for key, out in (
+        ("host_step_skew_fraction", "max_skew_fraction"),
+        ("barrier_wait_fraction", "max_barrier_wait_fraction"),
+        ("peer_heartbeat_age_seconds", "max_heartbeat_age_seconds"),
+        ("allgather_bytes_per_chip", "allgather_bytes_per_chip"),
+    ):
+        vals = [v for _, v in _vals(key)]
+        if vals:
+            fleet[out] = max(vals)
+    straggler = sum(v for _, v in _vals("straggler_suspected"))
+    fleet["straggler_suspected_total"] = straggler
+    fleet["flightrec_dumps"] = sum(len(v) for v in dumps.values())
+    return {
+        "fleet_summary": True,
+        "telemetry_dir": os.path.abspath(d),
+        "hosts": hosts,
+        "fleet": fleet,
+    }
+
+
+_FLEET_COLUMNS = (
+    ("img/s", "images_per_sec"),
+    ("step_ema", "step_time_ema_seconds"),
+    ("step_p99", "step_time_p99_seconds"),
+    ("loader_wait", "loader_wait_fraction"),
+    ("barrier_wait", "barrier_wait_fraction"),
+    ("skew", "host_step_skew_fraction"),
+    ("hb_age", "peer_heartbeat_age_seconds"),
+    ("restarts", "restarts"),
+    ("straggler", "straggler_suspected"),
+    ("ag_B/chip", "allgather_bytes_per_chip"),
+)
+
+
+def render_fleet_table(fs: Dict[str, Any]) -> str:
+    lines = [f"telemetry dir  {fs['telemetry_dir']}"]
+    header = ["host"] + [label for label, _ in _FLEET_COLUMNS] + ["dumps"]
+    rows = [header]
+    for pid in sorted(fs["hosts"], key=int):
+        row = fs["hosts"][pid]
+        rows.append(
+            [pid]
+            + [_fmt(row.get(key)) for _, key in _FLEET_COLUMNS]
+            + [str(len(row.get("flightrec_dumps", [])))]
+        )
+    widths = [
+        max(len(str(r[i])) for r in rows) for i in range(len(header))
+    ]
+    for r in rows:
+        lines.append("  ".join(
+            f"{str(v):>{w}}" for v, w in zip(r, widths)
+        ))
+    lines.append("")
+    for k, v in sorted(fs["fleet"].items()):
+        lines.append(f"fleet.{k:<32}  {_fmt(v)}")
+    return "\n".join(lines)
+
+
+def fleet_main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="mgproto-telemetry fleet",
+        description="Merge host 0 + per-host telemetry sidecars into a "
+                    "per-host table with fleet aggregates",
+    )
+    p.add_argument("dir", help="telemetry dir (or a run dir containing "
+                               "telemetry/)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the fleet summary as one JSON object")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        raise SystemExit(f"not a directory: {args.dir}")
+    fs = fleet_summary(args.dir)
+    if not fs["hosts"]:
+        raise SystemExit(
+            f"no metrics.jsonl (or .h<pid> sidecars) under "
+            f"{resolve_dir(args.dir)}"
+        )
+    if args.json:
+        print(json.dumps(fs, indent=2))
+    else:
+        print(render_fleet_table(fs))
+    return 0
+
+
 # ---------------------------------------------------------- regression gate
 # `mgproto-telemetry check <dir> --baseline FILE`: compare a run's
 # summarized metrics against a committed baseline with tolerance bands and
@@ -544,6 +788,24 @@ DEFAULT_GATES = (
     ("serving.breaker_open_time_fraction", "lower", 0.0),
 )
 
+# fleet gate set (ISSUE 10; written by `--write-baseline --fleet-gates`,
+# committed as evidence/fleet_baseline.json from the two-process dryrun
+# drill): entries are 4-tuples with an ABSOLUTE band because the gated
+# values are machine-independent fractions/byte counts, and a clean
+# baseline value near zero makes a purely relative band meaningless. A
+# straggling host blows the skew/barrier-wait gates; per-chip allgather
+# traffic must stay flat-within-tolerance as the fleet grows (the
+# weak-scaling contract: 'equal', not 'lower' — silently LOSING traffic
+# would mean the gather stopped covering the bank).
+FLEET_GATES = (
+    ("fleet.max_skew_fraction", "lower", 0.0, 0.35),
+    ("fleet.max_barrier_wait_fraction", "lower", 0.0, 0.60),
+    # abs_tol must stay well under the baseline VALUE or the equal band
+    # could never catch traffic dropping to zero (it absorbs jitter near
+    # zero, nothing more; at real scale the relative band dominates)
+    ("fleet.allgather_bytes_per_chip", "equal", 0.25, 64.0),
+)
+
 
 def _lookup(summary: Dict[str, Any], dotted: str):
     node: Any = summary
@@ -554,11 +816,14 @@ def _lookup(summary: Dict[str, Any], dotted: str):
     return node
 
 
-def build_baseline(summary: Dict[str, Any]) -> Dict[str, Any]:
-    """A baseline record from a known-good run's summary: every default
-    gate whose key holds a number, frozen with its direction + band."""
+def build_baseline(summary: Dict[str, Any], gates=None) -> Dict[str, Any]:
+    """A baseline record from a known-good run's summary: every gate whose
+    key holds a number, frozen with its direction + band. Gate specs are
+    (key, direction, rel_tol[, abs_tol]) tuples."""
     entries = []
-    for key, direction, rel_tol in DEFAULT_GATES:
+    for spec in (DEFAULT_GATES if gates is None else gates):
+        key, direction, rel_tol = spec[0], spec[1], spec[2]
+        abs_tol = spec[3] if len(spec) > 3 else 0.0
         value = _lookup(summary, key)
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
@@ -567,7 +832,7 @@ def build_baseline(summary: Dict[str, Any]) -> Dict[str, Any]:
             "value": float(value),
             "direction": direction,
             "rel_tol": rel_tol,
-            "abs_tol": 0.0,
+            "abs_tol": abs_tol,
         })
     return {
         "telemetry_check_baseline": True,
@@ -631,14 +896,41 @@ def check_main(argv: Optional[list] = None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="summarize the dir and WRITE --baseline from it "
                         "(no checking)")
+    p.add_argument("--fleet-gates", action="store_true",
+                   help="with --write-baseline: freeze the FLEET gate set "
+                        "(max skew / barrier-wait fraction, per-chip "
+                        "allgather bytes) instead of the single-run "
+                        "defaults — the evidence/fleet_baseline.json "
+                        "workflow")
     p.add_argument("--json", action="store_true",
                    help="emit the check result as one JSON object")
     args = p.parse_args(argv)
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")
     summary = summarize(args.dir)
+    # fleet aggregates ride along only when the dir shows an actual FLEET
+    # (>= 2 host streams): a single-host run checked against a fleet
+    # baseline then fails LOUDLY on every fleet.* key ("metric missing")
+    # instead of passing vacuously on its pre-registered zeros. The cheap
+    # file probe gates the full sidecar parse — an ordinary single-host
+    # check never re-reads its metric stream for a fleet nobody has.
+    if len(_host_metric_files(resolve_dir(args.dir))) > 1:
+        summary["fleet"] = fleet_summary(args.dir)["fleet"]
     if args.write_baseline:
-        baseline = build_baseline(summary)
+        baseline = build_baseline(
+            summary, gates=FLEET_GATES if args.fleet_gates else None
+        )
+        if not baseline["entries"]:
+            # an empty baseline would make every later check pass
+            # vacuously ('checked: 0' is ok=True) — the fleet gate would
+            # be silently disabled forever. Refuse instead.
+            raise SystemExit(
+                "refusing to write an EMPTY baseline: no gate key resolved "
+                "to a number in this summary"
+                + (" (fleet.* gates need >= 2 host metric streams — did "
+                   "the drill write its sidecars into this dir?)"
+                   if args.fleet_gates else "")
+            )
         with open(args.baseline, "w") as f:
             json.dump(baseline, f, indent=2, sort_keys=True)
         print(f"wrote {len(baseline['entries'])} gate entries to "
@@ -677,11 +969,13 @@ def main(argv: Optional[list] = None) -> Optional[int]:
     # `mgproto-telemetry <dir>` keeps meaning summarize
     if argv and argv[0] == "check":
         return check_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        return fleet_main(argv[1:])
     if argv and argv[0] == "summarize":
         argv = argv[1:]
     p = argparse.ArgumentParser(
         description="Summarize an mgproto-tpu telemetry directory "
-                    "(subcommands: summarize [default], check)"
+                    "(subcommands: summarize [default], fleet, check)"
     )
     p.add_argument("dir", help="telemetry dir (or a run dir containing "
                                "telemetry/)")

@@ -108,6 +108,8 @@ def run_training(
     profile_out: str = "",
     barrier_timeout_s: float = 300.0,
     ckpt_format: str = "auto",
+    straggler_threshold: float = 0.25,
+    straggler_patience: int = 5,
 ):
     """Run the full schedule; returns (final_state, last_test_accuracy).
 
@@ -128,7 +130,17 @@ def run_training(
     into exit code `PEER_LOST_EXIT_CODE` for the launch_pod.sh relaunch
     loop); `ckpt_format` picks the checkpoint protocol ('auto' = the
     coordinated sharded format when multi-host, the replicated orbax format
-    otherwise)."""
+    otherwise).
+
+    Fleet observatory (ISSUE 10): under multi-host every process runs a
+    real TelemetrySession (host 0 keeps the canonical files, others write
+    `.h<pid>` sidecars into the shared telemetry dir), the guarded
+    barriers/collectives record wait histograms, and an obs.fleet
+    SkewMonitor watches barrier-arrival skew — a host that stays the last
+    arriver with skew-fraction EMA >= `straggler_threshold` for
+    `straggler_patience` barriers captures a profiler trace of ITSELF and
+    lands a `straggler_suspected` event on the flight recorder. Merge the
+    per-host story with `mgproto-telemetry fleet <telemetry-dir>`."""
     # resolve --resume FIRST: a typo'd path must fail fast, before any
     # data-pipeline or device work happens. 'auto' resumes only from
     # manifest-verified checkpoints (torn saves and .tmp dirs never qualify)
@@ -355,12 +367,18 @@ def run_training(
     )
     prev_recorder = set_recorder(recorder)
     window = None
-    if profile_steps or profile_on_anomaly:
+    if profile_steps or profile_on_anomaly or multihost:
         from mgproto_tpu.obs.stall import step_costs
 
+        # multi-host: the window also exists (unarmed, zero cost) as the
+        # straggler trigger's capture target — each host captures into its
+        # own subdirectory so a shared-FS profile_out never collides
+        base_out = profile_out or os.path.join(
+            "evidence", f"trace_{os.path.basename(cfg.model_dir) or 'run'}"
+        )
         window = ProfilerWindow(
-            out_dir=profile_out or os.path.join(
-                "evidence", f"trace_{os.path.basename(cfg.model_dir) or 'run'}"
+            out_dir=base_out if primary else os.path.join(
+                base_out, f"h{jax.process_index()}"
             ),
             steps=parse_step_range(profile_steps),
             on_anomaly=profile_on_anomaly,
@@ -371,6 +389,27 @@ def run_training(
             cost_provider=lambda: step_costs(cfg),
             log=log,
         )
+
+    # fleet straggler detection (ISSUE 10): observe every guarded barrier's
+    # arrival skew; a persistent last-arriver arms `window` on itself only.
+    # Single-host runs never construct one — the zero-extra-work path.
+    fleet_mon = None
+    prev_skew_observer = None
+    skew_observer_installed = False
+    if multihost:
+        from mgproto_tpu.obs.fleet import SkewMonitor
+        from mgproto_tpu.parallel.multihost import set_skew_observer
+
+        fleet_mon = SkewMonitor(
+            process_id=jax.process_index(),
+            window=window,
+            monitor=telem.monitor if telem else None,
+            threshold=straggler_threshold,
+            patience=straggler_patience,
+            log=log,
+        )
+        prev_skew_observer = set_skew_observer(fleet_mon.observe_barrier)
+        skew_observer_installed = True
 
     # recovery wiring: preemption flag (signal handlers, if any, are
     # installed by main(); chaos raises the same flag), active chaos state,
@@ -414,6 +453,7 @@ def run_training(
                     ood_loaders, log, metrics, telem, run_meta, img_dir,
                     render_push, target_accu, guard, skip_batches,
                     window=window, ckpt_sharded=ckpt_sharded,
+                    fleet=fleet_mon,
                 )
             except DivergenceError as e:
                 rollbacks += 1
@@ -534,6 +574,10 @@ def run_training(
         raise
     finally:
         clear_barrier()
+        if skew_observer_installed:
+            from mgproto_tpu.parallel.multihost import set_skew_observer
+
+            set_skew_observer(prev_skew_observer)
         if window is not None:
             window.close()  # never leave a device trace open
         set_recorder(prev_recorder)
@@ -554,7 +598,7 @@ def _run_epoch(
     cfg, trainer, state, epoch, start_epoch, profile_dir,
     train_loader, test_loader, push_loader, push_ds, ood_loaders,
     log, metrics, telem, run_meta, img_dir, render_push, target_accu,
-    guard=None, skip_batches=0, window=None, ckpt_sharded=None,
+    guard=None, skip_batches=0, window=None, ckpt_sharded=None, fleet=None,
 ):
     """One epoch of the reference main.py flow (train / test / conditional
     push), under an `epoch` tracing span so the stage spans nest.
@@ -587,6 +631,7 @@ def _run_epoch(
                 monitor=telem.monitor if telem else None,
                 guard=guard,
                 window=window,
+                fleet=fleet,
             )
         if last is not None:
             m = jax.device_get(last._asdict())
@@ -680,7 +725,10 @@ chaos-injection env knobs (fault drills; all off by default):
                                 host-crash drill (survivors must exit 75
                                 via the guarded-barrier timeout)
   MGPROTO_CHAOS_WEDGE_HOST_AT   same, but the process HANGS (stuck host)
-  MGPROTO_CHAOS_HOST_INDEX      restrict kill/wedge to this
+  MGPROTO_CHAOS_SLOW_HOST_MS    non-fatal straggler: the targeted process
+                                sleeps this many ms before EVERY step (the
+                                fleet skew monitor must name it)
+  MGPROTO_CHAOS_HOST_INDEX      restrict kill/wedge/slow to this
                                 jax.process_index() (-1: any process whose
                                 environment carries the knob)
 serving-side knobs (MGPROTO_CHAOS_SERVE_*): see `mgproto-serve --help`
@@ -728,6 +776,8 @@ def main(argv: Optional[list] = None) -> None:
             profile_out=args.profile_out,
             barrier_timeout_s=args.barrier_timeout_s,
             ckpt_format=args.ckpt_format,
+            straggler_threshold=args.straggler_threshold,
+            straggler_patience=args.straggler_patience,
         )
     except BarrierTimeoutError as e:
         # failure agreement: the marker + flight-recorder dump are already

@@ -580,7 +580,7 @@ class Trainer:
         return jax.device_put(out)
 
     def train_epoch(self, state, batches, epoch: int, monitor=None,
-                    guard=None, window=None):
+                    guard=None, window=None, fleet=None):
         """Drive one epoch over an iterable of (images, labels) host batches.
 
         Batches are device-prefetched (data/loader.py device_prefetch): batch
@@ -627,7 +627,13 @@ class Trainer:
         or anomaly triggers (spike vs EMA, recompile via `monitor`,
         loader-wait fraction). Every step also lands on the process flight
         recorder's ring, so a failure dump shows the steps leading up to
-        it."""
+        it.
+
+        `fleet` (an obs.fleet.SkewMonitor, multi-host runs only) gets each
+        step's wall time as its fallback step-EMA denominator — the
+        barrier-arrival skew it accumulates (via the multihost skew
+        observer) is reported as a FRACTION of step time, and must stay
+        meaningful even when telemetry is disabled."""
         from mgproto_tpu.data.loader import device_prefetch
         from mgproto_tpu.obs.flightrec import record_event
         from mgproto_tpu.parallel.multihost import heartbeat_tick
@@ -690,6 +696,8 @@ class Trainer:
             step_i += 1
             if window is not None:
                 window.on_step(step_s, wait_fraction=wait_frac)
+            if fleet is not None:
+                fleet.observe_step(step_s)
             em_max = (
                 last.em_active if em_max is None
                 else jnp.maximum(em_max, last.em_active)

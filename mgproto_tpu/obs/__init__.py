@@ -23,13 +23,21 @@ stall budget, and make the numbers enforceable:
   flightrec — bounded ring buffer of recent structured events (steps,
               dispatch triggers, breaker transitions, swaps, chaos
               injections, rollbacks) dumped to JSONL on divergence
-              rollback, preemption, replica death or crash.
+              rollback, preemption, replica death or crash. Every event
+              and dump carries this process's host index (multi-host dumps
+              are mergeable, `.h<pid>`-suffixed off host 0).
+  fleet     — `SkewMonitor`: per-barrier arrival-skew EMA from the guarded
+              barrier's seq-file arrival stamps; a persistent last-arriver host
+              fires the PR-8 anomaly trigger (targeted ProfilerWindow
+              capture on the straggling host only) and lands a
+              `straggler_suspected` event on the flight recorder.
 
 Everything here is host-side; `stall`'s cost-analysis path is the only
 module that touches jax, and only when asked to lower a program. The
 regression gate lives in `cli/telemetry.py` (`mgproto-telemetry check`).
 """
 
+from mgproto_tpu.obs.fleet import SkewMonitor
 from mgproto_tpu.obs.flightrec import (
     FlightRecorder,
     get_recorder,
@@ -39,6 +47,7 @@ from mgproto_tpu.obs.flightrec import (
 
 __all__ = [
     "FlightRecorder",
+    "SkewMonitor",
     "get_recorder",
     "record_event",
     "set_recorder",

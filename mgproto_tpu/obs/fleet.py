@@ -1,0 +1,161 @@
+"""Straggler detection: turn barrier-arrival skew into a targeted capture.
+
+At pod scale a slow HOST is indistinguishable from a slow MODEL unless the
+collective layer says who everyone waited for. The guarded barrier
+(parallel/multihost.py) already records every peer's arrival time for free
+— the seq files' arrival stamps — and hands them to the observer registered with
+`set_skew_observer`. `SkewMonitor` is that observer:
+
+  * per barrier it computes THIS host's arrival skew (my arrival minus the
+    earliest peer's) and whether this host was the LAST arriver;
+  * an EMA of the skew, normalized by the step-time EMA (the wired
+    telemetry StepMonitor's, else its own from `observe_step`), feeds the
+    `host_step_skew_fraction` gauge — the fleet table's headline number;
+  * when this host is the PERSISTENT last-arriver (skew-fraction EMA above
+    `threshold` for `patience` consecutive barriers), it fires ONCE: a
+    `straggler_suspected` event on the flight recorder, the
+    `straggler_suspected_total` counter, and — exactly like PR 8's anomaly
+    triggers — `ProfilerWindow.arm("straggler")`, so the trace capture
+    happens on the straggling host ONLY (off-TPU the window degrades to its
+    cost-analysis capture, keeping the whole path tier-1 testable).
+
+A non-last arriver resets the streak, and after a firing the monitor holds
+off for `cooldown` barriers so a persistently-skewed run cannot spend its
+epoch writing traces. Single-host runs never construct one (cli/train gates
+on process_count > 1), and the barrier layer only collects arrival stamps
+while an observer is registered — the zero-extra-work guard.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from mgproto_tpu.obs.flightrec import record_event
+from mgproto_tpu.telemetry.session import SKEW_GAUGE, STRAGGLER_COUNTER
+
+
+class SkewMonitor:
+    """Per-barrier arrival-skew EMA + persistent-last-arriver trigger.
+
+    Args:
+      process_id: this host's jax.process_index().
+      window: obs.profiler.ProfilerWindow to arm on detection (None: detect
+        and record, but capture nothing).
+      monitor: telemetry StepMonitor whose `ema_seconds` normalizes the
+        skew (None: the monitor keeps its own EMA from `observe_step`).
+      threshold: skew-fraction EMA that counts as "straggling" (<= 0
+        disables the trigger; the gauge still updates).
+      patience: consecutive last-arriver barriers above threshold before
+        firing.
+      cooldown: barriers to ignore after a firing.
+      ema_alpha: EMA weight for skew and the fallback step EMA.
+      log: optional line logger.
+    """
+
+    def __init__(
+        self,
+        process_id: int,
+        window=None,
+        monitor=None,
+        threshold: float = 0.25,
+        patience: int = 5,
+        cooldown: int = 200,
+        ema_alpha: float = 0.3,
+        log=None,
+    ):
+        self.process_id = int(process_id)
+        self.window = window
+        self.monitor = monitor
+        self.threshold = float(threshold)
+        self.patience = max(int(patience), 1)
+        self.cooldown = max(int(cooldown), 0)
+        self.ema_alpha = float(ema_alpha)
+        self.log = log
+        self.fired = 0  # straggler firings (this process)
+        self._skew_ema: Optional[float] = None
+        self._step_ema: Optional[float] = None  # fallback denominator
+        self._streak = 0
+        self._barriers = 0
+        self._cooldown_until = -1
+
+    # ------------------------------------------------------------------ state
+    @property
+    def skew_fraction(self) -> float:
+        """Current skew EMA / step-time EMA (the gauge's value)."""
+        step = self._step_seconds()
+        if not step or self._skew_ema is None:
+            return 0.0
+        return self._skew_ema / step
+
+    def _step_seconds(self) -> Optional[float]:
+        if self.monitor is not None:
+            ema = self.monitor.ema_seconds
+            if ema:
+                return float(ema)
+        return self._step_ema
+
+    def _ema(self, prev: Optional[float], value: float) -> float:
+        a = self.ema_alpha
+        return value if prev is None else a * value + (1 - a) * prev
+
+    # ------------------------------------------------------------------ hooks
+    def observe_step(self, seconds: float) -> None:
+        """Fallback step-time EMA for callers without a StepMonitor
+        (engine/train.py feeds this at step cadence either way — the wired
+        monitor, when present, simply wins as the denominator)."""
+        self._step_ema = self._ema(self._step_ema, float(seconds))
+
+    def observe_barrier(
+        self, name: str, arrivals: Dict[int, float], wait_s: float = 0.0
+    ) -> None:
+        """The `set_skew_observer` callback: one completed barrier's
+        per-peer arrival wall times (seq-file stamps)."""
+        self._barriers += 1
+        mine = arrivals.get(self.process_id)
+        if mine is None or len(arrivals) < 2:
+            return
+        first = min(arrivals.values())
+        last_pid = max(arrivals, key=lambda p: arrivals[p])
+        self._skew_ema = self._ema(self._skew_ema, mine - first)
+        frac = self.skew_fraction
+        self._set_gauge(frac)
+        if self.threshold <= 0:
+            return
+        if last_pid == self.process_id and frac >= self.threshold:
+            self._streak += 1
+        else:
+            self._streak = 0
+            return
+        if self._barriers < self._cooldown_until:
+            return
+        if self._streak >= self.patience:
+            self._fire(name, frac)
+
+    # --------------------------------------------------------------- internals
+    def _set_gauge(self, frac: float) -> None:
+        from mgproto_tpu.telemetry.registry import default_registry
+
+        default_registry().gauge(SKEW_GAUGE).set(frac)
+
+    def _fire(self, name: str, frac: float) -> None:
+        from mgproto_tpu.telemetry.registry import default_registry
+
+        self.fired += 1
+        self._streak = 0
+        self._cooldown_until = self._barriers + self.cooldown
+        default_registry().counter(STRAGGLER_COUNTER).inc()
+        record_event(
+            "straggler_suspected",
+            barrier=name,
+            skew_fraction=round(frac, 4),
+            skew_ema_s=round(self._skew_ema or 0.0, 6),
+            patience=self.patience,
+        )
+        if self.log:
+            self.log(
+                f"fleet: this host is the persistent last-arriver "
+                f"(skew fraction {frac:.2f} over {self.patience} barriers)"
+                + ("; arming profiler capture" if self.window else "")
+            )
+        if self.window is not None:
+            self.window.arm("straggler")

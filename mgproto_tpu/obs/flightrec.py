@@ -19,6 +19,15 @@ install/restore pattern as the telemetry registry and tracer.
 
 Events are host-side plain data; callers must `device_get` anything device-
 resident first (same contract as the metric registry).
+
+Multi-host identity (ISSUE 10): every event and dump header carries this
+process's fleet index (`host` = jax.process_index, `pid` = OS pid), and a
+non-zero host's dump files take a `.h<host>` suffix
+(`flightrec_<reason>_<n>.h<host>.jsonl`, the PR-9 log-suffix convention) —
+so a pod-wide PEER_LOST dump into the shared telemetry dir yields one
+mergeable, attributable file per host instead of an overwrite race.
+`mgproto-telemetry fleet` lists the dumps per host. Single process resolves
+to host 0: unsuffixed names, exactly the pre-fleet behavior.
 """
 
 from __future__ import annotations
@@ -35,6 +44,14 @@ from mgproto_tpu.telemetry.tracing import _jsonable
 DEFAULT_CAPACITY = 512
 
 
+def _default_host() -> int:
+    """This process's fleet index (the shared telemetry.session definition:
+    best-effort, host 0 in jax-free processes)."""
+    from mgproto_tpu.telemetry.session import resolve_host
+
+    return resolve_host()
+
+
 class FlightRecorder:
     """Ring buffer of recent events + dump-to-JSONL on failure."""
 
@@ -43,12 +60,15 @@ class FlightRecorder:
         capacity: int = DEFAULT_CAPACITY,
         clock=time.time,
         dump_dir: Optional[str] = None,
+        host: Optional[int] = None,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.clock = clock
         self.dump_dir = dump_dir
+        self.host = _default_host() if host is None else int(host)
+        self.pid = os.getpid()
         self._events: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._seq = 0  # total events recorded (survives ring eviction)
@@ -62,6 +82,8 @@ class FlightRecorder:
         evt: Dict[str, Any] = {
             "ts": float(self.clock()),
             "kind": str(kind),
+            "host": self.host,
+            "pid": self.pid,
         }
         for k, v in fields.items():
             evt[k] = _jsonable(v)
@@ -97,6 +119,8 @@ class FlightRecorder:
             "events": len(events),
             "recorded_total": self.recorded_total,
             "capacity": self.capacity,
+            "host": self.host,
+            "pid": self.pid,
         }
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         tmp = path + ".tmp"
@@ -118,8 +142,11 @@ class FlightRecorder:
         with self._lock:
             n = self._dumps
             self._dumps += 1
+        # host 0 keeps the unsuffixed name; other hosts suffix theirs so a
+        # pod-wide dump into the shared telemetry dir never collides
+        suffix = f".h{self.host}" if self.host > 0 else ""
         path = os.path.join(
-            self.dump_dir, f"flightrec_{reason}_{n:03d}.jsonl"
+            self.dump_dir, f"flightrec_{reason}_{n:03d}{suffix}.jsonl"
         )
         out = self.dump(path, reason)
         self.dumped.append(out)

@@ -16,15 +16,19 @@ evidence source:
     cost analysis supplies FLOPs + bytes accessed, and a roofline model
     apportions a (measured or modeled) step time.
 
-Both paths emit the same report: step time split into four buckets that sum
+Both paths emit the same report: step time split into five buckets that sum
 to ~100% —
 
-    mxu_busy    time the matrix units are doing the program's FLOPs
-    hbm_bound   bandwidth time NOT hidden behind compute (bytes/BW minus
-                the compute it could overlap; the roofline's memory wall)
-    host_infeed host + input-pipeline time the device sat waiting
-    bubble      everything else (scheduling gaps, launch latency, the
-                residual between model and measurement)
+    mxu_busy        time the matrix units are doing the program's FLOPs
+    hbm_bound       bandwidth time NOT hidden behind compute (bytes/BW minus
+                    the compute it could overlap; the roofline's memory wall)
+    collective_wait cross-host/chip collective time (all-reduce/all-gather
+                    ops on the trace, or an externally measured host-side
+                    barrier/collective wait — ISSUE 10's fleet dimension;
+                    the cost fallback reports ZERO on one host)
+    host_infeed     host + input-pipeline time the device sat waiting
+    bubble          everything else (scheduling gaps, launch latency, the
+                    residual between model and measurement)
 
 — plus measured vs attainable MFU in the PERF.md decomposition (the
 attainable bound defaults to the committed
@@ -47,15 +51,23 @@ DEFAULT_PEAK_FLOPS = 197e12
 DEFAULT_HBM_BYTES_PER_S = 819e9
 DEFAULT_ATTAINABLE_MFU = 0.886  # PERF.md structural ceiling (see below)
 
-BUCKETS = ("mxu_busy", "hbm_bound", "host_infeed", "bubble")
+BUCKETS = (
+    "mxu_busy", "hbm_bound", "collective_wait", "host_infeed", "bubble"
+)
 
 # ---------------------------------------------------------------- trace side
-# device-op name -> bucket. Checked in order; first hit wins. The MXU list
-# is deliberately ahead of the HBM list: a fusion named "fusion.conv..."
-# is matrix work even though plain "fusion" defaults to bandwidth-bound.
+# device-op name -> bucket. Checked in order; first hit wins. Collectives
+# come before HBM ("all-gather" contains the HBM token "gather") and before
+# MXU; the MXU list is ahead of the HBM list: a fusion named
+# "fusion.conv..." is matrix work even though plain "fusion" defaults to
+# bandwidth-bound.
 _HOST_TOKENS = (
     "infeed", "outfeed", "host", "transfer", "copy-start", "copy-done",
     "send", "recv",
+)
+_COLLECTIVE_TOKENS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective", "psum", "ppermute",
 )
 _MXU_TOKENS = (
     "convolution", "conv", "dot", "matmul", "gemm", "mxu", "einsum",
@@ -64,7 +76,7 @@ _MXU_TOKENS = (
 _HBM_TOKENS = (
     "copy", "scatter", "gather", "reduce", "broadcast", "transpose",
     "select", "concatenate", "slice", "pad", "iota", "sort", "fusion",
-    "all-reduce", "all-gather", "reduce-scatter", "bitcast", "compare",
+    "bitcast", "compare",
     "loop", "while", "dynamic-update",
 )
 
@@ -75,6 +87,9 @@ def classify_op(name: str) -> str:
     for tok in _HOST_TOKENS:
         if tok in n:
             return "host_infeed"
+    for tok in _COLLECTIVE_TOKENS:
+        if tok in n:
+            return "collective_wait"
     for tok in _MXU_TOKENS:
         if tok in n:
             return "mxu_busy"
@@ -230,19 +245,23 @@ def roofline_buckets(
     bytes_accessed: float,
     step_time_s: Optional[float] = None,
     host_infeed_s: float = 0.0,
+    collective_wait_s: float = 0.0,
     peak_flops: float = DEFAULT_PEAK_FLOPS,
     hbm_bytes_per_s: float = DEFAULT_HBM_BYTES_PER_S,
 ) -> Dict[str, Any]:
     """Apportion a step via the roofline: compute time is flops/peak, the
-    HBM bucket is the bandwidth time compute cannot hide, host time is
-    whatever the caller measured.
+    HBM bucket is the bandwidth time compute cannot hide, host and
+    collective time are whatever the caller measured (`collective_wait_s`
+    is e.g. telemetry's per-step barrier+collective wait; the single-host
+    cost fallback passes nothing and the line item reports ZERO, keeping
+    the schema identical across fleet sizes).
 
     A MEASURED `step_time_s` is GROUND TRUTH: the buckets partition it
     exactly. The bandwidth model is an upper bound on stall time (XLA's
     bytes-accessed is fusion-pessimistic, especially on the CPU backend),
-    so the HBM bucket is clamped into the measured residual after compute
-    and host time; whatever the bandwidth model cannot claim is the
-    bubble. `hbm_model_clamped` flags when the clamp bit (the model had
+    so the HBM bucket is clamped into the measured residual after compute,
+    host and collective time; whatever the bandwidth model cannot claim is
+    the bubble. `hbm_model_clamped` flags when the clamp bit (the model had
     MORE traffic than the residual — read the HBM bucket as "at least
     this bound-ness", not a precise stall count). Without a measurement
     the modeled sum stands in (bubble 0) and the report says so.
@@ -251,27 +270,30 @@ def roofline_buckets(
     hbm_total_s = bytes_accessed / hbm_bytes_per_s if hbm_bytes_per_s else 0.0
     hbm_raw_s = max(hbm_total_s - mxu_s, 0.0)
     host_s = max(float(host_infeed_s), 0.0)
+    coll_s = max(float(collective_wait_s), 0.0)
     measured = step_time_s is not None
+    floor = mxu_s + host_s + coll_s
     if measured:
-        # a step cannot be shorter than its compute + host floor; a
-        # measurement below it means the peaks are mis-set, and the floor
-        # wins so the partition stays consistent
-        total = max(float(step_time_s), mxu_s + host_s)
-        hbm_s = min(hbm_raw_s, max(total - mxu_s - host_s, 0.0))
+        # a step cannot be shorter than its compute + host + collective
+        # floor; a measurement below it means the peaks are mis-set, and
+        # the floor wins so the partition stays consistent
+        total = max(float(step_time_s), floor)
+        hbm_s = min(hbm_raw_s, max(total - floor, 0.0))
     else:
-        total = mxu_s + hbm_raw_s + host_s
+        total = floor + hbm_raw_s
         hbm_s = hbm_raw_s
     buckets = {
         "mxu_busy": mxu_s,
         "hbm_bound": hbm_s,
+        "collective_wait": coll_s,
         "host_infeed": host_s,
-        "bubble": max(total - mxu_s - hbm_s - host_s, 0.0),
+        "bubble": max(total - mxu_s - hbm_s - host_s - coll_s, 0.0),
     }
     return {
         "source": "cost_analysis",
         "step_time_s": total,
         "step_time_measured": measured,
-        "modeled_step_time_s": mxu_s + hbm_raw_s + host_s,
+        "modeled_step_time_s": floor + hbm_raw_s,
         "hbm_total_s": hbm_total_s,
         "hbm_model_clamped": measured and hbm_raw_s > hbm_s,
         "buckets": _fractions(buckets, total),

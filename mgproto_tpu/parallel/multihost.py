@@ -32,6 +32,22 @@ code (or the marker appearing on the shared FS) by relaunching everyone
 from the last committed checkpoint. Unconfigured (or single-process), every
 guard call is a no-op, so library users pay nothing.
 
+Wait attribution (ISSUE 10): every guarded barrier and host collective is
+TIMED into the process-current registry — `barrier_wait_seconds{barrier=}`
+(time from this host's arrival until the last peer shows up),
+`collective_wait_seconds{collective=}` (whole-call wall time of
+allgather_sum/allgather_rows), `allgather_bytes_total{collective=}` (bytes
+gathered to this host — the weak-scaling per-chip traffic deliverable) and
+`peer_heartbeat_age_seconds` (max peer heartbeat age sampled at barrier
+entry, so heartbeat decay is visible BEFORE a timeout kills the run). A
+completed barrier already knows every peer's arrival time for free — the
+seq files' arrival stamps (each peer writes its time.time() into its
+file; mtime is the fallback) — so per-peer arrival skew is derived there and
+handed to the registered skew observer (`set_skew_observer`;
+obs/fleet.SkewMonitor), which turns a persistent last-arriver into a
+targeted profiler capture. Single process: the existing early returns skip
+ALL of it (one process-count check, nothing else).
+
 Reference: none — the reference is single-process (SURVEY.md §2.3); this is
 the scaffolding its NCCL/torch.distributed story never grew.
 """
@@ -42,10 +58,46 @@ import dataclasses
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
+
+# ISSUE 10 wait attribution: per-barrier arrival observer (obs/fleet.py's
+# SkewMonitor registers here). Called as fn(name, arrivals, wait_s) with
+# arrivals = {process_id: arrival wall time} read from the completed
+# barrier's seq-file arrival stamps. None = nobody watching (zero extra
+# reads).
+_SKEW_OBSERVER: Optional[Callable[[str, Dict[int, float], float], None]] = None
+
+
+def set_skew_observer(
+    fn: Optional[Callable[[str, Dict[int, float], float], None]],
+) -> Optional[Callable[[str, Dict[int, float], float], None]]:
+    """Install the per-barrier arrival-skew observer (None uninstalls);
+    returns the previous one so callers can restore it."""
+    global _SKEW_OBSERVER
+    prev = _SKEW_OBSERVER
+    _SKEW_OBSERVER = fn
+    return prev
+
+
+def _observe_collective(name: str, seconds: float, nbytes: int = 0) -> None:
+    """Record one host-collective call into the process-current registry
+    (collective_wait_seconds + allgather_bytes_total). Only reached on the
+    real multi-process branches — single-host pays nothing."""
+    from mgproto_tpu.telemetry.registry import default_registry
+    from mgproto_tpu.telemetry.session import (
+        ALLGATHER_BYTES_COUNTER,
+        COLLECTIVE_WAIT_HIST,
+    )
+
+    r = default_registry()
+    r.histogram(COLLECTIVE_WAIT_HIST).observe(
+        float(seconds), collective=name
+    )
+    if nbytes:
+        r.counter(ALLGATHER_BYTES_COUNTER).inc(float(nbytes), collective=name)
 
 
 def is_primary_host() -> bool:
@@ -77,11 +129,14 @@ def allgather_rows(x: np.ndarray) -> np.ndarray:
     identity."""
     if jax.process_count() == 1:
         return x
+    t0 = time.monotonic()
     guarded_barrier("allgather_rows")
     from jax.experimental import multihost_utils
 
     stacked = multihost_utils.process_allgather(np.asarray(x))
-    return np.concatenate(list(stacked), axis=0)
+    out = np.concatenate(list(stacked), axis=0)
+    _observe_collective("allgather_rows", time.monotonic() - t0, out.nbytes)
+    return out
 
 
 def _f64_to_wire(x: float) -> np.ndarray:
@@ -108,11 +163,14 @@ def allgather_sum(x: float) -> float:
     process: identity."""
     if jax.process_count() == 1:
         return float(x)
+    t0 = time.monotonic()
     guarded_barrier("allgather_sum")
     from jax.experimental import multihost_utils
 
     stacked = np.asarray(multihost_utils.process_allgather(_f64_to_wire(x)))
-    return float(sum(_f64_from_wire(row) for row in stacked))
+    out = float(sum(_f64_from_wire(row) for row in stacked))
+    _observe_collective("allgather_sum", time.monotonic() - t0, stacked.nbytes)
+    return out
 
 
 def any_across_hosts(flag: bool) -> bool:
@@ -377,6 +435,58 @@ def _on_barrier_timeout(g: BarrierGuard, name: str, missing: List[int]):
     raise BarrierTimeoutError(name, missing, g.timeout_s)
 
 
+def _sample_heartbeat_age(g: BarrierGuard) -> None:
+    """Max PEER heartbeat age -> the `peer_heartbeat_age_seconds` gauge,
+    sampled at barrier entry (ISSUE 10 satellite): heartbeat decay becomes
+    visible in telemetry BEFORE a stale peer turns into a barrier timeout."""
+    from mgproto_tpu.telemetry.registry import default_registry
+    from mgproto_tpu.telemetry.session import HEARTBEAT_AGE_GAUGE
+
+    ages = [
+        a for pid, a in peer_heartbeat_ages().items()
+        if pid != g.process_id and a is not None
+    ]
+    if ages:
+        default_registry().gauge(HEARTBEAT_AGE_GAUGE).set(max(ages))
+
+
+def _observe_barrier_wait(
+    g: BarrierGuard, name: str, seq: int, wait_s: float
+) -> None:
+    """Post-completion accounting: the wait histogram, and — when a skew
+    observer is registered — per-peer arrival times from the completed
+    barrier's seq files (each peer already recorded WHEN it arrived:
+    `guarded_barrier` writes its `time.time()` INTO `<name>.<seq>.h<pid>`,
+    so last-arriver identity and skew magnitude come for free; the file's
+    mtime is only the fallback — shared-FS mtime granularity can be a full
+    second, far coarser than the skews the monitor must resolve).
+    Observation must never fail a barrier."""
+    from mgproto_tpu.telemetry.registry import default_registry
+    from mgproto_tpu.telemetry.session import BARRIER_WAIT_HIST
+
+    default_registry().histogram(BARRIER_WAIT_HIST).observe(
+        wait_s, barrier=name
+    )
+    obs = _SKEW_OBSERVER
+    if obs is None:
+        return
+    arrivals: Dict[int, float] = {}
+    for pid in range(g.num_processes):
+        path = g._file(name, seq, pid)
+        try:
+            with open(path) as f:
+                arrivals[pid] = float(f.read().strip())
+        except (OSError, ValueError):
+            try:
+                arrivals[pid] = os.path.getmtime(path)
+            except OSError:
+                pass  # already reaped on a slow observer
+    try:
+        obs(name, arrivals, wait_s)
+    except Exception:
+        pass
+
+
 def guarded_barrier(name: str) -> None:
     """Block until every process reaches this named barrier, or raise
     `BarrierTimeoutError` after `timeout_s` listing the missing peers.
@@ -389,10 +499,12 @@ def guarded_barrier(name: str) -> None:
     seq = g._seq.get(name, 0)
     g._seq[name] = seq + 1
     heartbeat_tick()
+    _sample_heartbeat_age(g)
     mine = g._file(name, seq, g.process_id)
     with open(mine, "w") as f:
         f.write(str(time.time()))
-    deadline = time.monotonic() + g.timeout_s
+    t_arrived = time.monotonic()
+    deadline = t_arrived + g.timeout_s
     while True:
         missing = [
             pid for pid in range(g.num_processes)
@@ -403,6 +515,7 @@ def guarded_barrier(name: str) -> None:
         if time.monotonic() > deadline:
             _on_barrier_timeout(g, name, missing)
         time.sleep(g.poll_s)
+    _observe_barrier_wait(g, name, seq, time.monotonic() - t_arrived)
     # barrier `seq` completed globally: every peer has SEEN all files of
     # this seq, so our own files from earlier seqs can never be awaited
     # again — reap them to bound the shared directory's growth

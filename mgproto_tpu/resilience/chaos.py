@@ -57,6 +57,15 @@ agreement instead of deadlock; MGPROTO_CHAOS_HOST_INDEX targets a specific
 jax.process_index() (-1 = any process whose environment carries the knob —
 the two-process harness sets it on the victim only). One-shot each, hooked
 in `resilience.guard.EpochGuard.wrap_batches`.
+
+MGPROTO_CHAOS_SLOW_HOST_MS (ISSUE 10) is the non-fatal sibling: the
+targeted process sleeps that many milliseconds before EVERY step — a
+chaos-wedged STRAGGLER, not a dead host. The guarded barrier keeps
+completing (nobody times out), but every peer waits for the victim each
+step, which is exactly what the fleet observatory must attribute: the
+barrier-wait histograms fill on the FAST hosts, the arrival-skew monitor
+names the victim, and the straggler trigger captures a trace on the victim
+only. The injection counter fires once (the delay itself repeats).
 """
 
 from __future__ import annotations
@@ -125,9 +134,13 @@ class ChaosPlan:
     # of deadlocking in the next collective. One-shot each.
     kill_host_at: Optional[int] = None
     wedge_host_at: Optional[int] = None
-    # which jax.process_index() the kill/wedge targets; -1 = any process
-    # whose env carries the knob (the two-process harness sets the knob in
-    # the victim's environment only)
+    # non-fatal straggler (ISSUE 10): the targeted process sleeps this many
+    # milliseconds before every step — the fleet observatory's skew/wait
+    # attribution must name it (repeats every step, counter fires once)
+    slow_host_ms: float = 0.0
+    # which jax.process_index() the kill/wedge/slow targets; -1 = any
+    # process whose env carries the knob (the two-process harness sets the
+    # knob in the victim's environment only)
     host_index: int = -1
 
     def any_active(self) -> bool:
@@ -145,6 +158,7 @@ class ChaosPlan:
             or self.serve_swap_bad_artifact > 0
             or self.kill_host_at is not None
             or self.wedge_host_at is not None
+            or self.slow_host_ms > 0.0
         )
 
 
@@ -170,6 +184,7 @@ class ChaosState:
         self._bad_swaps_left = int(plan.serve_swap_bad_artifact)
         self._host_kill_fired = False
         self._host_wedge_fired = False
+        self._host_slow_counted = False
 
     def _count(self, kind: str) -> None:
         from mgproto_tpu.obs.flightrec import record_event
@@ -362,6 +377,25 @@ class ChaosState:
             process_index, "host_wedge",
         )
 
+    def host_slow_s(self, global_step: int, process_index: int) -> float:
+        """Per-step straggler delay (seconds) for the targeted process —
+        0.0 everywhere else. Unlike kill/wedge this is NOT one-shot (a
+        straggler straggles every step); the injection counter fires once
+        so the chaos accounting stays bounded."""
+        ms = self.plan.slow_host_ms
+        if ms <= 0.0:
+            return 0.0
+        if self.plan.host_index >= 0 and (
+            process_index != self.plan.host_index
+        ):
+            return 0.0
+        with self._lock:
+            counted = self._host_slow_counted
+            self._host_slow_counted = True
+        if not counted:
+            self._count("host_slow")
+        return ms / 1000.0
+
     # ---------------------------------------------------------- checkpoint IO
     def checkpoint_should_fail(self) -> bool:
         with self._lock:
@@ -439,6 +473,7 @@ def plan_from_env(environ=None) -> Optional[ChaosPlan]:
         ),
         kill_host_at=_get("MGPROTO_CHAOS_KILL_HOST_AT", int, None),
         wedge_host_at=_get("MGPROTO_CHAOS_WEDGE_HOST_AT", int, None),
+        slow_host_ms=_get("MGPROTO_CHAOS_SLOW_HOST_MS", float, 0.0),
         host_index=_get("MGPROTO_CHAOS_HOST_INDEX", int, -1),
     )
     return plan if plan.any_active() else None

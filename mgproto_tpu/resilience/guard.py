@@ -135,6 +135,14 @@ class EpochGuard:
 
                     while True:  # a stuck host: alive, silent, not stepping
                         time.sleep(3600)
+                slow_s = self.chaos.host_slow_s(global_step, pid)
+                if slow_s > 0.0:
+                    # non-fatal straggler (ISSUE 10): this host limps
+                    # behind every step — the fleet skew monitor, not the
+                    # barrier timeout, must be what names it
+                    import time
+
+                    time.sleep(slow_s)
                 if self.chaos.preempt_due(global_step) and (
                     self.preemption is not None
                 ):

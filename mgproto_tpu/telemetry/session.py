@@ -8,10 +8,17 @@ recorder, and writes a telemetry directory:
     health.jsonl    one ModelHealth record per epoch
     trace.json      Chrome-trace export of the span tracer
 
-Multi-host: every process computes (SPMD steps and the scalar health
-diagnostics need all hosts), but ONLY host 0 sinks to disk — the other
-processes keep their writers None, so the artifact set is exactly one
-directory per run, not one per host. Cross-host throughput goes through
+Multi-host (ISSUE 10 fleet observatory): every process computes (SPMD steps
+and the scalar health diagnostics need all hosts), and every process SINKS —
+host 0 keeps the canonical unsuffixed files (all existing tooling reads
+them unchanged), while process p > 0 writes host-tagged SIDECAR streams
+next to them (`metrics.jsonl.h<p>`, `metrics.prom.h<p>`, `health.jsonl.h<p>`,
+`trace.json.h<p>` — the PR-9 log-suffix convention). Every JSONL snapshot
+record carries a top-level `host` field so merged streams stay
+attributable; `mgproto-telemetry fleet` joins host 0 + sidecars into the
+per-host table. meta.json stays host-0-only (run config is run-wide).
+Single process resolves to host 0 and takes the exact pre-sidecar path —
+no suffix, no extra work. Cross-host throughput goes through
 `parallel.multihost.allgather_sum` in `end_epoch` (every process must call
 it: it is a collective).
 """
@@ -56,11 +63,40 @@ AUTOTUNE_REJECTED_COUNTER = "autotune_plan_rejected_total"
 DATA_WAIT_GAUGE = "loader_wait_fraction"
 DATA_SHM_SLABS_GAUGE = "loader_shm_slabs_in_use"
 
+# fleet observatory (ISSUE 10): cross-host wait attribution + straggler
+# detection. The histograms are fed by parallel/multihost.py's instrumented
+# barrier/collective wrappers (labels: barrier=<name> / collective=<name>),
+# the skew gauge + straggler counter by obs/fleet.py's SkewMonitor, the
+# heartbeat gauge at every guarded-barrier entry. Pre-registered so a
+# single-host (or skew-free) run reports explicit zeros and
+# `mgproto-telemetry fleet` / `check` can always see the series.
+BARRIER_WAIT_HIST = "barrier_wait_seconds"
+COLLECTIVE_WAIT_HIST = "collective_wait_seconds"
+SKEW_GAUGE = "host_step_skew_fraction"
+HEARTBEAT_AGE_GAUGE = "peer_heartbeat_age_seconds"
+STRAGGLER_COUNTER = "straggler_suspected_total"
+ALLGATHER_BYTES_COUNTER = "allgather_bytes_total"
+HOST_DEVICES_GAUGE = "host_local_device_count"
+
 
 def _is_primary_host() -> bool:
     from mgproto_tpu.parallel.multihost import is_primary_host
 
     return is_primary_host()
+
+
+def resolve_host() -> int:
+    """This process's fleet index: jax.process_index() under multi-host, 0
+    otherwise (the zero-extra-work single-host path). Best-effort — jax-free
+    processes (serving-side tooling, obs/flightrec) resolve to host 0
+    instead of failing over identity. The ONE definition; the flight
+    recorder shares it."""
+    try:
+        import jax
+
+        return int(jax.process_index()) if jax.process_count() > 1 else 0
+    except Exception:
+        return 0
 
 
 class TelemetrySession:
@@ -70,6 +106,7 @@ class TelemetrySession:
         registry: Optional[MetricRegistry] = None,
         tracer: Optional[Tracer] = None,
         primary: Optional[bool] = None,
+        host: Optional[int] = None,
     ):
         self.out_dir = out_dir
         # a FRESH registry/tracer per session (unless the caller brings
@@ -86,13 +123,25 @@ class TelemetrySession:
         self._prev_registry = set_current_registry(self.registry)
         self._prev_tracer = set_current_tracer(self.tracer)
         self.primary = _is_primary_host() if primary is None else bool(primary)
+        # fleet sidecars (ISSUE 10): host 0 owns the canonical unsuffixed
+        # artifacts; host p > 0 writes the same streams with a `.h<p>`
+        # suffix (run-wide model_dir is shared under multi-host, so they
+        # all land in ONE telemetry dir). A session constructed with
+        # primary=False and no explicit host (the pre-fleet contract, and
+        # any single-process caller) keeps its writers None.
+        self.host = resolve_host() if host is None else int(host)
+        self.host_suffix = f".h{self.host}" if self.host > 0 else ""
         self._closed = False
         metrics_writer = None
         health_writer = None
-        if self.primary:
+        if self.primary or self.host > 0:
             os.makedirs(out_dir, exist_ok=True)
-            metrics_writer = JsonlWriter(os.path.join(out_dir, METRICS_FILE))
-            health_writer = JsonlWriter(os.path.join(out_dir, HEALTH_FILE))
+            metrics_writer = JsonlWriter(
+                os.path.join(out_dir, METRICS_FILE + self.host_suffix)
+            )
+            health_writer = JsonlWriter(
+                os.path.join(out_dir, HEALTH_FILE + self.host_suffix)
+            )
         self._metrics_writer = metrics_writer
         self.monitor = StepMonitor(registry=self.registry)
         self.health = ModelHealth(registry=self.registry, writer=health_writer)
@@ -135,6 +184,52 @@ class TelemetrySession:
             "auto-tuner candidate plans rejected as over the HBM budget",
         )
         self._c_autotune_rejected.inc(0.0)
+        # fleet observatory (ISSUE 10): barrier/collective wait attribution
+        # + straggler detection. Histograms are registered name-only (their
+        # series appear when a guarded barrier actually runs); the scalars
+        # carry explicit zeros so single-host runs report "no skew", not
+        # an absent metric.
+        self.registry.histogram(
+            BARRIER_WAIT_HIST,
+            "per-call guarded-barrier wait, labeled barrier=<name>",
+        )
+        self.registry.histogram(
+            COLLECTIVE_WAIT_HIST,
+            "per-call host collective wall time (barrier + gather), "
+            "labeled collective=<name>",
+        )
+        self.registry.gauge(
+            SKEW_GAUGE,
+            "EMA of this host's barrier-arrival skew as a fraction of the "
+            "step-time EMA (0 = never the late arriver)",
+        ).set(0.0)
+        self.registry.gauge(
+            HEARTBEAT_AGE_GAUGE,
+            "max peer heartbeat age sampled at guarded-barrier entry "
+            "(heartbeat decay is visible BEFORE a barrier timeout)",
+        ).set(0.0)
+        self.registry.counter(
+            STRAGGLER_COUNTER,
+            "times the skew monitor flagged THIS host as the persistent "
+            "last-arriver (each firing arms a targeted profiler capture)",
+        ).inc(0.0)
+        self.registry.counter(
+            ALLGATHER_BYTES_COUNTER,
+            "bytes gathered to this host by the instrumented host-side "
+            "collectives, labeled collective=<name> (the weak-scaling "
+            "per-chip bank/EM traffic deliverable)",
+        ).inc(0.0)
+        g_dev = self.registry.gauge(
+            HOST_DEVICES_GAUGE,
+            "devices addressed by this process (per-chip normalizer for "
+            "the fleet table)",
+        )
+        try:
+            import jax
+
+            g_dev.set(float(jax.local_device_count()))
+        except Exception:
+            g_dev.set(1.0)
 
     def observe_em(self, active_classes: float, compact_fallbacks: float = 0.0):
         """Record one epoch's EM fast-path outcome (host floats — callers
@@ -177,15 +272,22 @@ class TelemetrySession:
 
     # ------------------------------------------------------------------ sinks
     def flush(self, step: Optional[int] = None, extra: Optional[Dict] = None):
-        """Write the current registry + trace state (primary host only)."""
-        if not self.primary or self._closed:
+        """Write the current registry + trace state. Host 0 writes the
+        canonical files; host p > 0 its `.h<p>` sidecars; a sink-less
+        session (primary=False, host 0) writes nothing. Every snapshot
+        record carries the host index so merged streams stay attributable."""
+        if self._metrics_writer is None or self._closed:
             return
-        self.registry.write_prometheus(os.path.join(self.out_dir, PROM_FILE))
-        if self._metrics_writer is not None:
-            write_jsonl_snapshot(
-                self.registry, self._metrics_writer, step=step, extra=extra
-            )
-        self.tracer.export_chrome_trace(os.path.join(self.out_dir, TRACE_FILE))
+        self.registry.write_prometheus(
+            os.path.join(self.out_dir, PROM_FILE + self.host_suffix)
+        )
+        write_jsonl_snapshot(
+            self.registry, self._metrics_writer, step=step,
+            extra={"host": self.host, **(extra or {})},
+        )
+        self.tracer.export_chrome_trace(
+            os.path.join(self.out_dir, TRACE_FILE + self.host_suffix)
+        )
 
     def end_epoch(
         self,

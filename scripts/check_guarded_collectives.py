@@ -1,21 +1,27 @@
 #!/usr/bin/env python
-"""Lint: host-loop code must not reach around the guarded barrier.
+"""Lint: host-loop code must not reach around the guarded barrier — and,
+since ISSUE 10, must not reach around the INSTRUMENTED wrappers either.
 
 A bare host-side collective (`jax.experimental.multihost_utils` —
 process_allgather, sync_global_devices, broadcast_one_to_all) DEADLOCKS
-every survivor when one pod host dies or wedges. ISSUE 9 wraps the
-sanctioned agreement points in `parallel/multihost.py` with the guarded
-barrier (heartbeat files + timeout -> PEER_LOST failure agreement), so the
-host loops in `mgproto_tpu/engine/` and `mgproto_tpu/cli/` may only reach
-cross-host agreement THROUGH that module's helpers (`allgather_sum`,
-`allgather_rows`, `fetch_replicated`, `checkpoint_barrier`, ...) — never by
-importing `multihost_utils` themselves, and never by re-wrapping the
-agreement primitive `any_across_hosts` (its policy callers —
-`preemption.requested_any_host`, `EpochGuard` — live in resilience/, which
-owns the recovery semantics).
+every survivor when one pod host dies or wedges, and — even when it
+completes — records nothing: an un-timed collective is invisible to the
+fleet observatory's wait attribution (`barrier_wait_seconds` /
+`collective_wait_seconds` / `allgather_bytes_total`), so a straggling host
+hides behind it. ISSUE 9 wrapped the sanctioned agreement points in
+`parallel/multihost.py` with the guarded barrier (heartbeat files + timeout
+-> PEER_LOST failure agreement); ISSUE 10 made those same wrappers the
+metric source. Every module in `mgproto_tpu/` EXCEPT
+`parallel/multihost.py` itself may therefore only reach cross-host
+agreement THROUGH that module's guarded+instrumented helpers
+(`allgather_sum`, `allgather_rows`, `fetch_replicated`,
+`checkpoint_barrier`, ...) — never by importing `multihost_utils`, and
+never by re-wrapping the agreement primitive `any_across_hosts` (its ONE
+sanctioned policy wrapper is `resilience/preemption.py::
+requested_any_host`; other recovery callers route through it).
 
 AST-based (companion to check_no_blocking_sleep.py). Flags, in every module
-under mgproto_tpu/engine/ and mgproto_tpu/cli/:
+under mgproto_tpu/ except the allowlisted wrapper modules:
 
   * any import of `jax.experimental.multihost_utils` (plain, from-import,
     or aliased) and any attribute use of a name bound to it;
@@ -26,8 +32,8 @@ Run from anywhere:
     python scripts/check_guarded_collectives.py [repo_root]
 
 Exit 0 when clean, 1 with one `path:line` per offender otherwise. Wired
-into tier-1 via tests/test_sharded_checkpoint.py (with violation-detection
-coverage, like the other lint scripts).
+into tier-1 via tests/test_sharded_checkpoint.py and tests/test_fleet.py
+(with violation-detection coverage, like the other lint scripts).
 """
 
 from __future__ import annotations
@@ -37,44 +43,57 @@ import os
 import sys
 from typing import Iterator, List, Tuple
 
-_PACKAGES = ("engine", "cli")
+# the one module allowed to touch multihost_utils: it owns the guarded +
+# instrumented wrappers everything else must route through
+_MHU_ALLOWED = (os.path.join("parallel", "multihost.py"),)
+# sanctioned any_across_hosts wrappers: the primitive's home, and the one
+# recovery-policy caller that owns preemption agreement semantics
+_ANY_ALLOWED = _MHU_ALLOWED + (os.path.join("resilience", "preemption.py"),)
 _BANNED_NAME = "any_across_hosts"
 _MHU = "jax.experimental.multihost_utils"
 
 
-def _offenders_in(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+def _offenders_in(
+    tree: ast.AST, ban_mhu: bool = True, ban_any: bool = True
+) -> Iterator[Tuple[int, str]]:
     mhu_aliases = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == _MHU:
-                    yield node.lineno, f"imports {_MHU}"
+                    if ban_mhu:
+                        yield node.lineno, f"imports {_MHU}"
                     mhu_aliases.add((a.asname or a.name).split(".")[0])
         elif isinstance(node, ast.ImportFrom):
             if node.module == _MHU:
-                yield node.lineno, f"from-imports {_MHU}"
+                if ban_mhu:
+                    yield node.lineno, f"from-imports {_MHU}"
             elif node.module == "jax.experimental":
                 for a in node.names:
                     if a.name == "multihost_utils":
-                        yield node.lineno, f"imports {_MHU}"
+                        if ban_mhu:
+                            yield node.lineno, f"imports {_MHU}"
                         mhu_aliases.add(a.asname or a.name)
-            for a in node.names:
-                if a.name == _BANNED_NAME:
-                    yield (
-                        node.lineno,
-                        f"imports {_BANNED_NAME} (use the guarded helpers "
-                        "in parallel/multihost.py or "
-                        "preemption.requested_any_host)",
-                    )
+            if ban_any:
+                for a in node.names:
+                    if a.name == _BANNED_NAME:
+                        yield (
+                            node.lineno,
+                            f"imports {_BANNED_NAME} (use the guarded "
+                            "helpers in parallel/multihost.py or "
+                            "preemption.requested_any_host)",
+                        )
     for node in ast.walk(tree):
         if (
-            isinstance(node, ast.Attribute)
+            ban_mhu
+            and isinstance(node, ast.Attribute)
             and isinstance(node.value, ast.Name)
             and node.value.id in mhu_aliases
         ):
             yield node.lineno, f"calls {_MHU}.{node.attr} directly"
         elif (
-            isinstance(node, ast.Call)
+            ban_any
+            and isinstance(node, ast.Call)
             and isinstance(node.func, ast.Name)
             and node.func.id == _BANNED_NAME
         ):
@@ -83,26 +102,33 @@ def _offenders_in(tree: ast.AST) -> Iterator[Tuple[int, str]]:
 
 def offenders(repo_root: str) -> List[Tuple[str, int, str]]:
     found = []
-    for pkg in _PACKAGES:
-        root = os.path.join(repo_root, "mgproto_tpu", pkg)
-        for dirpath, _dirnames, filenames in os.walk(root):
-            for fname in sorted(filenames):
-                if not fname.endswith(".py"):
+    root = os.path.join(repo_root, "mgproto_tpu")
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel_pkg = os.path.relpath(path, root)
+            ban_mhu = rel_pkg not in _MHU_ALLOWED
+            ban_any = rel_pkg not in _ANY_ALLOWED
+            if not (ban_mhu or ban_any):
+                continue
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    found.append((
+                        os.path.relpath(path, repo_root), e.lineno or 0,
+                        "unparseable module",
+                    ))
                     continue
-                path = os.path.join(dirpath, fname)
-                with open(path) as f:
-                    try:
-                        tree = ast.parse(f.read(), filename=path)
-                    except SyntaxError as e:
-                        found.append((
-                            os.path.relpath(path, repo_root), e.lineno or 0,
-                            "unparseable module",
-                        ))
-                        continue
-                for lineno, why in _offenders_in(tree):
-                    found.append(
-                        (os.path.relpath(path, repo_root), lineno, why)
-                    )
+            for lineno, why in _offenders_in(
+                tree, ban_mhu=ban_mhu, ban_any=ban_any
+            ):
+                found.append(
+                    (os.path.relpath(path, repo_root), lineno, why)
+                )
     return found
 
 
@@ -114,8 +140,8 @@ def main(argv=None) -> int:
     found = offenders(root)
     for path, lineno, why in found:
         print(f"{path}:{lineno}: {why} (a bare collective deadlocks on a "
-              "dead peer; route through parallel/multihost.py's guarded "
-              "helpers)")
+              "dead peer AND records no wait attribution; route through "
+              "parallel/multihost.py's guarded+instrumented helpers)")
     if found:
         return 1
     print("check_guarded_collectives: clean")

@@ -1,8 +1,8 @@
 #!/usr/bin/env python
 """Stall-budget attribution report (ISSUE 8; ROADMAP item 2 lever a).
 
-Apportions a train step's time into MXU-busy / HBM-bound / host+infeed /
-bubble buckets and reports measured-vs-attainable MFU in the PERF.md
+Apportions a train step's time into MXU-busy / HBM-bound / collective-wait
+/ host+infeed / bubble buckets and reports measured-vs-attainable MFU in the PERF.md
 decomposition — the line items behind the 55.8% -> 88.6% gap. Two evidence
 sources, one output schema (see mgproto_tpu/obs/stall.py):
 
@@ -51,6 +51,7 @@ def cost_analysis_report(
     hbm_bytes_per_s: float,
     attainable: Optional[float],
     tiny: bool = False,
+    collective_wait_s: float = 0.0,
 ) -> dict:
     """The hermetic fallback: flagship (or tiny, for smoke tests) config
     lowered through the shared planner helper, roofline-attributed."""
@@ -66,6 +67,7 @@ def cost_analysis_report(
         costs["bytes_accessed"],
         step_time_s=step_time_s,
         host_infeed_s=host_infeed_s,
+        collective_wait_s=collective_wait_s,
         peak_flops=peak_flops,
         hbm_bytes_per_s=hbm_bytes_per_s,
     )
@@ -126,6 +128,11 @@ def main(argv=None) -> int:
     p.add_argument("--host-infeed-s", type=float, default=0.0,
                    help="measured host+input wait per step (e.g. "
                         "loader_wait_fraction x step time from telemetry)")
+    p.add_argument("--collective-wait-s", type=float, default=0.0,
+                   help="measured per-step cross-host barrier/collective "
+                        "wait (e.g. barrier_wait_seconds mean from "
+                        "`mgproto-telemetry fleet`); the single-host "
+                        "fallback reports the line item as zero")
     p.add_argument("--peak-tflops", type=float, default=197.0,
                    help="accelerator peak TFLOP/s (default: v5e bf16)")
     p.add_argument("--hbm-gbps", type=float, default=819.0,
@@ -152,6 +159,7 @@ def main(argv=None) -> int:
         report = cost_analysis_report(
             args.batch, args.step_time_s, args.host_infeed_s, peak_flops,
             hbm, args.attainable, tiny=args.tiny,
+            collective_wait_s=args.collective_wait_s,
         )
     line = json.dumps(report, sort_keys=True)
     print(line)

@@ -1,0 +1,173 @@
+"""Worker for the fleet-observatory drills (ISSUE 10): one jax.distributed
+CPU process of a two-process "pod".
+
+Run as:  python tests/fleet_worker.py <pid> <nprocs> <port> <model_dir> \
+             <steps> <base_step_ms>
+
+Drives the REAL cross-process halves of the fleet observatory — per-host
+telemetry sidecars into the shared telemetry dir, guarded-barrier wait
+histograms, seq-file-mtime arrival-skew attribution, the SkewMonitor
+straggler trigger arming a (cost-fallback) ProfilerWindow capture — with
+two coordinated processes stepping a simulated train loop. Following the
+PR-9 container constraint (this CPU jax cannot run cross-process
+COMPUTATIONS), the device leg of `process_allgather` is stubbed to a local
+stack: the guarded barrier, its timing, the skew mtimes and the byte
+accounting all run for real; only the wire transport is simulated.
+
+The parent injects the straggler through the real chaos knobs
+(MGPROTO_CHAOS_SLOW_HOST_MS + MGPROTO_CHAOS_HOST_INDEX): the victim sleeps
+before every step, so the FAST host's barrier-wait histogram fills and the
+victim's skew monitor names itself. Each check prints a CHECK line; the
+parent asserts on them plus `mgproto-telemetry fleet` / `check` over the
+merged telemetry dir.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    pid, nprocs, port, model_dir, steps, base_ms = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+        int(sys.argv[5]), float(sys.argv[6]),
+    )
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.process_count() == nprocs
+
+    import numpy as np
+
+    # PR-9 container constraint: CPU jax cannot run cross-process
+    # computations, so the allgather WIRE is a local stack — everything
+    # around it (guarded barrier, wait timing, byte accounting) is real
+    from jax.experimental import multihost_utils
+
+    multihost_utils.process_allgather = (
+        lambda x, **kw: np.stack([np.asarray(x)] * nprocs)
+    )
+
+    from mgproto_tpu.obs.fleet import SkewMonitor
+    from mgproto_tpu.obs.flightrec import FlightRecorder, set_recorder
+    from mgproto_tpu.obs.profiler import ProfilerWindow
+    from mgproto_tpu.parallel import multihost
+    from mgproto_tpu.resilience.chaos import ChaosState, plan_from_env
+    from mgproto_tpu.telemetry.session import (
+        BARRIER_WAIT_HIST,
+        COLLECTIVE_WAIT_HIST,
+        TelemetrySession,
+    )
+
+    telem_dir = os.path.join(model_dir, "telemetry")
+    set_recorder(FlightRecorder(dump_dir=telem_dir))
+    telem = TelemetrySession(telem_dir)
+    assert telem.host == pid and telem.primary == (pid == 0)
+    # the production multi-host path: barrier session shared via
+    # MGPROTO_BARRIER_SESSION from the parent (no bring-up collective)
+    multihost.configure_barrier(model_dir, timeout_s=60.0, poll_s=0.005)
+
+    window = ProfilerWindow(
+        out_dir=os.path.join(model_dir, "profile", f"h{pid}"),
+        cost_provider=lambda: {"drill": True},
+    )
+    fleet_mon = SkewMonitor(
+        process_id=pid, window=window, monitor=telem.monitor,
+        threshold=0.25, patience=3,
+    )
+    prev_obs = multihost.set_skew_observer(fleet_mon.observe_barrier)
+    assert prev_obs is None
+
+    plan = plan_from_env()
+    chaos = ChaosState(plan) if plan else None
+
+    base_s = base_ms / 1000.0
+    for step in range(steps):
+        t0 = time.perf_counter()
+        time.sleep(base_s)  # simulated compute, every host
+        if chaos is not None:
+            slow = chaos.host_slow_s(step, jax.process_index())
+            if slow > 0.0:
+                time.sleep(slow)  # the chaos-wedged straggler limps here
+        multihost.heartbeat_tick()
+        # the step-cadence agreement point: guarded + instrumented
+        total = multihost.allgather_sum(1.0)
+        assert total == float(nprocs), total
+        dt = time.perf_counter() - t0
+        telem.monitor.observe_step(8, dt)
+        fleet_mon.observe_step(dt)
+        window.on_step(dt)
+    # one row gather (the per-epoch eval/push shape) for the bytes story
+    rows = multihost.allgather_rows(np.ones((4, 3), np.float32))
+    assert rows.shape == (4 * nprocs, 3)
+
+    # ---- checks against THIS process's registry, before close() restores it
+    snap = telem.registry.snapshot()
+
+    def _hist_count(name):
+        return sum(s["count"] for s in snap[name]["series"])
+
+    assert _hist_count(BARRIER_WAIT_HIST) >= steps
+    assert _hist_count(COLLECTIVE_WAIT_HIST) >= steps
+    print(f"CHECK barrier_hist ok pid={pid}", flush=True)
+
+    straggling = bool(
+        chaos is not None and plan.slow_host_ms > 0
+        and (plan.host_index < 0 or plan.host_index == pid)
+    )
+    reasons = [c["reason"] for c in window.captures]
+    if straggling:
+        assert fleet_mon.fired >= 1, "straggler trigger never fired"
+        assert "straggler" in reasons, reasons
+        cap = window.captures[0]
+        assert cap["fallback"] and os.path.isfile(
+            os.path.join(cap["dir"], "capture_meta.json")
+        )
+        print(f"CHECK straggler_capture ok pid={pid}", flush=True)
+    else:
+        assert fleet_mon.fired == 0 and not reasons, (
+            f"non-straggler host captured: {reasons}"
+        )
+        print(f"CHECK no_capture ok pid={pid}", flush=True)
+
+    # per-host flight-recorder dump (mergeable `.h<pid>` naming off host 0)
+    from mgproto_tpu.obs.flightrec import get_recorder
+
+    dump = get_recorder().maybe_dump("drill")
+    expect = "flightrec_drill_000.jsonl" if pid == 0 else (
+        f"flightrec_drill_000.h{pid}.jsonl"
+    )
+    assert dump is not None and os.path.basename(dump) == expect, dump
+
+    window.close()
+    telem.flush(step=steps)
+    telem.close()
+    multihost.set_skew_observer(prev_obs)
+
+    suffix = "" if pid == 0 else f".h{pid}"
+    assert os.path.isfile(os.path.join(telem_dir, "metrics.jsonl" + suffix))
+    print(f"CHECK sidecar ok pid={pid}", flush=True)
+
+    # all sidecars land before the parent reads the merged dir
+    multihost.guarded_barrier("drill_done")
+    multihost.clear_barrier()
+    print(f"WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,669 @@
+"""Fleet observatory (ISSUE 10): per-host telemetry sidecars, barrier /
+collective wait attribution, straggler detection, `mgproto-telemetry
+fleet` / fleet `check` gates.
+
+Two halves:
+
+  * in-process tier-1 units: sidecar session contract + single-host
+    zero-extra-work guard, SkewMonitor trigger semantics, flightrec host
+    identity, the stall schema's `collective_wait` line item, the
+    slow-host chaos knob, the widened guarded-collectives lint, and the
+    fleet gate roundtrip;
+  * a two-process jax.distributed CPU drill (tests/fleet_worker.py, the
+    multihost_ckpt_worker style — metadata/placement only per the PR-9
+    container constraint): chaos-wedge host 1 with
+    MGPROTO_CHAOS_SLOW_HOST_MS, prove both hosts write sidecars, the
+    barrier-wait histogram fills on the FAST host, the skew attribution
+    names the wedged host, the straggler trigger captures a
+    (cost-fallback) trace on host 1 ONLY, and `fleet --json` / `check`
+    against the committed evidence/fleet_baseline.json behave: the clean
+    drill PASSES, the straggler drill FAILS the skew gate, and a
+    perturbed baseline fails even the clean run.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mgproto_tpu.cli.telemetry import (
+    FLEET_GATES,
+    build_baseline,
+    check,
+    fleet_summary,
+)
+from mgproto_tpu.obs.fleet import SkewMonitor
+from mgproto_tpu.obs.flightrec import FlightRecorder
+from mgproto_tpu.obs.profiler import ProfilerWindow
+from mgproto_tpu.telemetry.registry import MetricRegistry, set_current_registry
+from mgproto_tpu.telemetry.session import (
+    BARRIER_WAIT_HIST,
+    COLLECTIVE_WAIT_HIST,
+    SKEW_GAUGE,
+    STRAGGLER_COUNTER,
+    TelemetrySession,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fleet_worker.py")
+BASELINE = os.path.join(REPO, "evidence", "fleet_baseline.json")
+
+DRILL_STEPS = 20
+DRILL_BASE_MS = 50.0
+DRILL_SLOW_MS = 150.0
+
+
+# --------------------------------------------------------------------------
+# two-process drills (module-scoped: each runs one 2-proc pod)
+# --------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_drill(model_dir: str, slow_ms: float = 0.0):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        env["MGPROTO_BARRIER_SESSION"] = "fleetdrill"
+        if slow_ms > 0:
+            env["MGPROTO_CHAOS_SLOW_HOST_MS"] = str(slow_ms)
+            env["MGPROTO_CHAOS_HOST_INDEX"] = "1"
+        else:
+            env.pop("MGPROTO_CHAOS_SLOW_HOST_MS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", WORKER, str(pid), "2", str(port),
+             model_dir, str(DRILL_STEPS), str(DRILL_BASE_MS)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"worker {pid} rc={p.returncode}\n{out[-3000:]}"
+        )
+        assert f"WORKER_OK {pid}" in out
+    return outs
+
+
+@pytest.fixture(scope="module")
+def wedged_drill(tmp_path_factory):
+    model_dir = str(tmp_path_factory.mktemp("fleet_wedged"))
+    outs = _run_drill(model_dir, slow_ms=DRILL_SLOW_MS)
+    return model_dir, outs
+
+
+@pytest.fixture(scope="module")
+def clean_drill(tmp_path_factory):
+    model_dir = str(tmp_path_factory.mktemp("fleet_clean"))
+    outs = _run_drill(model_dir, slow_ms=0.0)
+    return model_dir, outs
+
+
+def test_wedged_drill_names_the_straggler(wedged_drill):
+    """Acceptance: chaos-wedge host 1 for N ms/step -> fleet names host 1
+    as slowest with skew within tolerance of the injected delay, barrier
+    waits populate on host 0, and the straggler trigger arms a
+    cost-fallback ProfilerWindow capture on host 1 ONLY."""
+    model_dir, outs = wedged_drill
+    for pid, out in enumerate(outs):
+        assert f"CHECK sidecar ok pid={pid}" in out
+        assert f"CHECK barrier_hist ok pid={pid}" in out
+    assert "CHECK no_capture ok pid=0" in outs[0]
+    assert "CHECK straggler_capture ok pid=1" in outs[1]
+
+    fs = fleet_summary(os.path.join(model_dir, "telemetry"))
+    assert set(fs["hosts"]) == {"0", "1"}
+    fleet = fs["fleet"]
+    assert fleet["slowest_host"] == 1
+    assert fleet["straggler_suspected_total"] >= 1
+    h0, h1 = fs["hosts"]["0"], fs["hosts"]["1"]
+    # barrier waits land on the FAST host (it waits for the straggler)
+    assert h0["barrier_waits"] >= DRILL_STEPS
+    assert h0["barrier_wait_fraction"] > 0.4
+    # the wedged host carries the skew; its implied absolute skew matches
+    # the injected delay within tolerance (EMAs settle from zero, so the
+    # band is generous but one-sided: host 0 must carry ~none)
+    skew_s = h1["host_step_skew_fraction"] * h1["step_time_ema_seconds"]
+    assert 0.4 * DRILL_SLOW_MS / 1e3 <= skew_s <= 1.5 * DRILL_SLOW_MS / 1e3
+    assert h0["host_step_skew_fraction"] < 0.1
+    assert h1["straggler_suspected"] >= 1 and h0["straggler_suspected"] == 0
+    # the targeted capture exists on host 1 only, cost-fallback mode
+    cap_root = os.path.join(model_dir, "profile")
+    assert not os.path.isdir(os.path.join(cap_root, "h0")) or not os.listdir(
+        os.path.join(cap_root, "h0")
+    )
+    h1_caps = os.listdir(os.path.join(cap_root, "h1"))
+    assert any(d.startswith("trace_straggler") for d in h1_caps), h1_caps
+    # per-host flight-recorder dumps are mergeable, listed per host
+    assert fs["hosts"]["0"]["flightrec_dumps"] == [
+        "flightrec_drill_000.jsonl"
+    ]
+    assert fs["hosts"]["1"]["flightrec_dumps"] == [
+        "flightrec_drill_000.h1.jsonl"
+    ]
+
+
+def test_wedged_drill_fails_fleet_gates(wedged_drill):
+    """The committed baseline's skew/barrier-wait gates catch the
+    straggler run (that is what they are FOR)."""
+    model_dir, _ = wedged_drill
+    proc = subprocess.run(
+        [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "check",
+         os.path.join(model_dir, "telemetry"), "--baseline", BASELINE,
+         "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout)
+    failed = {r["key"] for r in result["rows"] if not r["ok"]}
+    assert "fleet.max_skew_fraction" in failed
+
+
+def test_fleet_json_matches_committed_baseline_schema(wedged_drill):
+    """`fleet --json` merges host 0 + sidecars; every key the committed
+    baseline gates resolves to a number in the merged summary."""
+    model_dir, _ = wedged_drill
+    proc = subprocess.run(
+        [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "fleet",
+         os.path.join(model_dir, "telemetry"), "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fs = json.loads(proc.stdout)
+    assert fs["fleet_summary"] and len(fs["hosts"]) == 2
+    with open(BASELINE) as f:
+        baseline = json.load(f)
+    assert baseline["telemetry_check_baseline"]
+    for entry in baseline["entries"]:
+        key = entry["key"]
+        assert key.startswith("fleet.")
+        value = fs["fleet"][key.split(".", 1)[1]]
+        assert isinstance(value, (int, float)), key
+    for row in fs["hosts"].values():
+        for col in ("images_per_sec", "step_time_p99_seconds",
+                    "loader_wait_fraction", "barrier_wait_fraction",
+                    "host_step_skew_fraction", "peer_heartbeat_age_seconds",
+                    "restarts", "allgather_bytes_per_chip"):
+            assert col in row
+
+
+def test_clean_drill_passes_fleet_gates_and_perturbation_fails(
+    clean_drill, tmp_path
+):
+    """Acceptance: `mgproto-telemetry check` passes the committed baseline
+    on a clean run, and fails when the baseline's skew gate is perturbed."""
+    model_dir, _ = clean_drill
+    telem = os.path.join(model_dir, "telemetry")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "check",
+         telem, "--baseline", BASELINE],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # the clean fleet is quiet: nobody straggled, nobody captured
+    fs = fleet_summary(telem)
+    assert fs["fleet"]["straggler_suspected_total"] == 0
+    assert fs["fleet"]["max_skew_fraction"] < 0.3
+    # perturb the skew gate: its band collapses below zero -> any run fails
+    with open(BASELINE) as f:
+        baseline = json.load(f)
+    for entry in baseline["entries"]:
+        if entry["key"] == "fleet.max_skew_fraction":
+            entry["value"], entry["abs_tol"] = -1.0, 0.0
+    perturbed = tmp_path / "perturbed.json"
+    perturbed.write_text(json.dumps(baseline))
+    proc = subprocess.run(
+        [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "check",
+         telem, "--baseline", str(perturbed)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "fleet.max_skew_fraction" in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# sidecar session contract + the single-host zero-extra-work guard
+# --------------------------------------------------------------------------
+
+def test_sidecar_session_writes_host_tagged_streams(tmp_path):
+    s = TelemetrySession(str(tmp_path), primary=False, host=3)
+    try:
+        s.monitor.observe_step(4, 0.01)
+        s.flush(step=1)
+    finally:
+        s.close()
+    names = set(os.listdir(tmp_path))
+    assert {"metrics.jsonl.h3", "metrics.prom.h3", "trace.json.h3",
+            "health.jsonl.h3"} <= names
+    assert "metrics.jsonl" not in names  # host 0's canonical file untouched
+    rec = [json.loads(l) for l in open(tmp_path / "metrics.jsonl.h3")]
+    assert all(r["host"] == 3 for r in rec)
+    # meta stays host-0-only: a sidecar session writes none
+    s2 = TelemetrySession(str(tmp_path), primary=False, host=3)
+    try:
+        s2.write_meta({"x": 1})
+    finally:
+        s2.close()
+    assert "meta.json" not in set(os.listdir(tmp_path))
+
+
+def test_single_host_takes_the_zero_extra_work_path(tmp_path):
+    """Disabled-cost guard (acceptance): one process -> host 0, no suffix,
+    no sidecars, no skew observer, and the collectives' early return never
+    touches the wait metrics."""
+    from mgproto_tpu.parallel import multihost
+
+    assert multihost._SKEW_OBSERVER is None
+    s = TelemetrySession(str(tmp_path))
+    try:
+        assert s.host == 0 and s.host_suffix == "" and s.primary
+        # single-process collectives return before any instrumentation
+        assert multihost.allgather_sum(3.5) == 3.5
+        rows = multihost.allgather_rows(np.ones((2, 2), np.float32))
+        assert rows.shape == (2, 2)
+        multihost.guarded_barrier("noop")  # unconfigured: one check, out
+        snap = s.registry.snapshot()
+        assert snap[COLLECTIVE_WAIT_HIST]["series"] == []
+        assert snap[BARRIER_WAIT_HIST]["series"] == []
+        s.flush(step=0)
+    finally:
+        s.close()
+    names = set(os.listdir(tmp_path))
+    assert "metrics.jsonl" in names
+    assert not any(".h" in n for n in names)
+
+
+def test_sinkless_session_still_writes_nothing(tmp_path):
+    """primary=False with no explicit host (the pre-fleet contract) keeps
+    its writers None."""
+    s = TelemetrySession(str(tmp_path), primary=False)
+    try:
+        s.flush(step=0)
+    finally:
+        s.close()
+    assert not os.path.exists(tmp_path / "metrics.jsonl")
+
+
+# --------------------------------------------------------------------------
+# SkewMonitor semantics
+# --------------------------------------------------------------------------
+
+def _arrivals(base, skews):
+    return {pid: base + s for pid, s in enumerate(skews)}
+
+
+def test_skew_monitor_fires_on_persistent_last_arriver(tmp_path):
+    reg = MetricRegistry()
+    prev = set_current_registry(reg)
+    try:
+        win = ProfilerWindow(str(tmp_path), cost_provider=lambda: {})
+        mon = SkewMonitor(process_id=1, window=win, threshold=0.25,
+                          patience=3)
+        for i in range(5):
+            mon.observe_step(0.1)
+            mon.observe_barrier("b", _arrivals(float(i), [0.0, 0.08]))
+            win.on_step(0.1)
+        assert mon.fired == 1
+        assert [c["reason"] for c in win.captures] == ["straggler"]
+        assert reg.counter(STRAGGLER_COUNTER).value() == 1.0
+        assert reg.gauge(SKEW_GAUGE).value() == pytest.approx(
+            mon.skew_fraction
+        )
+        assert mon.skew_fraction > 0.25
+    finally:
+        set_current_registry(prev)
+
+
+def test_skew_monitor_resets_streak_and_respects_threshold():
+    reg = MetricRegistry()
+    prev = set_current_registry(reg)
+    try:
+        mon = SkewMonitor(process_id=1, threshold=0.25, patience=3)
+        for i in range(10):
+            mon.observe_step(0.1)
+            # alternating last-arriver: the streak can never reach patience
+            late = [0.0, 0.08] if i % 2 == 0 else [0.08, 0.0]
+            mon.observe_barrier("b", _arrivals(float(i), late))
+        assert mon.fired == 0
+        # below-threshold skew never fires even as the persistent last
+        mon2 = SkewMonitor(process_id=1, threshold=0.25, patience=3)
+        for i in range(10):
+            mon2.observe_step(0.1)
+            mon2.observe_barrier("b", _arrivals(float(i), [0.0, 0.01]))
+        assert mon2.fired == 0 and mon2.skew_fraction < 0.25
+        # threshold <= 0 disables the trigger outright, gauge still moves
+        mon3 = SkewMonitor(process_id=1, threshold=0.0, patience=1)
+        for i in range(4):
+            mon3.observe_step(0.1)
+            mon3.observe_barrier("b", _arrivals(float(i), [0.0, 0.08]))
+        assert mon3.fired == 0 and mon3.skew_fraction > 0.25
+    finally:
+        set_current_registry(prev)
+
+
+def test_skew_monitor_records_flightrec_event(tmp_path):
+    from mgproto_tpu.obs.flightrec import set_recorder
+
+    rec = FlightRecorder(host=1)
+    prev_rec = set_recorder(rec)
+    reg = MetricRegistry()
+    prev = set_current_registry(reg)
+    try:
+        mon = SkewMonitor(process_id=1, threshold=0.25, patience=2)
+        for i in range(4):
+            mon.observe_step(0.1)
+            mon.observe_barrier("step", _arrivals(float(i), [0.0, 0.09]))
+        kinds = [e["kind"] for e in rec.events()]
+        assert "straggler_suspected" in kinds
+        evt = [e for e in rec.events() if e["kind"] == "straggler_suspected"][0]
+        assert evt["host"] == 1 and evt["barrier"] == "step"
+        assert evt["skew_fraction"] > 0.25
+    finally:
+        set_current_registry(prev)
+        set_recorder(prev_rec)
+
+
+# --------------------------------------------------------------------------
+# flightrec host identity (satellite)
+# --------------------------------------------------------------------------
+
+def test_flightrec_events_and_dumps_carry_host_identity(tmp_path):
+    rec = FlightRecorder(dump_dir=str(tmp_path), host=2)
+    rec.record("step", i=1)
+    evt = rec.events()[0]
+    assert evt["host"] == 2 and evt["pid"] == os.getpid()
+    path = rec.maybe_dump("peer_lost")
+    assert os.path.basename(path) == "flightrec_peer_lost_000.h2.jsonl"
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["host"] == 2 and lines[0]["pid"] == os.getpid()
+    # host 0 (and the single-process default) keeps the unsuffixed name
+    rec0 = FlightRecorder(dump_dir=str(tmp_path))
+    assert rec0.host == 0
+    rec0.record("step", i=1)
+    path0 = rec0.maybe_dump("peer_lost")
+    assert os.path.basename(path0) == "flightrec_peer_lost_000.jsonl"
+
+
+# --------------------------------------------------------------------------
+# stall schema: the collective_wait line item (tentpole, schema side)
+# --------------------------------------------------------------------------
+
+def test_stall_buckets_gain_collective_wait():
+    from mgproto_tpu.obs import stall
+
+    assert "collective_wait" in stall.BUCKETS
+    assert stall.classify_op("all-gather-start.7") == "collective_wait"
+    assert stall.classify_op("all-reduce.1") == "collective_wait"
+    assert stall.classify_op("reduce-scatter") == "collective_wait"
+    # plain gathers/reduces stay bandwidth work
+    assert stall.classify_op("gather.3") == "hbm_bound"
+    assert stall.classify_op("reduce.2") == "hbm_bound"
+
+
+def test_roofline_collective_wait_partitions_and_defaults_zero():
+    from mgproto_tpu.obs import stall
+
+    rep = stall.roofline_buckets(
+        flops=1e12, bytes_accessed=1e9, step_time_s=0.1,
+        collective_wait_s=0.03,
+    )
+    b = rep["buckets"]
+    assert set(b) == set(stall.BUCKETS)
+    assert b["collective_wait"]["seconds"] == pytest.approx(0.03)
+    assert sum(x["fraction"] for x in b.values()) == pytest.approx(1.0)
+    # the single-host cost-fallback path passes nothing -> explicit zero
+    rep0 = stall.roofline_buckets(
+        flops=1e12, bytes_accessed=1e9, step_time_s=0.1
+    )
+    assert rep0["buckets"]["collective_wait"]["seconds"] == 0.0
+    assert sum(
+        x["fraction"] for x in rep0["buckets"].values()
+    ) == pytest.approx(1.0)
+
+
+def test_committed_stall_evidence_has_collective_wait_line():
+    with open(os.path.join(REPO, "evidence", "stall_report_b256.json")) as f:
+        rep = json.load(f)
+    assert rep["buckets"]["collective_wait"]["fraction"] == 0.0
+    assert rep["fraction_sum"] == pytest.approx(1.0, abs=1e-6)
+
+
+# --------------------------------------------------------------------------
+# slow-host chaos knob (satellite)
+# --------------------------------------------------------------------------
+
+def test_chaos_slow_host_knob():
+    from mgproto_tpu.resilience.chaos import ChaosState, plan_from_env
+
+    plan = plan_from_env({
+        "MGPROTO_CHAOS_SLOW_HOST_MS": "40", "MGPROTO_CHAOS_HOST_INDEX": "1",
+    })
+    assert plan is not None and plan.slow_host_ms == 40.0
+    state = ChaosState(plan)
+    # repeats every step on the target, never on other hosts
+    assert state.host_slow_s(0, 1) == pytest.approx(0.04)
+    assert state.host_slow_s(5, 1) == pytest.approx(0.04)
+    assert state.host_slow_s(0, 0) == 0.0
+    # untargeted (-1): any process carrying the knob
+    state2 = ChaosState(plan_from_env({"MGPROTO_CHAOS_SLOW_HOST_MS": "10"}))
+    assert state2.host_slow_s(0, 0) == pytest.approx(0.01)
+
+
+# --------------------------------------------------------------------------
+# fleet gates + baseline roundtrip (in-process)
+# --------------------------------------------------------------------------
+
+def _write_host_stream(tmp_path, host, skew, barrier_s, devices=4.0,
+                       step_s=0.05, n_steps=5, per_step_barrier=False):
+    reg = MetricRegistry()
+    prev = set_current_registry(reg)
+    try:
+        s = TelemetrySession(
+            str(tmp_path), registry=reg, primary=host == 0, host=host
+        )
+        for _ in range(n_steps):
+            s.monitor.observe_step(8, step_s)
+            if per_step_barrier:
+                reg.histogram(BARRIER_WAIT_HIST).observe(
+                    barrier_s, barrier="b"
+                )
+        reg.gauge(SKEW_GAUGE).set(skew)
+        if not per_step_barrier:
+            reg.histogram(BARRIER_WAIT_HIST).observe(barrier_s, barrier="b")
+        from mgproto_tpu.telemetry.session import (
+            ALLGATHER_BYTES_COUNTER,
+            HOST_DEVICES_GAUGE,
+        )
+
+        reg.counter(ALLGATHER_BYTES_COUNTER).inc(416.0, collective="x")
+        reg.gauge(HOST_DEVICES_GAUGE).set(devices)
+        s.flush(step=5)
+        s.close()
+    finally:
+        set_current_registry(prev)
+
+
+def test_fleet_gate_baseline_roundtrip(tmp_path):
+    _write_host_stream(tmp_path, 0, skew=0.01, barrier_s=0.004)
+    _write_host_stream(tmp_path, 1, skew=0.02, barrier_s=0.002)
+    fs = fleet_summary(str(tmp_path))
+    assert len(fs["hosts"]) == 2
+    summary = {"fleet": fs["fleet"]}
+    baseline = build_baseline(summary, gates=FLEET_GATES)
+    keys = {e["key"] for e in baseline["entries"]}
+    assert keys == {
+        "fleet.max_skew_fraction", "fleet.max_barrier_wait_fraction",
+        "fleet.allgather_bytes_per_chip",
+    }
+    assert check(summary, baseline)["ok"]
+    # a straggling fleet blows the absolute skew band
+    bad = {"fleet": dict(fs["fleet"], max_skew_fraction=0.9)}
+    result = check(bad, baseline)
+    assert not result["ok"]
+    failed = {r["key"] for r in result["rows"] if not r["ok"]}
+    assert failed == {"fleet.max_skew_fraction"}
+    # per-chip traffic is an EQUAL gate: silently losing the traffic
+    # (gather stopped covering the bank) fails like growth does
+    lost = {"fleet": dict(fs["fleet"], allgather_bytes_per_chip=0.0)}
+    baseline_tight = build_baseline(summary, gates=(
+        ("fleet.allgather_bytes_per_chip", "equal", 0.25, 1.0),
+    ))
+    assert not check(lost, baseline_tight)["ok"]
+
+
+def test_single_host_run_fails_fleet_baseline_loudly(tmp_path):
+    """A single-host dir checked against the committed FLEET baseline must
+    fail on every fleet.* key ("metric missing") — its pre-registered
+    zeros must never pass the fleet gates vacuously."""
+    _write_host_stream(tmp_path, 0, skew=0.0, barrier_s=0.0)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "check",
+         str(tmp_path), "--baseline", BASELINE, "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout)
+    assert result["failed"] == len(result["rows"]) == 3
+    assert all("missing" in r["why"] for r in result["rows"])
+
+
+def test_write_fleet_baseline_refuses_single_host_dir(tmp_path):
+    """`--write-baseline --fleet-gates` on a dir without >= 2 host streams
+    must REFUSE (a 0-entry baseline would pass every later check
+    vacuously, silently disabling the fleet gate)."""
+    _write_host_stream(tmp_path, 0, skew=0.0, barrier_s=0.0)
+    out = tmp_path / "empty_baseline.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "check",
+         str(tmp_path), "--baseline", str(out), "--write-baseline",
+         "--fleet-gates"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "EMPTY baseline" in proc.stderr
+    assert not out.exists()
+
+
+def test_fleet_slowest_host_uses_barrier_adjusted_self_time(tmp_path):
+    """The fast host's raw step EMA absorbs the straggler's delay as
+    barrier wait, so slowest_host must rank by self time (EMA minus mean
+    barrier wait per step), not by the converged raw EMAs."""
+    # host 0: raw 0.20 but 0.15/step spent waiting at the barrier
+    _write_host_stream(tmp_path, 0, skew=0.0, barrier_s=0.15,
+                       step_s=0.2, n_steps=5, per_step_barrier=True)
+    # host 1: identical raw EMA, no barrier wait (it IS the straggler)
+    _write_host_stream(tmp_path, 1, skew=0.7, barrier_s=0.001,
+                       step_s=0.2, n_steps=5, per_step_barrier=True)
+    fs = fleet_summary(str(tmp_path))
+    h0, h1 = fs["hosts"]["0"], fs["hosts"]["1"]
+    assert h0["self_step_time_seconds"] == pytest.approx(0.05, abs=0.01)
+    assert h1["self_step_time_seconds"] == pytest.approx(0.2, abs=0.01)
+    assert fs["fleet"]["slowest_host"] == 1
+
+
+def test_summarize_resilience_renders_heartbeat_and_skew(tmp_path):
+    from mgproto_tpu.cli.telemetry import summarize
+    from mgproto_tpu.telemetry.session import HEARTBEAT_AGE_GAUGE
+
+    reg = MetricRegistry()
+    prev = set_current_registry(reg)
+    try:
+        s = TelemetrySession(str(tmp_path), registry=reg, primary=True)
+        reg.gauge(HEARTBEAT_AGE_GAUGE).set(1.25)
+        s.flush(step=1)
+        s.close()
+    finally:
+        set_current_registry(prev)
+    summary = summarize(str(tmp_path))
+    res = summary["resilience"]
+    assert res["peer_heartbeat_age_seconds"] == 1.25
+    assert res["host_step_skew_fraction"] == 0.0
+    assert res["straggler_suspected_total"] == 0.0
+
+
+# --------------------------------------------------------------------------
+# lint: the widened guarded-collectives scope (satellite)
+# --------------------------------------------------------------------------
+
+def test_guarded_collectives_lint_covers_whole_package(tmp_path):
+    """An un-timed collective OUTSIDE engine/ and cli/ is now a lint error;
+    the instrumented wrapper module and the sanctioned any_across_hosts
+    policy caller stay allowlisted."""
+    pkg = tmp_path / "mgproto_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "obs" / "bad.py").write_text(
+        "from jax.experimental import multihost_utils\n"
+        "def f():\n"
+        "    multihost_utils.process_allgather(1)\n"
+    )
+    (pkg / "parallel").mkdir()
+    (pkg / "parallel" / "multihost.py").write_text(
+        "from jax.experimental import multihost_utils\n"
+        "def any_across_hosts(x):\n"
+        "    return x\n"
+    )
+    (pkg / "resilience").mkdir()
+    (pkg / "resilience" / "preemption.py").write_text(
+        "from mgproto_tpu.parallel.multihost import any_across_hosts\n"
+        "def requested_any_host(x):\n"
+        "    return any_across_hosts(x)\n"
+    )
+    script = os.path.join(REPO, "scripts", "check_guarded_collectives.py")
+    proc = subprocess.run(
+        [sys.executable, script, str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "obs" in proc.stdout and "bad.py:1" in proc.stdout
+    flagged = {
+        line.split(":", 1)[0] for line in proc.stdout.splitlines()
+        if ": " in line and line[:1] != " "
+    }
+    assert not any(p.endswith("multihost.py") for p in flagged), flagged
+    assert not any(p.endswith("preemption.py") for p in flagged), flagged
+
+
+def test_guarded_collectives_lint_clean_on_repo():
+    script = os.path.join(REPO, "scripts", "check_guarded_collectives.py")
+    proc = subprocess.run(
+        [sys.executable, script, REPO], capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_fleet_metric_names_are_registered():
+    """ISSUE 10 satellite: every new fleet metric pre-exists in a real
+    session (the check_metric_registry contract), with explicit zeros for
+    the scalar families."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        s = TelemetrySession(tmp, primary=True)
+        try:
+            snap = s.registry.snapshot()
+        finally:
+            s.close()
+    for name in (BARRIER_WAIT_HIST, COLLECTIVE_WAIT_HIST, SKEW_GAUGE,
+                 STRAGGLER_COUNTER, "peer_heartbeat_age_seconds",
+                 "allgather_bytes_total", "host_local_device_count"):
+        assert name in snap, name
+    assert snap[SKEW_GAUGE]["series"][0]["value"] == 0.0
+    assert snap[STRAGGLER_COUNTER]["series"][0]["value"] == 0.0
