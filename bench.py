@@ -899,7 +899,14 @@ def _fail(error_obj: dict) -> None:
     if cached is None:
         _emit(error_obj)
         raise SystemExit(1)
+    # a cached number must never be presentable as live: the explicit
+    # cached flag plus the live failure — including the STRUCTURED probe
+    # failure when the probe gate is what failed — ride on the final line
+    # itself, so a trajectory reader sees the flatline's cause in-band
+    cached["cached"] = True
     cached["live_error"] = error_obj.get("error")
+    if error_obj.get("probe_failure") is not None:
+        cached["probe_failure"] = error_obj["probe_failure"]
     age = _cached_age_s(cached)
     cached["cached_age_s"] = None if age == float("inf") else round(age, 1)
     if age > CACHED_MAX_AGE_S:
@@ -910,10 +917,14 @@ def _fail(error_obj: dict) -> None:
     raise SystemExit(0)
 
 
-def _probe_gate() -> bool:
+def _probe_gate():
     """Cheap backend-health gate before any flagship attempt. Emits one JSON
-    line per probe; returns True when the backend answered. Probes whatever
-    platform this process would get (TPU in production, CPU in CI)."""
+    line per probe; returns (ok, last_failed_probe_record_or_None). Probes
+    whatever platform this process would get (TPU in production, CPU in CI).
+    The failure record rides into `_fail` so a cached-fallback line carries
+    the STRUCTURED probe diagnosis, not just prose — BENCH_r03-r05 served a
+    cached number whose probe story lived only in earlier log lines, and the
+    round-over-round trajectory flatlined invisibly."""
     if os.environ.get("BENCH_SKIP_PROBE"):
         _emit({
             # every in-progress line carries "error": if a kill makes it the
@@ -922,9 +933,10 @@ def _probe_gate() -> bool:
             "event": "probe_skipped",
             "reason": "BENCH_SKIP_PROBE set",
         })
-        return True
+        return True, None
     from mgproto_tpu.probe import probe_once
 
+    record = None
     for i in range(1, max(PROBE_ATTEMPTS, 1) + 1):
         record = probe_once(PROBE_TIMEOUT_S)
         line = {
@@ -938,10 +950,10 @@ def _probe_gate() -> bool:
         }
         _emit(line)
         if record["ok"]:
-            return True
+            return True, None
         if i <= PROBE_ATTEMPTS - 1:
             time.sleep(10)
-    return False
+    return False, {"attempts": max(PROBE_ATTEMPTS, 1), **(record or {})}
 
 
 def main() -> None:
@@ -963,7 +975,8 @@ def main() -> None:
         _emit({"error": detail, "attempts": 0, "errors": {}})
         raise SystemExit(1)
 
-    if not _probe_gate():
+    probe_ok, probe_failure = _probe_gate()
+    if not probe_ok:
         _fail({
             "error": (
                 "backend unreachable: a tiny-jit child probe failed "
@@ -973,6 +986,7 @@ def main() -> None:
             ),
             "attempts": 0,
             "errors": {"probe": "see probe event lines above"},
+            "probe_failure": probe_failure,
         })
 
     plan = [("unfused", "unfused", BATCH), ("fused", "fused", BATCH)]

@@ -1,0 +1,184 @@
+"""Online-learning driver: the drift drill and the drift status view.
+
+`mgproto-online drill` runs the seeded, virtual-clock drift drill (the
+ISSUE 11 deliverable): class-conditional traffic through the real serving
+plane, a hermetic EM bootstrap so served accuracy is real, an injected
+distribution shift (`--drift-kind shift`) or a brand-new class claiming a
+padded class_bucket slot (`--drift-kind new_class`), the continual-learning
+plane (trusted capture -> background consolidation -> drift monitor ->
+recalibrate + blue/green republish) closing the loop, and ONE JSON record
+of the whole story — detection-before-correction timestamps, before/during/
+after accuracy + p(x) curves, poison accounting, zero-dropped / zero-
+recompile proofs:
+
+    mgproto-online drill --out evidence/drift_drill.json
+
+The committed record is gated by `mgproto-telemetry check --drift-drill
+evidence/drift_drill.json` (cli/telemetry.py re-derives every verdict from
+the raw numbers). `mgproto-online status DIR` renders a telemetry dir's
+drift section (the same data `mgproto-telemetry summarize` shows, scoped).
+
+Hermetic: tiny model, CPU, seeded — no dataset, no network, no TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Optional
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _load_load_test():
+    """scripts/load_test.py as a module (scripts/ is repo-level, not a
+    package — the same path trick the tests use)."""
+    path = os.path.join(_REPO, "scripts", "load_test.py")
+    if not os.path.isfile(path):
+        raise SystemExit(
+            f"cannot find scripts/load_test.py under {_REPO}; the drill "
+            "driver runs from a repo checkout"
+        )
+    spec = importlib.util.spec_from_file_location("mgproto_load_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_drill(
+    seed: int = 0,
+    drift_kind: str = "shift",
+    drift_at: int = 120,
+    drift_magnitude: float = 0.25,
+    phases: str = "2x40,4x40,4x40",
+    capture_percentile: float = 10.0,
+    poison_rate: Optional[float] = None,
+    class_bucket: int = 8,
+    accuracy_window: int = 40,
+) -> dict:
+    """The drift drill as a dict record (drift_drill.json schema:
+    evidence/README.md). Importable — tests run the acceptance drill
+    through this exact function."""
+    lt = _load_load_test()
+    result = lt.run_load_test(
+        seed=seed,
+        phases=lt.parse_phases(phases),
+        online=True,
+        drift_at=drift_at,
+        drift_kind=drift_kind,
+        drift_magnitude=drift_magnitude,
+        capture_percentile=capture_percentile,
+        poison_rate=poison_rate,
+        class_bucket=class_bucket,
+        accuracy_window=accuracy_window,
+    )
+    result["drift_drill"] = True
+    # self-gate: the same derivations `mgproto-telemetry check
+    # --drift-drill` applies, stored for the reader (check re-derives,
+    # never trusts these)
+    from mgproto_tpu.cli.telemetry import drift_drill_gates
+
+    result["gates"] = drift_drill_gates(result)
+    return result
+
+
+def drill_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="mgproto-online drill",
+        description="Seeded drift drill: inject shift, detect via p(x), "
+                    "correct via recalibrate + blue/green republish",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--drift-kind", choices=("shift", "new_class"),
+                   default="shift")
+    p.add_argument("--drift-at", type=int, default=120,
+                   help="request index at which the distribution shifts")
+    p.add_argument("--drift-magnitude", type=float, default=0.25)
+    p.add_argument("--phases", default="2x40,4x40,4x40",
+                   help="comma list of DURxRPS storm phases")
+    p.add_argument("--capture-percentile", type=float, default=10.0)
+    p.add_argument("--poison-rate", type=float, default=None,
+                   help="low-p(x) mislabeled chaos fraction (default: "
+                        "MGPROTO_CHAOS_ONLINE_POISON_RATE)")
+    p.add_argument("--class-bucket", type=int, default=8)
+    p.add_argument("--accuracy-window", type=int, default=40)
+    p.add_argument("--out", default="",
+                   help="write the record here (e.g. "
+                        "evidence/drift_drill.json)")
+    args = p.parse_args(argv)
+    record = run_drill(
+        seed=args.seed,
+        drift_kind=args.drift_kind,
+        drift_at=args.drift_at,
+        drift_magnitude=args.drift_magnitude,
+        phases=args.phases,
+        capture_percentile=args.capture_percentile,
+        poison_rate=args.poison_rate,
+        class_bucket=args.class_bucket,
+        accuracy_window=args.accuracy_window,
+    )
+    line = json.dumps(record, sort_keys=True)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if record["gates"]["ok"] else 1
+
+
+def status_main(argv=None) -> int:
+    from mgproto_tpu.cli.telemetry import _fmt, summarize
+
+    p = argparse.ArgumentParser(
+        prog="mgproto-online status",
+        description="Render a telemetry dir's online-learning drift "
+                    "section",
+    )
+    p.add_argument("dir", help="telemetry dir (or a run dir containing "
+                               "telemetry/)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.dir):
+        raise SystemExit(f"not a directory: {args.dir}")
+    summary = summarize(args.dir)
+    drift = summary.get("drift")
+    if drift is None:
+        # only possible for a telemetry dir written before the online
+        # family existed — current sessions always pre-register it
+        raise SystemExit(
+            f"no online_*/drift_* series under {args.dir} (pre-online "
+            "telemetry dir?)"
+        )
+    if args.json:
+        print(json.dumps(drift, indent=2))
+        return 0
+    width = max(len(k) for k in drift)
+    for k, v in drift.items():
+        if isinstance(v, dict):
+            v = " ".join(
+                f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())
+            ) or "-"
+        print(f"{k:<{width}}  {_fmt(v)}")
+    return 0
+
+
+def main(argv: Optional[list] = None) -> Optional[int]:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "drill":
+        return drill_main(argv[1:])
+    if argv and argv[0] == "status":
+        return status_main(argv[1:])
+    p = argparse.ArgumentParser(
+        description="Online MGProto driver (subcommands: drill, status)"
+    )
+    p.parse_args(argv if argv else ["--help"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

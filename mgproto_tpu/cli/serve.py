@@ -167,6 +167,10 @@ def make_engine_factory(
             trainer, state, calibration=calib, monitor=_monitor(), **_kw()
         )
 
+    # the online plane (--online) needs the heavy live context the factory
+    # closed over; exposed as an attribute so the return type stays a
+    # plain callable for every existing caller
+    factory.live_context = (trainer, state, calib)
     return factory
 
 
@@ -179,26 +183,29 @@ def build_engine(args, monitor: Optional[StepMonitor] = None):
 
 
 # --------------------------------------------------------------- batch faces
-def drive_batch_engine(engine, payloads, ids, handler) -> List:
+def drive_batch_engine(engine, payloads, ids, handler, on_pump=None) -> List:
     """Single-engine batch driver with graceful drain: `serve_all` owns
     the submit/pump/order invariant, the preemption flag turns its exit
-    graceful (queued work shed typed, unsubmitted payloads answered too)."""
+    graceful (queued work shed typed, unsubmitted payloads answered too).
+    `on_pump` runs between pump iterations (the --online cadence tick)."""
     return engine.serve_all(
         payloads,
         request_ids=ids,
         should_stop=handler.requested if handler is not None else None,
+        on_pump=on_pump,
     )
 
 
 def drive_batch_plane(
     replica_set, payloads, ids, handler,
     swap_at: Optional[int] = None, swap_factory: Optional[Callable] = None,
-    require_calibrated: bool = True,
+    require_calibrated: bool = True, on_pump: Optional[Callable] = None,
 ) -> Tuple[List, List]:
     """Replica-plane batch driver: (responses, swap_reports). The swap
     drill fires before request `swap_at` is submitted — queued requests
     transfer old->new with zero drops, or the swap is refused and the old
-    fleet keeps answering."""
+    fleet keeps answering. `on_pump` runs after each supervisor poll (the
+    --online consolidation cadence tick)."""
     from mgproto_tpu.serving.response import shed_response
     from mgproto_tpu.serving.swap import hot_swap
 
@@ -217,6 +224,8 @@ def drive_batch_plane(
             ))
         responses.extend(replica_set.submit(payload, request_id=rid))
         responses.extend(replica_set.poll())
+        if on_pump is not None:
+            on_pump()
     if handler is not None and handler.requested():
         responses.extend(replica_set.drain())
     else:
@@ -320,11 +329,34 @@ def main(argv: Optional[list] = None) -> None:
                    help="capture a profiler trace of warmup compilation "
                         "into this dir (off-TPU: cost-analysis-only "
                         "capture — obs/profiler.py)")
+    # online learning (ISSUE 11): continual capture + consolidation beside
+    # the batch faces. Needs the LIVE checkpoint path (the artifact face
+    # has no trainer to consolidate with).
+    p.add_argument("--online", action="store_true",
+                   help="stage trusted high-p(x) predictions (calibrated "
+                        "capture gate) and consolidate them into the "
+                        "memory banks via compact EM after the batch "
+                        "drains — live-checkpoint faces only "
+                        "(online/capture.py, online/consolidate.py)")
+    p.add_argument("--online_capture_percentile", type=float, default=25.0,
+                   help="calibration percentile a prediction's log p(x) "
+                        "must clear to be captured")
+    p.add_argument("--online_capture_capacity", type=int, default=64,
+                   help="per-class staging reservoir bound")
+    p.add_argument("--online_cadence_s", type=float, default=1.0,
+                   help="consolidation cadence (poll-driven, injectable "
+                        "clock — never sleeps)")
     # NB: add_train_args already contributes --auto_tune; here it sizes the
     # warmup bucket set instead of the train plan (perf/planner.py
     # plan_serve_buckets): over-budget buckets are dropped before warmup
     # compiles them, and the outcome lands in telemetry meta when enabled.
     args = p.parse_args(argv)
+    if args.online and args.listen:
+        raise SystemExit(
+            "--online is wired into the batch faces (and the drift drill: "
+            "mgproto-online drill); the network face's pump does not tick "
+            "the consolidation cadence yet"
+        )
 
     from mgproto_tpu.resilience import chaos as chaos_mod
 
@@ -485,26 +517,104 @@ def _first_engine(rs):
     )
 
 
+def _setup_online(args, factory, telem):
+    """--online wiring for the batch faces: install the capture tap and
+    build the consolidator over the factory's live context. Returns
+    (capture, consolidator) or (None, None) when --online is off. Fails
+    loudly on the artifact face — there is no trainer to consolidate
+    with (export a new artifact from a consolidated checkpoint instead)."""
+    if not args.online:
+        return None, None
+    ctx = getattr(factory, "live_context", None)
+    if ctx is None:
+        raise SystemExit(
+            "--online needs the live checkpoint face (--checkpoint + "
+            "--calibrate): an exported artifact carries no trainer or "
+            "memory bank to consolidate into"
+        )
+    trainer, state, calib = ctx
+    if calib is None:
+        raise SystemExit(
+            "--online needs a calibration (--calibrate): the capture "
+            "gate is a calibrated p(x) percentile"
+        )
+    from mgproto_tpu.online import capture as capture_mod
+    from mgproto_tpu.online.capture import CaptureConfig, TrustedCapture
+    from mgproto_tpu.online.consolidate import Consolidator, ConsolidatorConfig
+
+    capture = TrustedCapture(
+        calib, trainer.cfg.model.num_classes,
+        CaptureConfig(
+            percentile=args.online_capture_percentile,
+            capacity_per_class=args.online_capture_capacity,
+        ),
+    )
+    capture_mod.install(capture)
+    cons = Consolidator(
+        trainer, state, capture,
+        ConsolidatorConfig(cadence_s=args.online_cadence_s),
+    )
+    # (online_*/drift_* metrics are pre-registered by TelemetrySession
+    # itself — the registry-lint convention, like resilience's)
+    return capture, cons
+
+
+def _online_summary(capture, cons, forced=False):
+    """The summary line's online block (None when --online off). The
+    batch faces consolidate once after the pump drains (`forced`) — the
+    cadence loop belongs to long-running faces."""
+    if capture is None:
+        return None
+    if forced and cons is not None and capture.staged_count():
+        cons.ingest(capture.drain())
+    block = {"capture": capture.stats()}
+    if cons is not None:
+        block["consolidation"] = {
+            "runs": cons.runs,
+            "samples": cons.samples_consolidated,
+            "em_active_max": max(
+                (r.em_active_max for r in cons.reports), default=0
+            ),
+        }
+    return block
+
+
 def _main_batch_engine(args, handler, telem, monitor) -> None:
     """The original single-engine batch face (plus graceful drain)."""
+    from mgproto_tpu.online import capture as capture_mod
     from mgproto_tpu.serving.health import HealthProbe
 
-    engine = build_engine(args, monitor=monitor)
-    if args.auto_tune:
-        _apply_auto_tune(args, engine, telem)
-    with _warmup_profile(args) as capture_dir:
-        compiled = engine.warmup()
-        _write_warmup_costs(capture_dir, engine)
-    payloads, ids = _load_payloads(args)
-    responses = drive_batch_engine(engine, payloads, ids, handler)
-    for r in responses:
-        print(json.dumps(r.to_dict()))
-    _summary_line(
-        responses, compiled,
-        engine.monitor.recompile_count - compiled,
-        engine.gate, HealthProbe(engine).readiness(),
-        extra={"drained": handler.requested()},
+    factory = make_engine_factory(
+        args, monitor_factory=(lambda: monitor) if monitor else None
     )
+    capture, cons = _setup_online(args, factory, telem)
+    try:
+        engine = factory()
+        if args.auto_tune:
+            _apply_auto_tune(args, engine, telem)
+        with _warmup_profile(args) as capture_dir:
+            compiled = engine.warmup()
+            _write_warmup_costs(capture_dir, engine)
+        payloads, ids = _load_payloads(args)
+        responses = drive_batch_engine(
+            engine, payloads, ids, handler,
+            on_pump=(lambda: cons.tick()) if cons is not None else None,
+        )
+        online = _online_summary(capture, cons, forced=True)
+        for r in responses:
+            print(json.dumps(r.to_dict()))
+        extra = {"drained": handler.requested()}
+        if online is not None:
+            extra["online"] = online
+        _summary_line(
+            responses, compiled,
+            engine.monitor.recompile_count - compiled,
+            engine.gate, HealthProbe(engine).readiness(),
+            extra=extra,
+        )
+    finally:
+        if capture is not None:
+            capture_mod.uninstall()
 
 
 def _build_plane(args, telem):
@@ -532,34 +642,46 @@ def _build_plane(args, telem):
 
 def _main_batch_plane(args, handler, telem) -> None:
     """Batch face through the replica plane (--replicas > 1 or --swap)."""
+    from mgproto_tpu.online import capture as capture_mod
+
     rs = _build_plane(args, telem)
-    with _warmup_profile(args) as capture_dir:
-        compiled = rs.start()
-        _write_warmup_costs(capture_dir, _first_engine(rs))
-    payloads, ids = _load_payloads(args)
-    swap_at = len(payloads) // 2 if args.swap else None
-    responses, reports = drive_batch_plane(
-        rs, payloads, ids, handler,
-        swap_at=swap_at,
-        swap_factory=_swap_factory(args, args.swap) if args.swap else None,
-        require_calibrated=not args.allow_uncalibrated,
-    )
-    for r in responses:
-        print(json.dumps(r.to_dict()))
-    for rep in reports:
-        print(json.dumps({"swap": True, **rep.to_dict()}))
-    first = next((r for r in rs.replicas if r.engine is not None), None)
-    _summary_line(
-        responses, compiled, rs.steady_recompiles,
-        first.engine.gate if first else None,
-        first.probe.readiness() if first and first.probe else None,
-        extra={
+    capture, cons = _setup_online(args, rs.engine_factory, telem)
+    try:
+        with _warmup_profile(args) as capture_dir:
+            compiled = rs.start()
+            _write_warmup_costs(capture_dir, _first_engine(rs))
+        payloads, ids = _load_payloads(args)
+        swap_at = len(payloads) // 2 if args.swap else None
+        responses, reports = drive_batch_plane(
+            rs, payloads, ids, handler,
+            swap_at=swap_at,
+            swap_factory=_swap_factory(args, args.swap) if args.swap else None,
+            require_calibrated=not args.allow_uncalibrated,
+            on_pump=(lambda: cons.tick()) if cons is not None else None,
+        )
+        online = _online_summary(capture, cons, forced=True)
+        for r in responses:
+            print(json.dumps(r.to_dict()))
+        for rep in reports:
+            print(json.dumps({"swap": True, **rep.to_dict()}))
+        first = next((r for r in rs.replicas if r.engine is not None), None)
+        extra = {
             "replicas": len(rs.replicas),
             "replicas_ready": len(rs.ready_replicas()),
             "swaps": [rep.to_dict() for rep in reports],
             "drained": handler.requested(),
-        },
-    )
+        }
+        if online is not None:
+            extra["online"] = online
+        _summary_line(
+            responses, compiled, rs.steady_recompiles,
+            first.engine.gate if first else None,
+            first.probe.readiness() if first and first.probe else None,
+            extra=extra,
+        )
+    finally:
+        if capture is not None:
+            capture_mod.uninstall()
 
 
 def _main_listen(args, handler, telem) -> None:

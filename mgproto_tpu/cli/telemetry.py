@@ -283,6 +283,55 @@ def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
     return section
 
 
+def _drift_section(last: Dict) -> Optional[Dict[str, Any]]:
+    """Online-learning drift story (ISSUE 11): p(x) sketch divergence,
+    per-class bank shift top-k, captures by outcome, consolidation +
+    republish counts. Follows the resilience-section convention: the
+    family is pre-registered by every TelemetrySession, so current runs
+    always render it (all zeros = "no drift observed", which an operator
+    should see, not infer); None only for pre-online telemetry dirs whose
+    snapshots predate the family."""
+    from mgproto_tpu.online import metrics as om  # jax-free
+
+    if not any(
+        name in last for name in om.ALL_COUNTERS + om.ALL_GAUGES
+    ):
+        return None
+    # per-class shift top-k from the labeled gauge series
+    shifts = []
+    for s in last.get(om.DRIFT_CLASS_SHIFT, {}).get("series", []):
+        cls = s.get("labels", {}).get("class")
+        if cls is not None and s.get("value") is not None:
+            shifts.append((cls, s["value"]))
+    shifts.sort(key=lambda kv: -kv[1])
+    section: Dict[str, Any] = {
+        "px_divergence": _series_value(last, om.DRIFT_PX_DIVERGENCE),
+        "mean_shift_max": _series_value(last, om.DRIFT_SHIFT_MAX),
+        "cov_shift_max": _series_value(last, om.DRIFT_COV_SHIFT_MAX),
+        "class_shift_topk": {cls: v for cls, v in shifts[:5]},
+        "breaches_by_signal": _series_by_label(
+            last, om.DRIFT_BREACHES, "signal"
+        ),
+        "captures_by_outcome": _series_by_label(
+            last, om.CAPTURED, "outcome"
+        ),
+        "capture_evicted": _series_value(last, om.CAPTURE_EVICTED),
+        "staged_samples": _series_value(last, om.STAGED),
+        "consolidations_by_result": _series_by_label(
+            last, om.CONSOLIDATIONS, "result"
+        ),
+        "consolidated_samples": _series_value(
+            last, om.CONSOLIDATED_SAMPLES
+        ),
+        "class_additions": _series_value(last, om.CLASS_ADDITIONS),
+        "active_classes": _series_value(last, om.ACTIVE_CLASSES),
+        "republish_by_result": _series_by_label(
+            last, om.REPUBLISH, "result"
+        ),
+    }
+    return section
+
+
 def summarize(telemetry_dir: str) -> Dict[str, Any]:
     """The whole summary as one JSON-able dict."""
     d = resolve_dir(telemetry_dir)
@@ -385,6 +434,10 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     serving = _serving_section(last)
     if serving is not None:
         summary["serving"] = serving
+
+    drift = _drift_section(last)
+    if drift is not None:
+        summary["drift"] = drift
 
     if health:
         traj = {}
@@ -499,6 +552,14 @@ def render_table(summary: Dict[str, Any]) -> str:
     if "resilience" in summary:
         section("resilience (recovery events)")
         for k, v in summary["resilience"].items():
+            rows.append((k, v))
+    if "drift" in summary:
+        section("drift (online learning)")
+        for k, v in summary["drift"].items():
+            if isinstance(v, dict):
+                v = " ".join(
+                    f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())
+                ) or "-"
             rows.append((k, v))
     if "serving" in summary:
         section("serving")
@@ -807,6 +868,84 @@ FLEET_GATES = (
 )
 
 
+def drift_drill_gates(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Gate a committed drift-drill record (evidence/drift_drill.json).
+
+    The drill's acceptance criteria, re-derived from the record's RAW
+    numbers (never from stored verdict booleans, which would gate nothing):
+    the injected shift was detected via p(x) BEFORE the correction landed,
+    the correction committed through the blue/green swap with zero dropped
+    requests and zero steady-state recompiles (serving AND consolidation),
+    poisoned traffic never became capture-eligible, and the served-accuracy
+    curve actually dipped under drift and recovered after republish."""
+    rows: List[Dict[str, Any]] = []
+
+    def gate(key: str, ok: bool, why: str = "") -> None:
+        rows.append({"key": key, "ok": bool(ok),
+                     "why": "" if ok else why, "baseline": None,
+                     "value": None, "direction": "drill"})
+
+    o = record.get("online") or {}
+    det = o.get("detection") or {}
+    fb = det.get("first_breach") or None
+    gate("drill.record", bool(o), "record has no 'online' section — not a "
+                                  "drift-drill result")
+    gate("drill.detected_via_px",
+         bool(fb) and "px" in (fb.get("signals") or ()),
+         "no p(x) drift breach recorded")
+    commit_t = det.get("first_commit_t")
+    gate("drill.detected_before_correction",
+         bool(fb) and commit_t is not None
+         and fb.get("t") is not None and fb["t"] <= commit_t,
+         f"breach t={fb.get('t') if fb else None} vs commit t={commit_t}")
+    committed = (o.get("republish_by_result") or {}).get("committed", 0)
+    gate("drill.republish_committed", committed >= 1,
+         "no republish committed through the blue/green swap")
+    overall = record.get("overall") or {}
+    gate("drill.zero_dropped", overall.get("zero_dropped") is True,
+         "storm dropped requests")
+    gate("drill.zero_steady_recompiles",
+         record.get("steady_state_recompiles") == 0,
+         f"serving recompiled in steady state: "
+         f"{record.get('steady_state_recompiles')}")
+    cons = o.get("consolidation") or {}
+    gate("drill.consolidation_compiled_once",
+         cons.get("steady_recompiles") == 0
+         and 0 < (cons.get("compiles") or 0) <= 1,
+         f"consolidation program compiles={cons.get('compiles')} "
+         f"steady={cons.get('steady_recompiles')}")
+    poison = o.get("poison") or {}
+    gate("drill.poison_never_capture_eligible",
+         (poison.get("capture_eligible") or 0) == 0,
+         f"{poison.get('capture_eligible')} poisoned requests cleared the "
+         "capture gate")
+    windows = o.get("accuracy_windows") or []
+    pre = [w for w in windows
+           if (w.get("drifted_fraction") or 0) == 0
+           and w.get("served_accuracy") is not None]
+    drifted = [w for w in windows
+               if (w.get("drifted_fraction") or 0) > 0.5
+               and w.get("served_accuracy") is not None]
+    if pre and len(drifted) >= 2:
+        pre_acc = sum(w["served_accuracy"] for w in pre) / len(pre)
+        dip = min(w["served_accuracy"] for w in drifted)
+        post_acc = sum(
+            w["served_accuracy"] for w in drifted[-2:]
+        ) / 2.0
+        detail = (f"pre={pre_acc:.3f} dip={dip:.3f} "
+                  f"post={post_acc:.3f}")
+        gate("drill.accuracy_dipped_under_drift",
+             dip <= pre_acc - 0.05, detail)
+        gate("drill.accuracy_recovered_after_republish",
+             post_acc >= pre_acc - 0.15 and post_acc >= dip + 0.1,
+             detail)
+    else:
+        gate("drill.accuracy_curves_present", False,
+             "missing pre-drift/drifted accuracy windows")
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
 def _lookup(summary: Dict[str, Any], dotted: str):
     node: Any = summary
     for part in dotted.split("."):
@@ -888,11 +1027,18 @@ def check_main(argv: Optional[list] = None) -> int:
         description="Gate a telemetry dir against a committed baseline "
                     "(exit 0 = within tolerance, 1 = regression)",
     )
-    p.add_argument("dir", help="telemetry dir (or a run dir containing "
-                               "telemetry/)")
-    p.add_argument("--baseline", required=True,
+    p.add_argument("dir", nargs="?", default=None,
+                   help="telemetry dir (or a run dir containing "
+                        "telemetry/); optional with --drift-drill")
+    p.add_argument("--baseline", default=None,
                    help="baseline JSON (generate with --write-baseline "
                         "from a known-good run, then commit it)")
+    p.add_argument("--drift-drill", default=None, metavar="FILE",
+                   help="gate a committed drift-drill record (e.g. "
+                        "evidence/drift_drill.json): detection-before-"
+                        "correction, zero drops/recompiles, poison "
+                        "rejection, accuracy dip+recovery — exit 1 on any "
+                        "failure")
     p.add_argument("--write-baseline", action="store_true",
                    help="summarize the dir and WRITE --baseline from it "
                         "(no checking)")
@@ -905,6 +1051,35 @@ def check_main(argv: Optional[list] = None) -> int:
     p.add_argument("--json", action="store_true",
                    help="emit the check result as one JSON object")
     args = p.parse_args(argv)
+    if args.drift_drill:
+        try:
+            with open(args.drift_drill) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            raise SystemExit(
+                f"cannot read drift-drill record {args.drift_drill}: {e}"
+            )
+        result = drift_drill_gates(record)
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            width = max(len(r["key"]) for r in result["rows"])
+            for r in result["rows"]:
+                status = "ok  " if r["ok"] else "FAIL"
+                detail = f" ({r['why']})" if r["why"] else ""
+                print(f"{status} {r['key']:<{width}}{detail}")
+            print(f"{result['checked']} checked, "
+                  f"{result['failed']} failed")
+        if args.dir is None:
+            return 0 if result["ok"] else 1
+        drill_ok = result["ok"]
+    else:
+        drill_ok = True
+    if args.dir is None or args.baseline is None:
+        raise SystemExit(
+            "check needs a telemetry dir AND --baseline (or --drift-drill "
+            "FILE alone)"
+        )
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")
     summary = summarize(args.dir)
@@ -958,7 +1133,7 @@ def check_main(argv: Optional[list] = None) -> int:
                   f"base={_fmt(r['baseline'])} new={_fmt(r['value'])}"
                   f"{detail}")
         print(f"{result['checked']} checked, {result['failed']} failed")
-    return 0 if result["ok"] else 1
+    return 0 if result["ok"] and drill_ok else 1
 
 
 def main(argv: Optional[list] = None) -> Optional[int]:

@@ -53,6 +53,13 @@ class ModelConfig:
     # of the HBM headroom at a fraction of full-remat's recompute tax.
     # Ignored when `remat` is True (full-trunk remat wins).
     remat_stages: Tuple[str, ...] = ()
+    # Online class addition (online/classes.py): build the class axis at
+    # num_classes rounded UP to a multiple of this bucket, mirroring the
+    # serving batch buckets — padded slots carry zero priors (inert for
+    # argmax and p(x)) until a new class claims one, so C can grow at run
+    # time without recompiling the trunk. <=1 disables (exact C, the
+    # pre-online behavior). Apply with online.classes.apply_class_bucket.
+    class_bucket: int = 0
 
     @property
     def num_prototypes(self) -> int:

@@ -1,0 +1,32 @@
+"""Online MGProto: continual learning from production traffic (ISSUE 11).
+
+The control plane that closes ROADMAP item 4's loop, sitting BESIDE the
+serving plane and off its hot path:
+
+  capture.py     — trusted capture: a post-`record()` tap in the serving
+                   engine stages high-p(x) production samples into bounded
+                   per-class reservoirs (one None-check when disabled).
+  consolidate.py — background consolidation: a poll-driven cadence loop
+                   (injectable clock, no blocking sleeps) drains staged
+                   samples into the per-class memory banks (core/memory.
+                   memory_push) and runs compact dirty-class EM (core/em.py)
+                   on the touched classes — one compiled program, zero
+                   steady-state recompiles, never on the pump.
+  classes.py     — class addition without trunk recompilation: pad-to-bucket
+                   over classes (ModelConfig.class_bucket), padded slots
+                   carry floor priors until a new class claims one.
+  drift.py       — drift detection via p(x): per-class bank mean/covariance
+                   shift (the mean-embedding view, "Deep Mean Maps") and
+                   serving-time p(x) quantile-sketch divergence vs the
+                   artifact's calibration, as drift_* gauges + flight-
+                   recorder events.
+  republish.py   — zero-downtime republish: recalibrate the consolidated
+                   candidate through the PR-3 path and promote it via the
+                   PR-7 blue/green swap, TrustGate fail-closed.
+  metrics.py     — online_*/drift_* metric names + registration (jax-free).
+
+Import discipline: this package __init__ stays import-free so the serving
+engine's tap (`from mgproto_tpu.online import capture`) never drags jax into
+a jax-free process; `consolidate`/`republish` (which need the model stack)
+are imported explicitly by their callers.
+"""

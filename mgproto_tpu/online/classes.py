@@ -1,0 +1,143 @@
+"""Class addition without trunk recompilation: pad-to-bucket over classes.
+
+XLA compiles per shape, and the class count C is a shape: logits [B, C],
+GMM means [C, K, d], memory bank [C, cap, d]. Growing C naively recompiles
+the trunk — exactly the steady-state-recompile regression the serving plane
+forbids. The fix mirrors the batch buckets (serving/engine.py pads requests
+to a compiled batch size): the model is BUILT at the class count rounded up
+to `ModelConfig.class_bucket`, and the padded slots are inert until claimed:
+
+  * a padded slot carries FLOOR (exactly zero) priors — head_forward maps
+    zero priors to -inf logits (the pruned-slot convention, core/mgproto.py)
+    so a padded slot can never win an argmax and contributes nothing to
+    p(x);
+  * `ClassDirectory.add_class` claims the next free slot; `claim_slot`
+    raises its priors to uniform 1/K so EM can own it as soon as its bank
+    fills (means stay at their random init — consolidation's EM pulls them
+    onto the new class's data manifold);
+  * every compiled program — trunk, eval, serving buckets, consolidation —
+    was traced at the PADDED width, so the addition is pure data movement:
+    zero recompiles, asserted in tests/test_online.py via the StepMonitor
+    recompile detector.
+
+When the bucket itself is exhausted the addition is REFUSED with a typed
+error naming the recompile the operator would be buying — growing past the
+bucket is a deliberate re-export/republish event, never a silent stall.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+from mgproto_tpu.online import metrics as om
+
+
+class ClassBucketFull(RuntimeError):
+    """Every padded slot is claimed: adding another class means rebuilding
+    (and recompiling) the trunk at the next bucket — an operator decision,
+    not something the online plane does implicitly."""
+
+
+def padded_num_classes(num_classes: int, class_bucket: int) -> int:
+    """`num_classes` rounded up to a multiple of `class_bucket`
+    (<=1 disables padding, the pre-online behavior)."""
+    c, b = int(num_classes), int(class_bucket)
+    if b <= 1:
+        return c
+    return ((c + b - 1) // b) * b
+
+
+def apply_class_bucket(cfg):
+    """A Config whose model is built at the padded class width (the trunk,
+    banks and buckets then all compile at the bucket). No-op when
+    `class_bucket` is unset or the count is already aligned."""
+    padded = padded_num_classes(
+        cfg.model.num_classes, cfg.model.class_bucket
+    )
+    if padded == cfg.model.num_classes:
+        return cfg
+    return cfg.replace(
+        model=dataclasses.replace(cfg.model, num_classes=padded)
+    )
+
+
+def floor_padded_priors(gmm, active_classes: int):
+    """Zero the priors of every slot at or past `active_classes` — the
+    floor that keeps padded slots out of argmax and p(x) until claimed.
+    (Exact zero, not epsilon: head_forward maps zero priors to -inf logits,
+    the same convention pruning uses.)"""
+    import jax.numpy as jnp
+
+    c = gmm.priors.shape[0]
+    mask = jnp.arange(c) < int(active_classes)  # [C]
+    return gmm._replace(priors=jnp.where(mask[:, None], gmm.priors, 0.0))
+
+
+def claim_slot(gmm, slot: int):
+    """Raise a padded slot's priors to uniform 1/K — the moment a new class
+    takes ownership. Host-side (runs on the consolidation cadence, never in
+    a compiled step)."""
+    k = gmm.priors.shape[1]
+    return gmm._replace(priors=gmm.priors.at[int(slot)].set(1.0 / k))
+
+
+class ClassDirectory:
+    """Which padded slots are live, and what external class they carry.
+
+    The serving/consolidation planes address classes by SLOT (the model's
+    class axis); the directory owns the slot <-> external-name mapping and
+    the free list. Thread-safe: additions come from the operator/feedback
+    path while the consolidation cadence reads."""
+
+    def __init__(self, base_classes: int, padded_classes: int):
+        base, padded = int(base_classes), int(padded_classes)
+        if padded < base:
+            raise ValueError(
+                f"padded class count {padded} < base {base}"
+            )
+        self.padded_classes = padded
+        self._lock = threading.Lock()
+        # slots [0, base) are the classes the model shipped with
+        self._names: Dict[int, str] = {
+            i: f"class{i}" for i in range(base)
+        }
+        self._next_free = base
+        om.gauge(om.ACTIVE_CLASSES).set(float(base))
+
+    @property
+    def active_classes(self) -> int:
+        with self._lock:
+            return len(self._names)
+
+    @property
+    def free_slots(self) -> int:
+        with self._lock:
+            return self.padded_classes - self._next_free
+
+    def slot_of(self, name: str) -> Optional[int]:
+        with self._lock:
+            for slot, n in self._names.items():
+                if n == name:
+                    return slot
+        return None
+
+    def add_class(self, name: Optional[str] = None) -> int:
+        """Claim the next free padded slot for a new class; returns the
+        slot index. Raises ClassBucketFull when the bucket is exhausted."""
+        with self._lock:
+            if self._next_free >= self.padded_classes:
+                raise ClassBucketFull(
+                    f"all {self.padded_classes} bucketed class slots are "
+                    "claimed; growing further requires rebuilding at the "
+                    "next class_bucket (a recompile + republish, not an "
+                    "online addition)"
+                )
+            slot = self._next_free
+            self._next_free += 1
+            self._names[slot] = name or f"class{slot}"
+            count = len(self._names)
+        om.counter(om.CLASS_ADDITIONS).inc()
+        om.gauge(om.ACTIVE_CLASSES).set(float(count))
+        return slot

@@ -1,0 +1,233 @@
+"""Background consolidation: captured traffic -> memory banks -> compact EM.
+
+The learning half of the online plane. On a poll-driven cadence (injectable
+clock, no blocking sleeps — the serving-plane discipline, enforced by
+check_no_blocking_sleep), staged samples from the trusted capture are
+drained and pushed through ONE jitted program:
+
+    images --(the trainer's own eval-mode forward)--> add-on feature map
+           --(head_forward with the staged labels)--> enqueue candidates
+           --(core/memory.memory_push)-------------> per-class banks
+           --(core/em.em_update, compact dirty-class width = the
+              consolidation batch)------------------> candidate GMM
+
+This is deliberately the TRAINING enqueue semantics (top-1 patch features
+of the labeled class, spatially deduped) and the PR-4 compact-EM machinery:
+a consolidation batch of W samples dirties at most W classes, so the
+compact slab covers every dirty bank and the dense fallback stays a
+counter, never a recompile. The program is compiled ONCE at a fixed batch
+width — drained samples are chunked and the tail padded with valid=False
+rows (memory_push drops them) — and watched by its own StepMonitor, so the
+zero-steady-state-recompile contract is assertable exactly like serving's.
+
+The consolidator owns the CANDIDATE state (gmm/memory/EM-optimizer moments,
+seeded from the serving state): serving keeps scoring with its frozen
+mixture while the candidate learns, and only a drift-triggered republish
+(online/republish.py) moves traffic — consolidation never touches the pump.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from mgproto_tpu.online import metrics as om
+from mgproto_tpu.online.capture import CapturedSample, TrustedCapture
+
+RESULT_RAN = "ran"
+RESULT_EMPTY = "empty"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsolidatorConfig:
+    cadence_s: float = 1.0  # how often `tick` actually consolidates
+    batch_width: int = 16  # the ONE compiled consolidation batch shape
+    min_samples: int = 1  # don't bother below this many staged
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsolidationReport:
+    """What one cadence firing did."""
+
+    t: float
+    drained: int
+    batches: int
+    em_active_max: int  # widest EM call of the run (0 = no bank was ready)
+    compact_fallbacks: int
+    result: str  # ran | empty
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class Consolidator:
+    """The cadence loop's engine: drain -> push -> compact EM (see module
+    docstring). Not thread-safe by design — exactly one consolidation
+    driver per process, the same single-pump rule the serving plane uses."""
+
+    def __init__(
+        self,
+        trainer,
+        state,
+        capture: Optional[TrustedCapture] = None,
+        config: Optional[ConsolidatorConfig] = None,
+        clock=time.monotonic,
+        monitor=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from mgproto_tpu.core.em import em_update, resolve_em_config
+        from mgproto_tpu.core.memory import memory_push
+        from mgproto_tpu.core.mgproto import head_forward
+        from mgproto_tpu.telemetry.monitor import StepMonitor
+
+        self.config = config or ConsolidatorConfig()
+        self.capture = capture
+        self.clock = clock
+        self.trainer = trainer
+        cfg = trainer.cfg
+        self._k = cfg.model.prototypes_per_class
+        self._img = cfg.model.img_size
+        self._c = cfg.model.num_classes
+        width = max(int(self.config.batch_width), 1)
+        self._width = width
+        # the candidate state: banks + mixture + EM moments, seeded from
+        # (and shaped exactly like) the serving state's
+        self.gmm = state.gmm
+        self.memory = state.memory
+        self.opt_state = state.proto_opt_state
+        self._params = state.params
+        self._batch_stats = state.batch_stats
+        self._mean_tx = trainer.proto_tx
+        # compact dirty-class EM at the consolidation width: W samples can
+        # newly dirty at most W classes (core/em.py resolve_em_config)
+        em_cfg = resolve_em_config(cfg.em, self._c, width)
+        self._em_cfg = em_cfg
+
+        def consolidate_fn(params, batch_stats, gmm, memory, opt_state,
+                           images, classes, valid):
+            (proto_map, _), _ = trainer._apply(
+                params, batch_stats, images, train=False
+            )
+            # padding rows carry class -1: clip for the label-indexed
+            # feature gather (valid=False already drops them at the push)
+            labels = jnp.clip(classes, 0, self._c - 1)
+            _, _, enq = head_forward(
+                proto_map, gmm, labels, cfg.model.mine_T,
+                fused=trainer._fused,
+            )
+            feats, enq_classes, enq_valid = enq
+            enq_valid = enq_valid & jnp.repeat(valid, self._k)
+            mem = memory_push(memory, feats, enq_classes, enq_valid)
+            gmm2, mem2, opt2, aux = em_update(
+                gmm, mem, opt_state, self._mean_tx, em_cfg
+            )
+            return gmm2, mem2, opt2, aux.num_active, aux.compact_fallback
+
+        self._jit = jax.jit(consolidate_fn)
+        self.monitor = monitor if monitor is not None else StepMonitor(
+            phase="online"
+        )
+        self.monitor.watch(self._jit)
+        self._next_due = self.clock() + self.config.cadence_s
+        self.runs = 0
+        self.samples_consolidated = 0
+        self.reports: List[ConsolidationReport] = []
+
+    # ---------------------------------------------------------------- cadence
+    def tick(self, now: Optional[float] = None) -> Optional[ConsolidationReport]:
+        """One poll: consolidate iff the cadence elapsed AND enough samples
+        are staged. Returns the report when the cadence fired, else None.
+        Poll-driven — the caller's pump decides when host time is spare."""
+        now = self.clock() if now is None else now
+        if now < self._next_due or self.capture is None:
+            return None
+        self._next_due = now + self.config.cadence_s
+        if self.capture.staged_count() < self.config.min_samples:
+            om.counter(om.CONSOLIDATIONS).inc(result=RESULT_EMPTY)
+            report = ConsolidationReport(
+                t=now, drained=0, batches=0, em_active_max=0,
+                compact_fallbacks=0, result=RESULT_EMPTY,
+            )
+            self.reports.append(report)
+            return report
+        return self.ingest(self.capture.drain(), now=now)
+
+    # ----------------------------------------------------------------- ingest
+    def ingest(
+        self, samples: Sequence[CapturedSample], now: Optional[float] = None
+    ) -> ConsolidationReport:
+        """Consolidate `samples` immediately (the drill's bootstrap path
+        and tick's worker). Chunks to the ONE compiled width; the tail pads
+        with valid=False rows."""
+        now = self.clock() if now is None else now
+        w = self._width
+        em_active_max = 0
+        fallbacks = 0
+        batches = 0
+        for i in range(0, len(samples), w):
+            chunk = samples[i:i + w]
+            images = np.zeros((w, self._img, self._img, 3), np.float32)
+            classes = np.full((w,), -1, np.int32)
+            valid = np.zeros((w,), bool)
+            for j, s in enumerate(chunk):
+                images[j] = np.asarray(s.payload, np.float32)
+                classes[j] = s.class_id
+                valid[j] = True
+            gmm, mem, opt, n_active, fallback = self._jit(
+                self._params, self._batch_stats, self.gmm, self.memory,
+                self.opt_state, images, classes, valid,
+            )
+            self.gmm, self.memory, self.opt_state = gmm, mem, opt
+            em_active_max = max(em_active_max, int(n_active))
+            fallbacks += int(fallback)
+            batches += 1
+        self.runs += 1
+        self.samples_consolidated += len(samples)
+        om.counter(om.CONSOLIDATIONS).inc(result=RESULT_RAN)
+        om.counter(om.CONSOLIDATED_SAMPLES).inc(float(len(samples)))
+        report = ConsolidationReport(
+            t=now,
+            drained=len(samples),
+            batches=batches,
+            em_active_max=em_active_max,
+            compact_fallbacks=fallbacks,
+            result=RESULT_RAN,
+        )
+        self.reports.append(report)
+        return report
+
+    # ------------------------------------------------------------- candidate
+    def claim_class(self, slot: int) -> None:
+        """Class addition (online/classes.py): raise the padded slot's
+        priors to uniform in the CANDIDATE mixture — host-side, on the
+        cadence, never in a compiled step."""
+        from mgproto_tpu.online.classes import claim_slot
+
+        self.gmm = claim_slot(self.gmm, slot)
+
+    def candidate_state(self, serving_state):
+        """`serving_state` with the candidate's gmm/memory/EM moments —
+        what recalibration scores and the republish promotes."""
+        return serving_state.replace(
+            gmm=self.gmm,
+            memory=self.memory,
+            proto_opt_state=self.opt_state,
+        )
+
+    def bank_arrays(self):
+        """(feats, length) of the candidate bank as host numpy — the drift
+        monitor's `observe_bank` input."""
+        return (
+            np.asarray(self.memory.feats),
+            np.asarray(self.memory.length),
+        )
+
+    def steady_recompiles(self) -> int:
+        """Recompiles of the consolidation program since the last check —
+        after the first ingest this must stay 0 (tier-1 asserts it)."""
+        return self.monitor.check_recompiles()

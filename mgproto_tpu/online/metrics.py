@@ -1,0 +1,103 @@
+"""Online-learning + drift metric names and registration (jax-free).
+
+Companion to `serving/metrics.py` and `resilience/metrics.py`: every event
+of the continual-learning plane — captures by outcome, reservoir evictions,
+consolidation runs, class additions, drift gauges and breaches, republish
+attempts — lands in the telemetry registry as a labeled counter/gauge, so
+`mgproto-telemetry summarize` renders the drift story next to serving and
+training health. The whole family is PRE-registered with explicit zeros
+(`register_online_metrics`, called by TelemetrySession) so a run that never
+drifted still snapshots the series and `check` baselines can gate them —
+the repo convention `scripts/check_metric_registry.py` enforces.
+"""
+
+from __future__ import annotations
+
+from mgproto_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    default_registry,
+)
+
+# trusted capture (online/capture.py): label outcome=
+#   accepted        — cleared the gate, staged for consolidation
+#   gate_rejected   — log p(x) below the capture percentile threshold
+#   outcome_skipped — non-predict / abstained / degraded response (tap
+#                     never stages what the trust gate would not vouch for)
+#   class_unknown   — predicted class outside the staging directory
+#   labeled         — operator-labeled feedback (the new-class path)
+CAPTURED = "online_capture_total"
+CAPTURE_EVICTED = "online_capture_evicted_total"
+STAGED = "online_staged_samples"
+
+# background consolidation (online/consolidate.py): label result=
+#   ran | empty (cadence fired with nothing staged)
+CONSOLIDATIONS = "online_consolidation_total"
+CONSOLIDATED_SAMPLES = "online_consolidated_samples_total"
+
+# class addition (online/classes.py)
+CLASS_ADDITIONS = "online_class_additions_total"
+ACTIVE_CLASSES = "online_active_classes"
+
+# republish (online/republish.py): label result= committed | rejected
+REPUBLISH = "online_republish_total"
+
+# drift monitor (online/drift.py). Values are distances in log p(x) /
+# feature space, not times — no _seconds suffix by design.
+DRIFT_PX_DIVERGENCE = "drift_px_divergence"
+DRIFT_CLASS_SHIFT = "drift_class_mean_shift"  # labeled class=<c>
+DRIFT_SHIFT_MAX = "drift_class_mean_shift_max"
+DRIFT_COV_SHIFT_MAX = "drift_class_cov_shift_max"
+DRIFT_BREACHES = "drift_breach_total"  # labeled signal= px | bank
+
+COUNTER_HELP = {
+    CAPTURED: "capture-tap decisions by outcome "
+              "(accepted/gate_rejected/outcome_skipped/class_unknown/labeled)",
+    CAPTURE_EVICTED:
+        "staged samples displaced by reservoir eviction (full class queue)",
+    CONSOLIDATIONS: "background consolidation cadence firings by result",
+    CONSOLIDATED_SAMPLES:
+        "captured samples drained into the memory banks by consolidation",
+    CLASS_ADDITIONS: "classes added online into padded class-bucket slots",
+    REPUBLISH: "drift-triggered republish attempts by result "
+               "(committed/rejected — rejection is the TrustGate failing "
+               "closed)",
+    DRIFT_BREACHES: "drift threshold breaches by signal (px/bank)",
+}
+
+GAUGE_HELP = {
+    STAGED: "samples currently staged across all per-class capture queues",
+    ACTIVE_CLASSES: "classes registered in the online class directory",
+    DRIFT_PX_DIVERGENCE:
+        "mean |serving-quantile - calibration-quantile| of log p(x), "
+        "normalized by the calibration sketch's IQR",
+    DRIFT_CLASS_SHIFT:
+        "L2 shift of a class's bank mean vs the calibration-time baseline "
+        "(labeled class=<c>)",
+    DRIFT_SHIFT_MAX: "max per-class bank mean shift",
+    DRIFT_COV_SHIFT_MAX:
+        "max per-class mean absolute shift of the bank's diagonal variance",
+}
+
+ALL_COUNTERS = tuple(COUNTER_HELP)
+ALL_GAUGES = tuple(GAUGE_HELP)
+
+
+def counter(name: str) -> Counter:
+    """The named online counter in the process-current registry."""
+    return default_registry().counter(name, COUNTER_HELP.get(name, ""))
+
+
+def gauge(name: str) -> Gauge:
+    """The named online gauge in the process-current registry."""
+    return default_registry().gauge(name, GAUGE_HELP.get(name, ""))
+
+
+def register_online_metrics(registry) -> None:
+    """Pre-create the online/drift family with explicit zero-valued
+    unlabeled series (the registry-lint contract: summarize/check always
+    see the series, even when the run never drifted)."""
+    for name in ALL_COUNTERS:
+        registry.counter(name, COUNTER_HELP[name]).inc(0.0)
+    for name in ALL_GAUGES:
+        registry.gauge(name, GAUGE_HELP[name]).set(0.0)

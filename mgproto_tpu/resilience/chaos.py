@@ -47,7 +47,10 @@ network serving plane (ISSUE 7) MGPROTO_CHAOS_SERVE_REPLICA_KILL_AT,
 MGPROTO_CHAOS_SERVE_WEDGE_AT (admitted-request indices that kill/wedge the
 replica the request routes to, one-shot each) and
 MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT (poison the first N hot-swap
-attempts with a trust-stripped artifact; the swap must fail closed).
+attempts with a trust-stripped artifact; the swap must fail closed), and
+for online learning (ISSUE 11) MGPROTO_CHAOS_ONLINE_POISON_RATE (fraction
+of requests replaced with low-p(x) mislabeled junk the trusted-capture
+gate must reject).
 
 Multi-host pod faults (ISSUE 9): MGPROTO_CHAOS_KILL_HOST_AT /
 MGPROTO_CHAOS_WEDGE_HOST_AT make one PROCESS die hard (os._exit) or hang
@@ -127,6 +130,12 @@ class ChaosPlan:
     # data is stripped (an operator pushing an uncalibrated artifact); the
     # swap MUST reject it fail-closed while the old model keeps serving
     serve_swap_bad_artifact: int = 0
+    # online learning (ISSUE 11): fraction of requests replaced with
+    # low-p(x) MISLABELED junk (deterministic per request index). The
+    # trusted-capture gate (online/capture.py) must reject every one —
+    # poisoned traffic never reaches the memory banks; the drift drill
+    # counts injections and asserts zero were captured.
+    online_poison_rate: float = 0.0
     # multi-host pod faults (ISSUE 9): when the batch for this global step
     # is drawn, the targeted process DIES hard (os._exit — a host crash) or
     # WEDGES (hangs mid-loop — a stuck host). Survivors must reach failure
@@ -156,6 +165,7 @@ class ChaosPlan:
             or self.serve_replica_kill_at is not None
             or self.serve_wedge_at is not None
             or self.serve_swap_bad_artifact > 0
+            or self.online_poison_rate > 0.0
             or self.kill_host_at is not None
             or self.wedge_host_at is not None
             or self.slow_host_ms > 0.0
@@ -331,6 +341,19 @@ class ChaosState:
         self._count("serve_swap_bad_artifact")
         return True
 
+    def online_poison_due(self, request_index: int) -> bool:
+        """Deterministic per request index: this request's payload becomes
+        low-p(x) mislabeled junk the capture gate must refuse (ISSUE 11;
+        the drill drives the substitution, this decides + counts it)."""
+        p = self.plan
+        if p.online_poison_rate <= 0.0:
+            return False
+        rng = np.random.default_rng([p.seed, 0x0150, int(request_index)])
+        hit = bool(rng.random() < p.online_poison_rate)
+        if hit:
+            self._count("online_poison")
+        return hit
+
     def serve_device_error_due(self, dispatch_index: int) -> bool:
         """True exactly once per listed dispatch index (a breaker-paced
         retry of later work must be able to heal)."""
@@ -470,6 +493,9 @@ def plan_from_env(environ=None) -> Optional[ChaosPlan]:
         serve_wedge_at=_get("MGPROTO_CHAOS_SERVE_WEDGE_AT", int, None),
         serve_swap_bad_artifact=_get(
             "MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT", int, 0
+        ),
+        online_poison_rate=_get(
+            "MGPROTO_CHAOS_ONLINE_POISON_RATE", float, 0.0
         ),
         kill_host_at=_get("MGPROTO_CHAOS_KILL_HOST_AT", int, None),
         wedge_host_at=_get("MGPROTO_CHAOS_WEDGE_HOST_AT", int, None),

@@ -95,9 +95,15 @@ class Calibration:
         logits = np.asarray(id_logits, np.float64)
         # dispersion equalizer: per-class std of log p(x|c), scaled so the
         # mean temperature is 1.0 (a pure reshape of confidence, never of
-        # the abstention decision, which gates on log p(x) alone)
-        stds = np.maximum(logits.std(axis=0), 1e-6)
-        temps = stds / float(stds.mean())
+        # the abstention decision, which gates on log p(x) alone). Columns
+        # with non-finite entries get temperature 1.0: padded class-bucket
+        # slots (online/classes.py) legitimately emit -inf log p(x|c), and
+        # an undefined dispersion must not poison the whole equalizer.
+        finite_cols = np.isfinite(logits).all(axis=0)
+        temps = np.ones(logits.shape[1], np.float64)
+        if finite_cols.any():
+            stds = np.maximum(logits[:, finite_cols].std(axis=0), 1e-6)
+            temps[finite_cols] = stds / float(stds.mean())
         return Calibration(
             percentile=float(percentile),
             threshold_log_px=thresholds[f"{float(percentile):g}"],

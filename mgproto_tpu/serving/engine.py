@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mgproto_tpu.obs import reqtrace as _reqtrace
+from mgproto_tpu.online import capture as _capture
 from mgproto_tpu.resilience import chaos as _chaos
 from mgproto_tpu.serving import metrics as _m
 from mgproto_tpu.serving.admission import (
@@ -401,14 +402,17 @@ class ServingEngine:
     def serve_all(self, payloads: Sequence[Any],
                   deadline_s: Optional[float] = None,
                   request_ids: Optional[Sequence[str]] = None,
-                  should_stop: Optional[Callable[[], bool]] = None
+                  should_stop: Optional[Callable[[], bool]] = None,
+                  on_pump: Optional[Callable[[], None]] = None
                   ) -> List[ServeResponse]:
         """Batch driver (CLI / tests): submit everything, drain to
         completion, return responses in submission order. `should_stop`
         (e.g. the preemption handler's flag) turns the exit graceful:
         queued work is shed typed via `drain()` and never-submitted
         payloads answer typed too — every id gets exactly one response
-        either way."""
+        either way. `on_pump` runs between pump iterations — the hook the
+        online consolidation cadence (cli/serve.py --online) ticks on,
+        keeping background work off the dispatch path itself."""
         from mgproto_tpu.serving.response import shed_response
 
         ids = [
@@ -425,6 +429,8 @@ class ServingEngine:
             responses.extend(
                 self.submit(payload, request_id=ids[i], deadline_s=deadline_s)
             )
+            if on_pump is not None:
+                on_pump()
         # every pop either answers or sheds-with-answer, so this terminates
         # with zero requests left unanswered
         while len(self.queue):
@@ -432,6 +438,8 @@ class ServingEngine:
                 responses.extend(self.drain())
                 break
             responses.extend(self.process_pending())
+            if on_pump is not None:
+                on_pump()
         responses.extend(
             shed_response(rid, REASON_SHUTDOWN) for rid in unsubmitted
         )
@@ -491,6 +499,9 @@ class ServingEngine:
             # THIS batch to ungated classification, flagged per response
             labels = [TRUST_UNGATED] * len(batch)
             degraded = True
+        # continual-learning tap (online/capture.py): disabled is ONE
+        # module-global None-check per batch — the reqtrace discipline
+        tap = _capture.get_active()
         out = []
         for req, pred, row, score, label in zip(
             batch, preds, logits, log_px, labels
@@ -509,7 +520,13 @@ class ServingEngine:
                 degraded=degraded or label == TRUST_UNGATED,
                 latency_s=self.clock() - req.enqueued_at,
             )
-            out.append(self._respond(resp))
+            resp = self._respond(resp)
+            if tap is not None:
+                # post-record(): stage trusted high-p(x) predictions for
+                # background consolidation. O(1) reservoir append; never
+                # raises (capture's own contract).
+                tap.on_response(req.payload, resp)
+            out.append(resp)
         return out
 
     def _respond(self, resp: ServeResponse) -> ServeResponse:

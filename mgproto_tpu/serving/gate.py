@@ -120,10 +120,16 @@ class TrustGate:
             z = np.asarray(logits_row, np.float64) / np.asarray(
                 self.calibration.per_class_temperature, np.float64
             )
-            if not np.isfinite(z).all():
+            # -inf is a legitimate "impossible class" (padded class-bucket
+            # slots carry zero priors): exp(-inf)=0 drops out of the
+            # softmax. NaN or +inf still means no confidence beats a wrong
+            # one — as does an all-impossible row.
+            if np.isnan(z).any() or np.isposinf(z).any():
                 return None
-            z = z - z.max()
-            p = np.exp(z)
+            m = z.max()
+            if not np.isfinite(m):
+                return None
+            p = np.exp(z - m)
             return float(p.max() / p.sum())
         except (ValueError, TypeError):
             # e.g. a calibration whose class count disagrees with the

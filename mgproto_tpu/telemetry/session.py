@@ -151,6 +151,12 @@ class TelemetrySession:
         from mgproto_tpu.resilience.metrics import register_resilience_metrics
 
         register_resilience_metrics(self.registry)
+        # online-learning + drift family (ISSUE 11): same contract — a run
+        # that never drifted still snapshots explicit zeros, and the
+        # registry lint resolves every online_*/drift_* name here
+        from mgproto_tpu.online.metrics import register_online_metrics
+
+        register_online_metrics(self.registry)
         self._g_epoch_ips = self.registry.gauge(
             "epoch_images_per_sec_global",
             "whole-epoch throughput summed across hosts",

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Lint: no blocking sleeps/waits in the serving request path.
+"""Lint: no blocking sleeps/waits in the serving or online request path.
 
 The serving plane (mgproto_tpu/serving/) is a poll-driven pump over
 injectable clocks: the admission queue, circuit breaker, micro-batcher,
@@ -9,8 +9,14 @@ frontend must never stall its event loop. A `time.sleep` (or an un-injected
 blocking retry) anywhere in serving/ breaks both properties at once — it
 stalls real traffic AND makes the fault drills timing-dependent.
 
+The online continual-learning plane (mgproto_tpu/online/, ISSUE 11) lives
+under the same contract: its consolidation/drift cadences are poll-driven
+`tick(now)` loops on injected clocks — a sleep there would either stall the
+pump that hosts the ticks or make the virtual-clock drift drill
+nondeterministic, so both packages are linted.
+
 AST-based (companion to check_no_print.py / check_no_signal_handlers.py).
-Flags, in every module under mgproto_tpu/serving/:
+Flags, in every module under mgproto_tpu/serving/ and mgproto_tpu/online/:
 
   * any call to `time.sleep` — through any alias of the `time` module
     (`import time as t; t.sleep(...)`) or a bare name bound from it
@@ -83,27 +89,31 @@ def _offending_calls(tree: ast.AST) -> Iterator[Tuple[int, str]]:
             )
 
 
+_LINTED_PACKAGES = ("serving", "online")
+
+
 def offenders(repo_root: str) -> List[Tuple[str, int, str]]:
-    pkg = os.path.join(repo_root, "mgproto_tpu", "serving")
     found = []
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=path)
-                except SyntaxError as e:
-                    found.append((
-                        os.path.relpath(path, repo_root), e.lineno or 0,
-                        "unparseable module",
-                    ))
+    for pkg_name in _LINTED_PACKAGES:
+        pkg = os.path.join(repo_root, "mgproto_tpu", pkg_name)
+        for dirpath, _dirnames, filenames in os.walk(pkg):
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
                     continue
-            for lineno, why in _offending_calls(tree):
-                found.append(
-                    (os.path.relpath(path, repo_root), lineno, why)
-                )
+                path = os.path.join(dirpath, fname)
+                with open(path) as f:
+                    try:
+                        tree = ast.parse(f.read(), filename=path)
+                    except SyntaxError as e:
+                        found.append((
+                            os.path.relpath(path, repo_root), e.lineno or 0,
+                            "unparseable module",
+                        ))
+                        continue
+                for lineno, why in _offending_calls(tree):
+                    found.append(
+                        (os.path.relpath(path, repo_root), lineno, why)
+                    )
     return found
 
 

@@ -19,6 +19,28 @@ Chaos knobs (all optional) drive the fault story mid-run:
   --swap-good-at N   a calibrated swap fires before request N — must commit
                      with zero dropped requests
 
+Online-learning drift drill (ISSUE 11; --drift-at / --online):
+
+  --drift-at N       from request N the traffic DISTRIBUTION shifts
+                     (`--drift-kind shift`: every class's texture rotates
+                     and its channel balance moves) or a brand-new class
+                     appears (`--drift-kind new_class`, claiming a padded
+                     class_bucket slot — zero trunk recompiles)
+  --online           the continual-learning plane runs beside the storm:
+                     trusted capture (post-record tap, calibrated p(x)
+                     gate), background consolidation (memory_push + compact
+                     EM on the virtual-clock cadence), drift monitoring
+                     (p(x) quantile-sketch divergence + bank mean shift),
+                     and drift-triggered recalibrate + blue/green republish
+
+  In online mode traffic is CLASS-CONDITIONAL (a seeded per-class texture
+  generator) and the mixture is BOOTSTRAPPED hermetically: labeled samples
+  are consolidated through the production EM path until the generative
+  classifier separates the classes — no backprop, no dataset — so served
+  accuracy is real and the drill's before/during/after curves mean what
+  they say. MGPROTO_CHAOS_ONLINE_POISON_RATE injects low-p(x) MISLABELED
+  requests that the capture gate must reject (counted + asserted).
+
 Output is ONE JSON line (stdout, and --out FILE): per-phase p50/p99 latency
 + shed-rate curves, shed-by-reason, breaker open-time fraction, batch-fill
 stats, dispatch-trigger counts, swap reports, restart counts, steady-state
@@ -73,6 +95,29 @@ def parse_phases(raw: str) -> List[Tuple[float, float]]:
     return phases
 
 
+def _consolidation_block(cons) -> Dict:
+    """Consolidation program compile accounting: check_recompiles() folds
+    the watched jit's cache-size delta into recompile_count — ONE compile
+    at first ingest, then never again (anything above 1 is a steady-state
+    retrace bug; the drill gate asserts exactly 1)."""
+    cons.monitor.check_recompiles()
+    compiles = cons.monitor.recompile_count
+    return {
+        "runs": cons.runs,
+        "samples": cons.samples_consolidated,
+        "compiles": compiles,
+        "steady_recompiles": max(compiles - 1, 0),
+    }
+
+
+def _gauge_value(snapshot: Dict, name: str):
+    """Latest unlabeled-series value of a gauge (None when absent)."""
+    for s in snapshot.get(name, {}).get("series", []):
+        if not s.get("labels"):
+            return s.get("value")
+    return None
+
+
 def _label_counts(snapshot: Dict, name: str, key: str) -> Dict[str, float]:
     out: Dict[str, float] = {}
     for s in snapshot.get(name, {}).get("series", []):
@@ -91,6 +136,355 @@ def _pcts(latencies_ms: Sequence[float]) -> Dict[str, Optional[float]]:
         "p99_ms": round(float(np.percentile(arr, 99)), 3),
         "max_ms": round(float(arr.max()), 3),
     }
+
+
+class OnlinePlane:
+    """The drift drill's continual-learning side-plane (ISSUE 11).
+
+    Bundles everything `run_load_test` needs beyond the storm itself: the
+    seeded class-conditional traffic generator, the hermetic EM bootstrap
+    (the production consolidation path fits the mixture to the generator's
+    classes — no backprop), the trusted-capture tap, the virtual-clock
+    consolidation cadence, the drift monitor, and the drift-triggered
+    recalibrate + blue/green republish. Deterministic end to end."""
+
+    def __init__(
+        self,
+        trainer,
+        state,
+        clock,
+        seed: int,
+        base_classes: int,
+        drift_kind: str,
+        drift_magnitude: float,
+        capture_percentile: float,
+        capture_capacity: int,
+        online_cadence_s: float,
+        republish_min_interval_s: float,
+        px_divergence_threshold: float,
+        mean_shift_threshold: float,
+        engine_kw: Dict,
+        bootstrap_epochs: int = 20,
+        bootstrap_per_class: int = 8,
+        new_class_rate: float = 0.35,
+        new_class_label_rate: float = 0.5,
+    ):
+        from mgproto_tpu.online import classes as ocl
+        from mgproto_tpu.online.capture import CaptureConfig, CapturedSample, TrustedCapture
+        from mgproto_tpu.online.consolidate import Consolidator, ConsolidatorConfig
+        from mgproto_tpu.online.drift import DriftConfig, DriftMonitor
+        from mgproto_tpu.serving.calibration import calibrate
+
+        self.trainer = trainer
+        self.clock = clock
+        self.drift_kind = drift_kind
+        self.drift_magnitude = float(drift_magnitude)
+        self.base_classes = int(base_classes)
+        self.img = trainer.cfg.model.img_size
+        self.engine_kw = engine_kw
+        self.new_class_rate = new_class_rate
+        self.new_class_label_rate = new_class_label_rate
+        self._gen_rng = np.random.RandomState(seed + 11)
+        self._traffic_rng = np.random.RandomState(seed + 13)
+        self._poison_rng = np.random.RandomState(seed + 17)
+        self.directory = ocl.ClassDirectory(
+            base_classes, trainer.cfg.model.num_classes
+        )
+        self.new_slot: Optional[int] = None
+        # padded slots inert until claimed (zero priors = -inf logits)
+        state = state.replace(
+            gmm=ocl.floor_padded_priors(state.gmm, base_classes)
+        )
+        # hermetic bootstrap: labeled samples through the PRODUCTION
+        # consolidation program (memory_push + compact EM) until the
+        # generative classifier separates the generator's classes
+        self.cons = Consolidator(
+            trainer, state,
+            config=ConsolidatorConfig(
+                cadence_s=online_cadence_s, batch_width=8
+            ),
+            clock=clock,
+        )
+        for _ in range(int(bootstrap_epochs)):
+            for c in range(base_classes):
+                self.cons.ingest([
+                    CapturedSample(p, c, None, "bootstrap", True)
+                    for p in self._samples(c, bootstrap_per_class)
+                ])
+        self.base_state = self.cons.candidate_state(state)
+        self.id_batch_size = 4
+        self.id_batches = [
+            (np.stack(self._samples(c, self.id_batch_size)),
+             np.full((self.id_batch_size,), c, np.int32))
+            for c in range(base_classes) for _ in range(2)
+        ]
+        self.calib = calibrate(trainer, self.base_state, self.id_batches)
+        self.serving_state = self.base_state
+        self.capture = TrustedCapture(
+            self.calib, trainer.cfg.model.num_classes,
+            CaptureConfig(
+                percentile=capture_percentile,
+                capacity_per_class=capture_capacity,
+                seed=seed,
+            ),
+        )
+        self.cons.capture = self.capture
+        self.drift = DriftMonitor(
+            self.calib,
+            DriftConfig(
+                px_window=128,
+                min_px_samples=48,
+                eval_interval_s=online_cadence_s,
+                px_divergence_threshold=px_divergence_threshold,
+                mean_shift_threshold=mean_shift_threshold,
+            ),
+            clock=clock,
+        )
+        self.drift.set_bank_baseline(*self.cons.bank_arrays())
+        self.republish_min_interval_s = republish_min_interval_s
+        self.republisher = None  # bound once the ReplicaSet exists
+        self._pending_candidate = None
+        self.first_breach: Optional[Dict] = None
+        self.drift_active = False
+        self.drift_started_t: Optional[float] = None
+        self.poisoned: set = set()
+        self.truth: Dict[str, int] = {}
+        self.drifted: Dict[str, bool] = {}
+        self.labeled_feedback = 0
+        self.replica_set = None
+        # recent raw traffic for THRESHOLD recalibration. Deliberately
+        # ungated: a capture-gated sample set can never see the sub-gate
+        # tail, so a threshold percentile re-derived from it is biased
+        # high and the corrected model over-abstains forever. Thresholds
+        # need the live score distribution (exactly what the drift monitor
+        # watches); the gate's job is protecting the BANKS, and it still
+        # does — consolidation only ever sees gated/labeled samples.
+        from collections import deque
+
+        self.recent_traffic = deque(maxlen=128)
+
+    # ----------------------------------------------------------- traffic gen
+    def _pattern(
+        self, cls: int, drift: float, channel: float = 1.0
+    ) -> np.ndarray:
+        """Deterministic class texture: oriented wave + channel balance;
+        `drift` rotates the texture and moves the balance (the covariate
+        shift the drill injects). `channel` scales the class's channel
+        offset — inverting it (-2.0) is the measured off-manifold poison
+        direction (log p(x) collapses well below the capture gate)."""
+        n = self.img
+        xx, yy = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+        ang = (cls * 45.0 + drift * 30.0) * np.pi / 180.0
+        wave = np.cos(
+            2.0 * np.pi * (cls + 1)
+            * (xx * np.cos(ang) + yy * np.sin(ang)) / float(n)
+        )
+        base = np.repeat(wave[..., None].astype(np.float32), 3, axis=2)
+        base[..., cls % 3] += channel
+        base[..., (cls + 1) % 3] += drift * 0.6
+        return base
+
+    def _samples(self, cls: int, count: int, drift: float = 0.0) -> list:
+        base = self._pattern(cls, drift)
+        return [
+            base + self._gen_rng.randn(self.img, self.img, 3)
+            .astype(np.float32) * 0.05
+            for _ in range(count)
+        ]
+
+    def start_drift(self, now: float) -> None:
+        self.drift_active = True
+        self.drift_started_t = now
+        if self.drift_kind == "new_class" and self.new_slot is None:
+            self.new_slot = self.directory.add_class("drill_new_class")
+            self.cons.claim_class(self.new_slot)
+
+    def next_payload(self, rid: str, poisoned: bool) -> np.ndarray:
+        """One request's payload + truth bookkeeping."""
+        if poisoned:
+            # low-p(x) mislabeled junk: pure noise far off the manifold;
+            # the capture gate must refuse it (asserted in the drill)
+            self.poisoned.add(rid)
+            self.truth[rid] = int(
+                self._traffic_rng.randint(0, self.base_classes)
+            )
+            self.drifted[rid] = self.drift_active
+            src = int(self._poison_rng.randint(0, self.base_classes))
+            payload = (
+                self._pattern(src, drift=0.0, channel=-2.0)
+                + self._poison_rng.randn(self.img, self.img, 3)
+                .astype(np.float32) * 0.05
+            )
+            # production cannot tell poison from traffic here; the
+            # threshold reservoir takes everything answered (a low rate
+            # only nudges the extreme tail of the recalibrated sketch)
+            self.recent_traffic.append(payload)
+            return payload
+        cls = int(self._traffic_rng.randint(0, self.base_classes))
+        drift = 0.0
+        if self.drift_active:
+            if (
+                self.drift_kind == "new_class"
+                and self.new_slot is not None
+                and self._traffic_rng.rand() < self.new_class_rate
+            ):
+                cls = self.new_slot
+            elif self.drift_kind == "shift":
+                drift = self.drift_magnitude
+        self.truth[rid] = cls
+        self.drifted[rid] = self.drift_active
+        payload = (
+            self._pattern(cls, drift)
+            + self._gen_rng.randn(self.img, self.img, 3)
+            .astype(np.float32) * 0.05
+        )
+        if (
+            cls == self.new_slot
+            and self._traffic_rng.rand() < self.new_class_label_rate
+        ):
+            # operator-labeled feedback: the ONLY way a class the serving
+            # mixture cannot score yet gets trusted samples staged
+            self.capture.submit_labeled(payload, cls, request_id=rid)
+            self.labeled_feedback += 1
+        self.recent_traffic.append(payload)
+        return payload
+
+    # -------------------------------------------------------------- republish
+    def bind_replica_set(self, replica_set) -> None:
+        from mgproto_tpu.online.republish import Republisher
+
+        self.replica_set = replica_set
+        self.republisher = Republisher(
+            replica_set,
+            recalibrate=self._recalibrate,
+            factory_builder=self._factory_builder,
+            clock=self.clock,
+            min_interval_s=self.republish_min_interval_s,
+            on_commit=self._on_commit,
+        )
+
+    def factory(self):
+        """The INITIAL engine factory (hot swaps retarget the set's)."""
+        from mgproto_tpu.serving.engine import ServingEngine
+
+        return ServingEngine.from_live(
+            self.trainer, self.serving_state, calibration=self.calib,
+            **self.engine_kw,
+        )
+
+    def _recalibrate(self):
+        from mgproto_tpu.serving.calibration import calibrate
+
+        cand = self.cons.candidate_state(self.base_state)
+        # thresholds come from the RECENT LIVE traffic rescored under the
+        # candidate (see recent_traffic above); the capture holdout and
+        # the bootstrap set are fallbacks for a cold start
+        traffic = list(self.recent_traffic)
+        bs = self.id_batch_size
+        batches = [
+            (np.stack(traffic[j:j + bs]),
+             np.zeros((bs,), np.int32))
+            for j in range(0, len(traffic) - bs + 1, bs)
+        ]
+        if not batches:
+            batches = self.capture.recal_batches(bs) or self.id_batches
+        calib = calibrate(self.trainer, cand, batches)
+        self._pending_candidate = (cand, calib)
+        return calib
+
+    def _factory_builder(self, calibration):
+        from mgproto_tpu.serving.engine import ServingEngine
+
+        cand, _ = self._pending_candidate
+
+        def factory():
+            return ServingEngine.from_live(
+                self.trainer, cand, calibration=calibration,
+                **self.engine_kw,
+            )
+
+        return factory
+
+    def _on_commit(self, calibration) -> None:
+        cand, _ = self._pending_candidate
+        self.serving_state = cand
+        self.calib = calibration
+        self.capture.retarget(calibration)
+        self.drift.rebase(calibration, *self.cons.bank_arrays())
+
+    # ------------------------------------------------------------------ ticks
+    def observe_responses(self, responses) -> None:
+        for r in responses:
+            if r.outcome in ("predict", "abstain") and r.log_px is not None:
+                self.drift.observe_px(r.log_px)
+
+    def tick(self, now: float) -> None:
+        """One pump-adjacent poll: consolidate on cadence, refresh drift,
+        republish on breach. Zero VIRTUAL time — structurally off the
+        serving hot path."""
+        report = self.cons.tick(now)
+        if report is not None and report.result == "ran":
+            self.drift.observe_bank(*self.cons.bank_arrays())
+        d = self.drift.evaluate(now)
+        if d is not None and d.breached and self.first_breach is None:
+            self.first_breach = d.to_dict()
+        if d is not None and self.republisher is not None:
+            self.republisher.maybe_republish(d, now=now)
+
+    # ---------------------------------------------------------------- result
+    def accuracy_windows(
+        self, responses, index_of: Dict[str, int], window: int
+    ) -> list:
+        """Served-accuracy / abstain / p(x) curves over request-index
+        windows. Served accuracy counts an answer as correct only when it
+        is a trusted (in_dist) prediction of the true class — an abstained
+        request is an unanswered one from the operator's seat. Poisoned
+        requests carry fake labels and are excluded."""
+        rows: Dict[int, Dict] = {}
+        for r in responses:
+            i = index_of.get(r.request_id)
+            if i is None or r.request_id in self.poisoned:
+                continue
+            if r.outcome not in ("predict", "abstain"):
+                continue
+            w = i // window
+            row = rows.setdefault(w, {
+                "window": w, "first_request": w * window,
+                "answered": 0, "predict": 0, "abstain": 0,
+                "raw_correct": 0, "served_correct": 0, "log_px_sum": 0.0,
+                "drifted": 0,
+            })
+            truth = self.truth.get(r.request_id)
+            row["answered"] += 1
+            row["drifted"] += bool(self.drifted.get(r.request_id))
+            if r.log_px is not None:
+                row["log_px_sum"] += r.log_px
+            if r.outcome == "predict":
+                row["predict"] += 1
+            else:
+                row["abstain"] += 1
+            if r.prediction is not None and truth is not None \
+                    and int(r.prediction) == truth:
+                row["raw_correct"] += 1
+                if r.outcome == "predict" and r.trust == "in_dist":
+                    row["served_correct"] += 1
+        out = []
+        for w in sorted(rows):
+            row = rows[w]
+            n = row["answered"]
+            out.append({
+                "window": row["window"],
+                "first_request": row["first_request"],
+                "answered": n,
+                "abstain_rate": round(row["abstain"] / n, 4) if n else None,
+                "raw_accuracy": round(row["raw_correct"] / n, 4) if n else None,
+                "served_accuracy":
+                    round(row["served_correct"] / n, 4) if n else None,
+                "mean_log_px":
+                    round(row["log_px_sum"] / n, 4) if n else None,
+                "drifted_fraction": round(row["drifted"] / n, 4) if n else None,
+            })
+        return out
 
 
 def run_load_test(
@@ -112,6 +506,19 @@ def run_load_test(
     nan_rate: float = 0.0,
     device_errors: Sequence[int] = (),
     trace_out: Optional[str] = None,
+    drift_at: Optional[int] = None,
+    drift_kind: str = "shift",
+    drift_magnitude: float = 0.35,
+    online: bool = False,
+    online_cadence_s: float = 0.5,
+    capture_percentile: float = 25.0,
+    capture_capacity: int = 48,
+    republish_min_interval_s: float = 2.0,
+    px_divergence_threshold: float = 0.25,
+    mean_shift_threshold: float = 0.0,
+    poison_rate: Optional[float] = None,
+    class_bucket: int = 8,
+    accuracy_window: int = 40,
 ) -> Dict:
     """Drive the storm; returns the result record (see module docstring).
     Importable — tests/test_load_plane.py runs the acceptance drill through
@@ -139,9 +546,18 @@ def run_load_test(
         set_current_registry,
     )
 
+    online_mode = online or drift_at is not None
+    if poison_rate is None:
+        poison_rate = float(
+            os.environ.get("MGPROTO_CHAOS_ONLINE_POISON_RATE") or 0.0
+        )
     registry = MetricRegistry()
     prev_registry = set_current_registry(registry)
     sm.register_serving_metrics(registry)
+    if online_mode:
+        from mgproto_tpu.online.metrics import register_online_metrics
+
+        register_online_metrics(registry)
     bad_swaps = 1 if swap_bad_at is not None else 0
     plan = chaos_mod.ChaosPlan(
         seed=seed,
@@ -151,26 +567,68 @@ def run_load_test(
         serve_replica_kill_at=kill_at,
         serve_wedge_at=wedge_at,
         serve_swap_bad_artifact=bad_swaps,
+        online_poison_rate=poison_rate if online_mode else 0.0,
     )
     prev_chaos = chaos_mod.set_active(
         chaos_mod.ChaosState(plan) if plan.any_active() else None
     )
+    prev_capture = None
     try:
-        cfg = tiny_test_config()
-        trainer = Trainer(cfg, steps_per_epoch=1)
-        state = trainer.init_state(jax.random.PRNGKey(seed))
-        rng = np.random.RandomState(seed)
-        id_batches = [
-            (
-                rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3)
-                .astype(np.float32),
-                rng.randint(0, cfg.model.num_classes, (4,)).astype(np.int32),
-            )
-            for _ in range(2)
-        ]
-        calib = calibrate(trainer, state, id_batches)
         clock = VirtualClock()
         service_s = service_ms / 1000.0
+        plane: Optional[OnlinePlane] = None
+        if online_mode:
+            import dataclasses as _dc
+
+            from mgproto_tpu.online import capture as capture_mod
+            from mgproto_tpu.online.classes import apply_class_bucket
+
+            cfg = tiny_test_config()
+            base_classes = cfg.model.num_classes
+            # pad the class axis to the bucket (classes can be added at
+            # run time with zero trunk recompiles) and give EM a drill-
+            # scale mean step so consolidation converges in a few passes
+            cfg = apply_class_bucket(cfg.replace(
+                model=_dc.replace(cfg.model, class_bucket=class_bucket),
+                em=_dc.replace(cfg.em, mean_lr=0.05),
+            ))
+            trainer = Trainer(cfg, steps_per_epoch=1)
+            state = trainer.init_state(jax.random.PRNGKey(seed))
+            plane = OnlinePlane(
+                trainer, state, clock,
+                seed=seed,
+                base_classes=base_classes,
+                drift_kind=drift_kind,
+                drift_magnitude=drift_magnitude,
+                capture_percentile=capture_percentile,
+                capture_capacity=capture_capacity,
+                online_cadence_s=online_cadence_s,
+                republish_min_interval_s=republish_min_interval_s,
+                px_divergence_threshold=px_divergence_threshold,
+                mean_shift_threshold=mean_shift_threshold,
+                engine_kw=dict(
+                    buckets=tuple(buckets),
+                    clock=clock,
+                    queue_capacity=queue_capacity,
+                    default_deadline_s=deadline_ms / 1000.0,
+                ),
+            )
+            prev_capture = capture_mod.install(plane.capture)
+        else:
+            cfg = tiny_test_config()
+            trainer = Trainer(cfg, steps_per_epoch=1)
+            state = trainer.init_state(jax.random.PRNGKey(seed))
+            rng = np.random.RandomState(seed)
+            id_batches = [
+                (
+                    rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3)
+                    .astype(np.float32),
+                    rng.randint(0, cfg.model.num_classes, (4,))
+                    .astype(np.int32),
+                )
+                for _ in range(2)
+            ]
+            calib = calibrate(trainer, state, id_batches)
 
         tracer = None
         if trace_out:
@@ -182,15 +640,18 @@ def run_load_test(
             tracer = Tracer()
             reqtrace.enable(clock=clock, tracer=tracer)
 
-        def factory():
-            return ServingEngine.from_live(
-                trainer, state,
-                calibration=calib,
-                buckets=tuple(buckets),
-                clock=clock,
-                queue_capacity=queue_capacity,
-                default_deadline_s=deadline_ms / 1000.0,
-            )
+        if plane is not None:
+            factory = plane.factory
+        else:
+            def factory():
+                return ServingEngine.from_live(
+                    trainer, state,
+                    calibration=calib,
+                    buckets=tuple(buckets),
+                    clock=clock,
+                    queue_capacity=queue_capacity,
+                    default_deadline_s=deadline_ms / 1000.0,
+                )
 
         rs = ReplicaSet(
             factory,
@@ -207,13 +668,19 @@ def run_load_test(
             pre_dispatch=lambda: clock.advance(service_s),
         )
         warmup_compiles = rs.start()
+        if plane is not None:
+            plane.bind_replica_set(rs)
 
         responses = []
         swap_reports = []
         submitted: List[str] = []
         phase_of: Dict[str, int] = {}
+        index_of: Dict[str, int] = {}
         payload_rng = np.random.RandomState(seed + 1)
         img = cfg.model.img_size
+        poison_injected = 0
+        chaos = chaos_mod.get_active()
+        drift_injected_t: Optional[float] = None
         i = 0
         for phase_idx, (duration_s, rps) in enumerate(phases):
             n = max(int(round(duration_s * rps)), 1)
@@ -230,9 +697,27 @@ def run_load_test(
                 rid = f"q{i}"
                 submitted.append(rid)
                 phase_of[rid] = phase_idx
-                payload = payload_rng.rand(img, img, 3).astype(np.float32)
+                index_of[rid] = i
+                if plane is not None:
+                    if drift_at is not None and i == drift_at:
+                        plane.start_drift(clock())
+                        drift_injected_t = clock()
+                    poisoned = (
+                        chaos is not None and chaos.online_poison_due(i)
+                    )
+                    poison_injected += poisoned
+                    payload = plane.next_payload(rid, poisoned)
+                else:
+                    payload = payload_rng.rand(img, img, 3).astype(np.float32)
+                before = len(responses)
                 responses.extend(rs.submit(payload, request_id=rid))
                 responses.extend(rs.poll())
+                if plane is not None:
+                    # the continual-learning side-plane runs BETWEEN pump
+                    # polls and consumes zero virtual time: pump latency
+                    # under the drill is the no-online storm's, asserted
+                    plane.observe_responses(responses[before:])
+                    plane.tick(clock())
                 clock.advance(spacing)
                 i += 1
         # drain: keep pumping virtual time until every request is answered
@@ -242,7 +727,11 @@ def run_load_test(
         for _ in range(10_000):
             if len(answered) >= len(submitted):
                 break
+            before = len(responses)
             responses.extend(rs.poll())
+            if plane is not None:
+                plane.observe_responses(responses[before:])
+                plane.tick(clock())
             answered = {r.request_id for r in responses}
             clock.advance(drain_dt)
         responses.extend(rs.drain())
@@ -341,6 +830,72 @@ def run_load_test(
             "steady_state_recompiles": rs.steady_recompiles,
             "virtual_duration_s": round(clock(), 3),
         }
+        if plane is not None:
+            # poisoned requests that actually got STAGED — must be zero:
+            # the capture gate is the thing standing between mislabeled
+            # junk and the banks (capture's own accepted-id record is the
+            # ground truth, not a re-derivation under a later threshold)
+            poison_eligible = sum(
+                1 for rid in plane.poisoned
+                if plane.capture.was_captured(rid)
+            )
+            republishes = [
+                rec.to_dict() for rec in plane.republisher.records
+            ] if plane.republisher is not None else []
+            commits = [
+                rec for rec in republishes if rec["result"] == "committed"
+            ]
+            first_commit_t = commits[0]["t"] if commits else None
+            detected_before = bool(
+                plane.first_breach is not None
+                and (first_commit_t is None
+                     or plane.first_breach["t"] <= first_commit_t)
+            )
+            windows = plane.accuracy_windows(
+                responses, index_of, accuracy_window
+            )
+            result["online"] = {
+                "drift_at": drift_at,
+                "drift_kind": drift_kind,
+                "drift_magnitude": drift_magnitude,
+                "drift_injected_t": drift_injected_t,
+                "class_bucket": class_bucket,
+                "base_classes": plane.base_classes,
+                "padded_classes": plane.directory.padded_classes,
+                "new_class_slot": plane.new_slot,
+                "labeled_feedback": plane.labeled_feedback,
+                "capture": plane.capture.stats(),
+                "capture_by_outcome": _label_counts(
+                    snapshot, "online_capture_total", "outcome"
+                ),
+                "poison": {
+                    "rate": poison_rate,
+                    "injected": poison_injected,
+                    "capture_eligible": poison_eligible,
+                },
+                "consolidation": _consolidation_block(plane.cons),
+                "detection": {
+                    "first_breach": plane.first_breach,
+                    "first_commit_t": first_commit_t,
+                    "detected_before_correction": detected_before,
+                },
+                "drift_gauges": {
+                    "px_divergence": _gauge_value(
+                        snapshot, "drift_px_divergence"
+                    ),
+                    "mean_shift_max": _gauge_value(
+                        snapshot, "drift_class_mean_shift_max"
+                    ),
+                    "breaches_by_signal": _label_counts(
+                        snapshot, "drift_breach_total", "signal"
+                    ),
+                },
+                "republishes": republishes,
+                "republish_by_result": _label_counts(
+                    snapshot, "online_republish_total", "result"
+                ),
+                "accuracy_windows": windows,
+            }
         if tracer is not None:
             os.makedirs(os.path.dirname(trace_out) or ".", exist_ok=True)
             tracer.export_chrome_trace(trace_out)
@@ -359,6 +914,10 @@ def run_load_test(
             from mgproto_tpu.obs import reqtrace
 
             reqtrace.disable()
+        if online_mode:
+            from mgproto_tpu.online import capture as capture_mod
+
+            capture_mod.install(prev_capture)
         chaos_mod.set_active(prev_chaos)
         set_current_registry(prev_registry)
 
@@ -385,6 +944,33 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--swap-good-at", type=int, default=None)
     p.add_argument("--malformed-rate", type=float, default=0.0)
     p.add_argument("--nan-rate", type=float, default=0.0)
+    p.add_argument("--drift-at", type=int, default=None,
+                   help="request index at which the traffic distribution "
+                        "shifts (implies the online drift drill)")
+    p.add_argument("--drift-kind", choices=("shift", "new_class"),
+                   default="shift",
+                   help="shift = covariate shift of every class; "
+                        "new_class = a brand-new class appears and claims "
+                        "a padded class_bucket slot")
+    p.add_argument("--drift-magnitude", type=float, default=0.35)
+    p.add_argument("--online", action="store_true",
+                   help="run the continual-learning plane (capture + "
+                        "consolidation + drift monitor + republish) "
+                        "beside the storm even without --drift-at")
+    p.add_argument("--online-cadence-s", type=float, default=0.5,
+                   help="virtual-clock consolidation/drift-eval cadence")
+    p.add_argument("--capture-percentile", type=float, default=25.0,
+                   help="calibration percentile a request's log p(x) must "
+                        "clear to be captured for consolidation")
+    p.add_argument("--class-bucket", type=int, default=8,
+                   help="pad the class axis to this bucket (online class "
+                        "addition without trunk recompiles)")
+    p.add_argument("--accuracy-window", type=int, default=40,
+                   help="requests per accuracy/abstain/p(x) curve window")
+    p.add_argument("--poison-rate", type=float, default=None,
+                   help="fraction of requests replaced with low-p(x) "
+                        "mislabeled junk the capture gate must reject "
+                        "(default: MGPROTO_CHAOS_ONLINE_POISON_RATE)")
     p.add_argument("--out", default="",
                    help="write the JSON line here (e.g. "
                         "evidence/load_test_baseline.json)")
@@ -411,6 +997,15 @@ def main(argv: Optional[list] = None) -> int:
         malformed_rate=args.malformed_rate,
         nan_rate=args.nan_rate,
         trace_out=args.trace or None,
+        drift_at=args.drift_at,
+        drift_kind=args.drift_kind,
+        drift_magnitude=args.drift_magnitude,
+        online=args.online,
+        online_cadence_s=args.online_cadence_s,
+        capture_percentile=args.capture_percentile,
+        class_bucket=args.class_bucket,
+        accuracy_window=args.accuracy_window,
+        poison_rate=args.poison_rate,
     )
     line = json.dumps(result, sort_keys=True)
     print(line)

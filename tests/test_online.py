@@ -1,0 +1,690 @@
+"""Online MGProto (ISSUE 11): trusted capture, background consolidation,
+class addition without trunk recompiles, drift detection via p(x), and the
+recalibrate + blue/green republish loop — plus the committed drift-drill
+evidence contract and the lint/metric satellites.
+
+IMPORTANT — run the suite via `scripts/test.sh` (or export JAX_PLATFORMS=cpu
+yourself): the drill tests drive real jitted programs on CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serving]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+# ------------------------------------------------------------ capture (unit)
+class _Resp:
+    def __init__(self, outcome="predict", trust="in_dist", log_px=1.0,
+                 prediction=0, degraded=False, request_id="r0"):
+        self.outcome = outcome
+        self.trust = trust
+        self.log_px = log_px
+        self.prediction = prediction
+        self.degraded = degraded
+        self.request_id = request_id
+
+
+def _calib(scores=None, fingerprint="fp0", n_classes=4):
+    from mgproto_tpu.serving.calibration import Calibration
+
+    rng = np.random.RandomState(0)
+    scores = rng.randn(256) if scores is None else np.asarray(scores)
+    logits = rng.randn(scores.size, n_classes)
+    return Calibration.from_scores(scores, logits, fingerprint)
+
+
+class TestTrustedCapture:
+    def _capture(self, **kw):
+        from mgproto_tpu.online.capture import CaptureConfig, TrustedCapture
+
+        cfg = CaptureConfig(**{"percentile": 25.0, "capacity_per_class": 4,
+                               "seed": 0, **kw})
+        return TrustedCapture(_calib(), num_classes=4, config=cfg)
+
+    def test_accepts_trusted_high_px_prediction(self):
+        cap = self._capture()
+        assert cap.on_response(
+            np.zeros((2, 2, 3)),
+            _Resp(log_px=cap.threshold + 1.0, request_id="a"),
+        )
+        assert cap.staged_count() == 1 and cap.was_captured("a")
+
+    def test_rejects_below_gate_and_at_threshold(self):
+        cap = self._capture()
+        assert not cap.on_response(
+            np.zeros(3), _Resp(log_px=cap.threshold - 1.0)
+        )
+        # the boundary itself does not clear the gate (strict >)
+        assert not cap.on_response(
+            np.zeros(3), _Resp(log_px=cap.threshold)
+        )
+        assert cap.staged_count() == 0
+
+    @pytest.mark.parametrize("resp", [
+        _Resp(outcome="abstain", trust="abstain"),
+        _Resp(outcome="reject"),
+        _Resp(outcome="shed"),
+        _Resp(degraded=True),
+        _Resp(trust="ungated"),
+        _Resp(log_px=None),
+    ])
+    def test_untrusted_outcomes_never_stage(self, resp):
+        cap = self._capture()
+        assert not cap.on_response(np.zeros(3), resp)
+        assert cap.staged_count() == 0
+
+    def test_unknown_class_rejected(self):
+        cap = self._capture()
+        assert not cap.on_response(
+            np.zeros(3), _Resp(log_px=10.0, prediction=99)
+        )
+
+    def test_reservoir_bounds_and_counts_evictions(self):
+        cap = self._capture(capacity_per_class=4)
+        for i in range(20):
+            cap.on_response(
+                np.full(3, i), _Resp(log_px=10.0, request_id=f"r{i}")
+            )
+        assert cap.staged_count() == 4
+        # only ACTUAL displacements count as evictions (an arriving sample
+        # the reservoir step drops displaces nothing)
+        assert cap.accepted == 20 and 0 < cap.evicted <= 16
+
+    def test_labeled_feedback_bypasses_gate(self):
+        cap = self._capture()
+        assert cap.submit_labeled(np.zeros(3), 2, request_id="fb")
+        assert cap.staged_count() == 1
+        assert not cap.submit_labeled(np.zeros(3), 99)
+
+    def test_drain_clears_recal_holdout_persists(self):
+        cap = self._capture()
+        for i in range(6):
+            cap.on_response(
+                np.full(3, i), _Resp(log_px=10.0, request_id=f"r{i}",
+                                     prediction=i % 4)
+            )
+        held = len(cap.recal_samples())
+        drained = cap.drain()
+        assert len(drained) == cap.staged_count() + len(drained)  # cleared
+        assert cap.staged_count() == 0
+        assert len(cap.recal_samples()) == held > 0
+
+    def test_retarget_moves_gate_threshold(self):
+        cap = self._capture()
+        t0 = cap.threshold
+        cap.retarget(_calib(scores=np.random.RandomState(1).randn(256) + 5))
+        assert cap.threshold != t0
+
+    def test_tap_install_restore(self):
+        from mgproto_tpu.online import capture as capture_mod
+
+        cap = self._capture()
+        prev = capture_mod.install(cap)
+        try:
+            assert capture_mod.get_active() is cap
+        finally:
+            capture_mod.install(prev)
+
+
+# ------------------------------------------------------- class bucket (unit)
+class TestClassBucket:
+    def test_padded_num_classes(self):
+        from mgproto_tpu.online.classes import padded_num_classes
+
+        assert padded_num_classes(4, 0) == 4
+        assert padded_num_classes(4, 1) == 4
+        assert padded_num_classes(4, 8) == 8
+        assert padded_num_classes(8, 8) == 8
+        assert padded_num_classes(9, 8) == 16
+
+    def test_apply_class_bucket(self):
+        import dataclasses
+
+        from mgproto_tpu.config import tiny_test_config
+        from mgproto_tpu.online.classes import apply_class_bucket
+
+        cfg = tiny_test_config()
+        assert apply_class_bucket(cfg) is cfg  # bucket unset: no-op
+        cfg2 = cfg.replace(
+            model=dataclasses.replace(cfg.model, class_bucket=8)
+        )
+        assert apply_class_bucket(cfg2).model.num_classes == 8
+
+    def test_directory_add_until_bucket_full(self):
+        from mgproto_tpu.online.classes import ClassBucketFull, ClassDirectory
+
+        d = ClassDirectory(4, 6)
+        assert d.active_classes == 4 and d.free_slots == 2
+        assert d.add_class("x") == 4
+        assert d.add_class() == 5
+        assert d.slot_of("x") == 4
+        with pytest.raises(ClassBucketFull):
+            d.add_class()
+
+    def test_floor_and_claim_priors(self):
+        import jax
+
+        from mgproto_tpu.config import tiny_test_config
+        from mgproto_tpu.core.mgproto import init_gmm
+        from mgproto_tpu.online.classes import claim_slot, floor_padded_priors
+
+        cfg = tiny_test_config(num_classes=6)
+        gmm = init_gmm(cfg.model, jax.random.PRNGKey(0))
+        gmm = floor_padded_priors(gmm, 4)
+        priors = np.asarray(gmm.priors)
+        assert (priors[4:] == 0.0).all() and (priors[:4] > 0).all()
+        gmm = claim_slot(gmm, 4)
+        k = priors.shape[1]
+        assert np.allclose(np.asarray(gmm.priors)[4], 1.0 / k)
+        assert (np.asarray(gmm.priors)[5] == 0.0).all()
+
+
+# ------------------------------------------------------- drift monitor (unit)
+class TestDriftMonitor:
+    def _monitor(self, **kw):
+        from mgproto_tpu.online.drift import DriftConfig, DriftMonitor
+
+        clock = {"t": 0.0}
+        cfg = DriftConfig(**{
+            "px_window": 128, "min_px_samples": 32,
+            "eval_interval_s": 1.0, "px_divergence_threshold": 0.3,
+            "mean_shift_threshold": 0.5, **kw,
+        })
+        mon = DriftMonitor(_calib(), cfg, clock=lambda: clock["t"])
+        return mon, clock
+
+    def test_matching_scores_do_not_breach(self):
+        mon, clock = self._monitor()
+        rng = np.random.RandomState(0)
+        for s in rng.randn(128):  # same distribution the sketch was cut from
+            mon.observe_px(float(s))
+        clock["t"] = 2.0
+        rep = mon.evaluate()
+        assert rep is not None and not rep.breached
+        assert rep.px_divergence is not None and rep.px_divergence < 0.3
+
+    def test_shifted_scores_breach_px_signal(self):
+        mon, clock = self._monitor()
+        rng = np.random.RandomState(0)
+        for s in rng.randn(128) - 2.0:  # whole curve moved ~1.5 IQR
+            mon.observe_px(float(s))
+        clock["t"] = 2.0
+        rep = mon.evaluate()
+        assert rep.breached and "px" in rep.signals
+        assert mon.breaches == 1 and mon.first_breach is not None
+
+    def test_cadence_gating_and_min_samples(self):
+        mon, clock = self._monitor()
+        mon.observe_px(0.0)
+        clock["t"] = 0.5
+        # before the interval elapses evaluate() must do nothing
+        mon._next_eval = 1.0
+        assert mon.evaluate() is None
+        clock["t"] = 2.0
+        rep = mon.evaluate()
+        assert rep is not None and rep.px_divergence is None  # < min samples
+
+    def test_bank_shift_against_baseline(self):
+        mon, clock = self._monitor(px_divergence_threshold=0.0,
+                                   mean_shift_threshold=0.5)
+        rng = np.random.RandomState(0)
+        feats = rng.randn(3, 8, 4).astype(np.float32)
+        length = np.array([8, 8, 0])
+        mon.set_bank_baseline(feats, length)
+        moved = feats.copy()
+        moved[1] += 1.0  # class 1 bank mean moves by ||1||*2 = 2.0
+        mon.observe_bank(moved, length)
+        clock["t"] = 2.0
+        rep = mon.evaluate()
+        assert rep.breached and "bank" in rep.signals
+        assert rep.class_shifts[1] == pytest.approx(2.0)
+        assert rep.class_shifts[0] == pytest.approx(0.0)
+        assert 2 not in rep.class_shifts  # empty bank: no drift claim
+
+    def test_rebase_resets_window_and_breach_latch(self):
+        mon, clock = self._monitor()
+        for s in np.random.RandomState(0).randn(128) - 2.0:
+            mon.observe_px(float(s))
+        clock["t"] = 2.0
+        assert mon.evaluate().breached
+        mon.rebase(_calib())
+        assert mon.first_breach is None and len(mon._scores) == 0
+
+
+# ------------------------------------- consolidation + class addition (jit)
+@pytest.fixture(scope="module")
+def booted():
+    """A bootstrapped online stack on the padded tiny model: trainer,
+    serving snapshot, consolidator, and the class-conditional generator."""
+    import dataclasses
+
+    import jax
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.online import classes as ocl
+    from mgproto_tpu.online.capture import CapturedSample
+    from mgproto_tpu.online.consolidate import Consolidator, ConsolidatorConfig
+
+    cfg = tiny_test_config()
+    cfg = ocl.apply_class_bucket(cfg.replace(
+        model=dataclasses.replace(cfg.model, class_bucket=8),
+        em=dataclasses.replace(cfg.em, mean_lr=0.05),
+    ))
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    state = state.replace(gmm=ocl.floor_padded_priors(state.gmm, 4))
+    rng = np.random.RandomState(0)
+
+    def gen(cls, n, drift=0.0):
+        img = cfg.model.img_size
+        xx, yy = np.meshgrid(np.arange(img), np.arange(img), indexing="ij")
+        ang = (cls * 45.0 + drift * 30.0) * np.pi / 180.0
+        wave = np.cos(2 * np.pi * (cls + 1)
+                      * (xx * np.cos(ang) + yy * np.sin(ang)) / img)
+        base = np.repeat(wave[..., None].astype(np.float32), 3, axis=2)
+        base[..., cls % 3] += 1.0
+        return [base + rng.randn(img, img, 3).astype(np.float32) * 0.05
+                for _ in range(n)]
+
+    cons = Consolidator(
+        trainer, state, config=ConsolidatorConfig(batch_width=8)
+    )
+    for _ in range(20):
+        for c in range(4):
+            cons.ingest([
+                CapturedSample(p, c, None, "boot", True)
+                for p in gen(c, 8)
+            ])
+    return {
+        "cfg": cfg, "trainer": trainer, "state": state, "cons": cons,
+        "gen": gen,
+        "snapshot": cons.candidate_state(state),
+    }
+
+
+class TestConsolidation:
+    def test_bootstrap_fits_a_real_classifier(self, booted):
+        trainer, gen = booted["trainer"], booted["gen"]
+        snap = booted["snapshot"]
+        correct = total = 0
+        for c in range(4):
+            out = trainer.eval_step(snap, np.stack(gen(c, 8)))
+            correct += int((np.argmax(np.asarray(out.logits), -1) == c).sum())
+            total += 8
+        assert correct / total >= 0.9
+
+    def test_consolidation_program_compiles_exactly_once(self, booted):
+        cons = booted["cons"]
+        cons.monitor.check_recompiles()
+        assert cons.monitor.recompile_count == 1
+        assert cons.runs >= 80 and cons.samples_consolidated >= 600
+
+    def test_padded_slots_never_win_argmax(self, booted):
+        trainer, gen = booted["trainer"], booted["gen"]
+        snap = booted["snapshot"]
+        for c in range(4):
+            out = trainer.eval_step(snap, np.stack(gen(c, 8)))
+            assert int(np.asarray(out.logits).argmax(-1).max()) < 4
+
+    def test_class_addition_without_recompile(self, booted):
+        """The acceptance criterion: a new class claims a padded slot,
+        its bank fills through the SAME compiled consolidation program,
+        and the eval program keeps serving — compile counts asserted."""
+        from mgproto_tpu.online.capture import CapturedSample
+        from mgproto_tpu.online.classes import ClassDirectory
+
+        trainer, cons, gen = booted["trainer"], booted["cons"], booted["gen"]
+        directory = ClassDirectory(4, booted["cfg"].model.num_classes)
+        eval_cache_before = trainer._eval_step._cache_size()
+        cons.monitor.check_recompiles()
+        compiles_before = cons.monitor.recompile_count
+
+        slot = directory.add_class("new")
+        assert slot == 4
+        cons.claim_class(slot)
+        for _ in range(12):
+            cons.ingest([
+                CapturedSample(p, slot, None, "fb", True)
+                for p in gen(slot, 8)
+            ])
+        cons.monitor.check_recompiles()
+        assert cons.monitor.recompile_count == compiles_before  # no retrace
+
+        snap = cons.candidate_state(booted["state"])
+        out = trainer.eval_step(snap, np.stack(gen(slot, 8)))
+        preds = np.argmax(np.asarray(out.logits), -1)
+        assert (preds == slot).mean() >= 0.75  # the new class is learned
+        # the eval program never recompiled for the grown class count
+        assert trainer._eval_step._cache_size() == eval_cache_before
+
+
+# ----------------------------------------- recalibration idempotence (unit)
+class TestRecalibration:
+    def test_recalibration_is_bit_identical_on_unchanged_bank(self, booted):
+        """Satellite: re-deriving calibration on an unchanged bank must be
+        bit-identical — thresholds, temperatures, quantile sketch."""
+        from mgproto_tpu.serving.calibration import calibrate
+
+        trainer, gen = booted["trainer"], booted["gen"]
+        snap = booted["snapshot"]
+        batches = [
+            (np.stack(gen(c, 4)), np.full((4,), c, np.int32))
+            for c in range(4)
+        ]
+        a = calibrate(trainer, snap, batches)
+        b = calibrate(trainer, snap, batches)
+        assert a.to_dict() == b.to_dict()
+        assert a.quantile_log_px == b.quantile_log_px
+        assert a.per_class_temperature == b.per_class_temperature
+        assert a.gmm_fingerprint == b.gmm_fingerprint
+
+    def test_from_scores_handles_padded_inf_columns(self):
+        from mgproto_tpu.serving.calibration import Calibration
+
+        rng = np.random.RandomState(0)
+        logits = rng.randn(64, 6)
+        logits[:, 4:] = -np.inf  # padded class-bucket slots
+        calib = Calibration.from_scores(rng.randn(64), logits, "fp")
+        temps = np.asarray(calib.per_class_temperature)
+        assert np.isfinite(temps).all()
+        assert temps[4] == 1.0 and temps[5] == 1.0
+
+    def test_republished_state_roundtrips_trustgate(self, booted):
+        """Satellite: a calibration derived from the candidate gates the
+        candidate (fingerprint match), and fails CLOSED against any other
+        mixture."""
+        from mgproto_tpu.serving.calibration import calibrate
+        from mgproto_tpu.serving.engine import ServingEngine
+
+        trainer, gen = booted["trainer"], booted["gen"]
+        snap = booted["snapshot"]
+        batches = [
+            (np.stack(gen(c, 4)), np.full((4,), c, np.int32))
+            for c in range(4)
+        ]
+        calib = calibrate(trainer, snap, batches)
+        engine = ServingEngine.from_live(
+            trainer, snap, calibration=calib, buckets=(4,)
+        )
+        assert not engine.gate.degraded
+        assert not engine.gate.fingerprint_mismatch
+        # the same calibration against the PRE-consolidation mixture is a
+        # stale-calibration operator error: degrade, never misgate
+        stale = ServingEngine.from_live(
+            trainer, booted["state"], calibration=calib, buckets=(4,)
+        )
+        assert stale.gate.fingerprint_mismatch and stale.gate.degraded
+
+
+# ------------------------------------------------------- drift drill (storm)
+DRILL = dict(
+    seed=0,
+    phases=((1.0, 40.0), (2.0, 40.0), (2.0, 40.0)),
+    online=True,
+    drift_at=60,
+    capture_percentile=10.0,
+    poison_rate=0.05,
+    accuracy_window=20,
+)
+
+
+@pytest.fixture(scope="module")
+def drill_result():
+    from load_test import run_load_test
+
+    return run_load_test(**DRILL)
+
+
+class TestDriftDrill:
+    def test_every_request_answered_zero_dropped(self, drill_result):
+        assert drill_result["overall"]["zero_dropped"] is True
+
+    def test_zero_steady_state_recompiles(self, drill_result):
+        assert drill_result["steady_state_recompiles"] == 0
+        cons = drill_result["online"]["consolidation"]
+        assert cons["compiles"] == 1 and cons["steady_recompiles"] == 0
+
+    def test_drift_detected_via_px_before_correction(self, drill_result):
+        det = drill_result["online"]["detection"]
+        fb = det["first_breach"]
+        assert fb is not None and "px" in fb["signals"]
+        assert det["first_commit_t"] is not None
+        assert fb["t"] <= det["first_commit_t"]
+        assert det["detected_before_correction"] is True
+
+    def test_republish_committed_through_swap(self, drill_result):
+        o = drill_result["online"]
+        assert o["republish_by_result"].get("committed", 0) >= 1
+        commit = [r for r in o["republishes"]
+                  if r["result"] == "committed"][0]
+        assert commit["swap"]["reason"] == "committed"
+        assert commit["calibration_fingerprint"]
+
+    def test_accuracy_dips_then_recovers(self, drill_result):
+        windows = drill_result["online"]["accuracy_windows"]
+        pre = [w["served_accuracy"] for w in windows
+               if w["drifted_fraction"] == 0]
+        drifted = [w["served_accuracy"] for w in windows
+                   if (w["drifted_fraction"] or 0) > 0.5]
+        assert pre and drifted
+        pre_acc = sum(pre) / len(pre)
+        assert min(drifted) <= pre_acc - 0.2  # the dip is real
+        assert drifted[-1] >= min(drifted) + 0.2  # and corrected
+
+    def test_poison_counted_and_never_captured(self, drill_result):
+        poison = drill_result["online"]["poison"]
+        assert poison["injected"] > 0
+        assert poison["capture_eligible"] == 0
+
+    def test_consolidation_off_the_hot_path(self, drill_result):
+        """Pump latency under the drill equals the plain storm's, phase by
+        phase: the online plane consumes zero virtual time between polls."""
+        from load_test import run_load_test
+
+        offline = run_load_test(
+            seed=DRILL["seed"], phases=DRILL["phases"]
+        )
+        for on, off in zip(drill_result["phases"], offline["phases"]):
+            assert on["p50_ms"] == off["p50_ms"]
+            assert on["p99_ms"] == off["p99_ms"]
+
+    def test_drill_is_deterministic(self):
+        from load_test import run_load_test
+
+        small = dict(DRILL, phases=((0.5, 40.0), (1.0, 40.0)), drift_at=30)
+        a = run_load_test(**small)
+        b = run_load_test(**small)
+        assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+    def test_capture_metrics_counted(self, drill_result):
+        cap = drill_result["online"]["capture_by_outcome"]
+        assert cap.get("accepted", 0) > 0
+        assert drill_result["online"]["consolidation"]["samples"] > 0
+
+
+@pytest.mark.serving
+def test_new_class_drill_grows_c_without_recompiles():
+    """new_class drift: a brand-new class appears, claims a padded slot,
+    gets labeled feedback, and after republish is served in-distribution —
+    with zero steady-state recompiles anywhere."""
+    from load_test import run_load_test
+
+    res = run_load_test(
+        seed=0,
+        phases=((1.0, 40.0), (3.0, 40.0)),
+        online=True,
+        drift_at=50,
+        drift_kind="new_class",
+        capture_percentile=10.0,
+    )
+    o = res["online"]
+    assert res["overall"]["zero_dropped"] is True
+    assert res["steady_state_recompiles"] == 0
+    assert o["consolidation"]["compiles"] == 1
+    assert o["new_class_slot"] == 4
+    assert o["labeled_feedback"] > 0
+    assert o["republish_by_result"].get("committed", 0) >= 1
+    # after the commit the new class is answered as trusted predictions:
+    # the last drifted window's served accuracy includes new-class traffic
+    drifted = [w for w in o["accuracy_windows"]
+               if (w["drifted_fraction"] or 0) > 0.5]
+    assert drifted and drifted[-1]["served_accuracy"] >= 0.6
+
+
+# -------------------------------------------------- committed evidence gate
+class TestCommittedDrillEvidence:
+    PATH = os.path.join(REPO, "evidence", "drift_drill.json")
+
+    def test_committed_record_passes_every_gate(self):
+        from mgproto_tpu.cli.telemetry import drift_drill_gates
+
+        with open(self.PATH) as f:
+            record = json.loads(f.read().strip())
+        assert record["drift_drill"] is True
+        result = drift_drill_gates(record)
+        assert result["ok"], [r for r in result["rows"] if not r["ok"]]
+        # schema spot checks the runbook documents
+        o = record["online"]
+        assert o["poison"]["injected"] > 0
+        assert o["poison"]["capture_eligible"] == 0
+        assert o["detection"]["detected_before_correction"] is True
+
+    def test_check_cli_gates_the_committed_record(self, capsys):
+        from mgproto_tpu.cli.telemetry import check_main
+
+        assert check_main(["--drift-drill", self.PATH]) == 0
+        out = capsys.readouterr().out
+        assert "drill.detected_before_correction" in out
+
+    def test_check_cli_fails_a_tampered_record(self, tmp_path, capsys):
+        from mgproto_tpu.cli.telemetry import check_main
+
+        with open(self.PATH) as f:
+            record = json.load(f)
+        record["steady_state_recompiles"] = 3
+        bad = tmp_path / "tampered.json"
+        bad.write_text(json.dumps(record))
+        assert check_main(["--drift-drill", str(bad)]) == 1
+        assert "FAIL" in capsys.readouterr().out
+
+
+# ------------------------------------------------- summarize drift section
+def test_summarize_renders_drift_section(tmp_path):
+    from mgproto_tpu.cli.telemetry import render_table, summarize
+    from mgproto_tpu.online import metrics as om
+    from mgproto_tpu.telemetry.session import TelemetrySession
+
+    session = TelemetrySession(str(tmp_path), primary=True)
+    try:
+        r = session.registry
+        r.counter(om.CAPTURED).inc(5.0, outcome="accepted")
+        r.counter(om.DRIFT_BREACHES).inc(2.0, signal="px")
+        r.gauge(om.DRIFT_PX_DIVERGENCE).set(0.4)
+        r.gauge(om.DRIFT_CLASS_SHIFT).set(0.7, **{"class": "2"})
+        r.counter(om.REPUBLISH).inc(1.0, result="committed")
+        session.flush()
+    finally:
+        session.close()
+    s = summarize(str(tmp_path))
+    drift = s["drift"]
+    assert drift["px_divergence"] == 0.4
+    assert drift["breaches_by_signal"] == {"px": 2.0}
+    assert drift["captures_by_outcome"]["accepted"] == 5.0
+    assert drift["class_shift_topk"] == {"2": 0.7}
+    assert drift["republish_by_result"] == {"committed": 1.0}
+    assert "drift (online learning)" in render_table(s)
+
+
+def test_registry_lint_covers_online_metrics():
+    """Every online_*/drift_* name is pre-registered (the registry-lint
+    ground truth is a real TelemetrySession)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_registry",
+        os.path.join(REPO, "scripts", "check_metric_registry.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from mgproto_tpu.online import metrics as om
+
+    names = mod.registered_names()
+    for name in om.ALL_COUNTERS + om.ALL_GAUGES:
+        assert name in names, f"{name} not pre-registered"
+
+
+# ------------------------------------------------------------ lint coverage
+class TestBlockingSleepLintCoversOnline:
+    SCRIPT = os.path.join(REPO, "scripts", "check_no_blocking_sleep.py")
+
+    def _run(self, root):
+        return subprocess.run(
+            [sys.executable, self.SCRIPT, str(root)],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_repo_online_is_clean(self):
+        proc = self._run(REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_detects_sleep_in_online_package(self, tmp_path):
+        pkg = tmp_path / "mgproto_tpu" / "online"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import time\n"
+            "def cadence():\n    time.sleep(1)\n"
+        )
+        proc = self._run(tmp_path)
+        out = proc.stdout.replace(os.sep, "/")
+        assert proc.returncode == 1
+        assert "online/bad.py:3" in out
+
+
+# --------------------------------------------------- chaos knob + serve CLI
+def test_online_poison_env_knob():
+    from mgproto_tpu.resilience import chaos as chaos_mod
+
+    plan = chaos_mod.plan_from_env(
+        {"MGPROTO_CHAOS_ONLINE_POISON_RATE": "0.25"}
+    )
+    assert plan is not None and plan.online_poison_rate == 0.25
+    state = chaos_mod.ChaosState(plan)
+    hits = sum(state.online_poison_due(i) for i in range(400))
+    assert 40 <= hits <= 160  # deterministic, roughly the configured rate
+    # same plan, same indices -> same decisions
+    state2 = chaos_mod.ChaosState(chaos_mod.ChaosPlan(
+        seed=plan.seed, online_poison_rate=0.25
+    ))
+    assert [state2.online_poison_due(i) for i in range(50)] == \
+        [chaos_mod.ChaosState(plan).online_poison_due(i) for i in range(50)]
+
+
+def test_serve_online_refuses_artifact_and_listen_faces(tmp_path):
+    import argparse
+
+    from mgproto_tpu.cli.serve import _setup_online, main as serve_main
+
+    # the network face does not tick the cadence yet: refuse loudly
+    with pytest.raises(SystemExit):
+        serve_main(["--online", "--listen", "127.0.0.1:0",
+                    "--allow-uncalibrated", "--artifact", "x.mgproto"])
+    # an artifact factory has no live context to consolidate into
+    args = argparse.Namespace(online=True)
+
+    def artifact_factory():
+        raise AssertionError("never called")
+
+    with pytest.raises(SystemExit):
+        _setup_online(args, artifact_factory, None)
