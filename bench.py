@@ -92,6 +92,9 @@ BEST_BATCH = _env_int("BENCH_BEST_BATCH", 256)
 # doubled activation working set fits without rematting the whole trunk.
 # 0 disables the entry (CI smoke).
 REMAT_BATCH = _env_int("BENCH_REMAT_BATCH", 512)
+# Batch for the f32 head-to-head (`fused_f32_b256`): the bf16 flagship's
+# measured counterpart (ISSUE 12). 0 disables the entry (CI smoke).
+F32_BATCH = _env_int("BENCH_F32_BATCH", 256)
 
 MAX_ATTEMPTS = 6
 BACKOFF_S = (5, 10, 20, 40, 60)  # >= 5 attempts spread over >= 2 minutes
@@ -139,12 +142,17 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def flagship_config(fused: bool, remat_stages: tuple = ()):
+def flagship_config(fused: bool, remat_stages: tuple = (),
+                    compute_dtype: str = "bfloat16"):
     """The flagship recipe (ResNet-34, CUB-200 shapes, bf16 trunk) — the ONE
     definition compiled by both this bench and scripts/perf_model.py, so the
     analytic pre-registration in PERF.md can never drift from what is timed
     on hardware. `remat_stages` opts stages into selective remat (the
-    batch-512 attempt runs layer1-only: the cheap-but-wide 112^2 stage)."""
+    batch-512 attempt runs layer1-only: the cheap-but-wide 112^2 stage);
+    `compute_dtype` is the mixed-precision knob (perf/precision.py) — the
+    flagship ships bf16, and the `fused_f32_b*` bench entry measures the
+    f32 counterpart head to head so the dtype win is a BENCH line, not a
+    belief."""
     from mgproto_tpu.config import Config, DataConfig, ModelConfig
 
     return Config(
@@ -153,7 +161,7 @@ def flagship_config(fused: bool, remat_stages: tuple = ()):
             num_classes=200,
             pretrained=False,
             # bf16 trunk on the MXU; params/BN-stats/density/losses stay f32
-            compute_dtype="bfloat16",
+            compute_dtype=compute_dtype,
             fused_scoring=fused,
             remat_stages=tuple(remat_stages),
         ),
@@ -189,7 +197,8 @@ def flops_from_cost_analysis(compiled, strict: bool = False):
 
 
 def run_config(
-    fused: bool, eval_mode: bool = False, remat_stages: tuple = ()
+    fused: bool, eval_mode: bool = False, remat_stages: tuple = (),
+    compute_dtype: str = "bfloat16",
 ) -> dict:
     """Steady-state throughput for one scoring path. Returns
     {imgs_per_sec, step_time_s, flops_per_step (or None), device_kind}.
@@ -235,7 +244,7 @@ def run_config(
     from mgproto_tpu.engine.train import Trainer
 
     _phase("init_model")
-    cfg = flagship_config(fused, remat_stages)
+    cfg = flagship_config(fused, remat_stages, compute_dtype=compute_dtype)
     trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
@@ -402,6 +411,7 @@ def run_config(
         "flops_per_step": flops,
         "device_kind": jax.devices()[0].device_kind,
         "batch": BATCH,
+        "compute_dtype": compute_dtype,
         "telemetry": telemetry,
     }
 
@@ -887,6 +897,139 @@ def measure_overlap() -> dict:
     }
 
 
+def measure_dtype() -> dict:
+    """Hermetic mixed-precision microbench (`python bench.py --measure
+    dtype`, CPU-friendly): the flagship step compiled/lowered at f32 AND
+    bf16, reporting both byte views per dtype —
+
+      * `cost_*`: XLA's compiled-module cost/memory analysis via the
+        shared `obs.stall.step_costs` -> `lower_step_programs` helper
+        (the planner's own machinery). CAVEAT, in-band: on CPU, float
+        normalization rewrites bf16 programs into f32-with-converts, so
+        these columns under-report the dtype win off-TPU;
+      * `model_*`: the dtype-aware StableHLO byte model
+        (`obs.stall.step_byte_model`) — logical dtypes, backend-neutral
+        shapes. The headline `bytes_ratio_f32_over_bf16` comes from its
+        ideal-fusion total: the number the acceptance gate and the
+        committed evidence/dtype_bench.json carry.
+
+    Env knobs: BENCH_DTYPE_BATCH (default 256 — the flagship operating
+    point; shrink for smoke runs), BENCH_DTYPE_NO_COMPILE=1 skips the
+    slow compile half (model columns only), BENCH_DTYPE_TINY=1 swaps the
+    flagship for the tiny test config (harness smoke in seconds — the
+    committed artifact is always the flagship)."""
+    if os.environ.get("BENCH_FAIL_INJECT"):
+        # deterministic failure for the cached-fallback contract tests
+        # (same knob as run_config): fires before any jax work
+        raise RuntimeError("BENCH_FAIL_INJECT: simulated dtype failure")
+    import dataclasses
+
+    from mgproto_tpu.obs import stall
+
+    tiny = bool(os.environ.get("BENCH_DTYPE_TINY"))
+    batch = _env_int("BENCH_DTYPE_BATCH", 256)
+    do_compile = not os.environ.get("BENCH_DTYPE_NO_COMPILE")
+    out: dict = {
+        "metric": "dtype_bytes_model",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "batch": batch,
+        "backend": None,
+        "config": "tiny" if tiny else "flagship",
+        "compiled_costs": bool(do_compile),
+    }
+    for name, dt in (("f32", "float32"), ("bf16", "bfloat16")):
+        if tiny:
+            from mgproto_tpu.config import tiny_test_config
+
+            base = tiny_test_config()
+            cfg = base.replace(
+                model=dataclasses.replace(base.model, compute_dtype=dt)
+            )
+        else:
+            cfg = flagship_config(fused=False, compute_dtype=dt)
+        # one trace/lowering per dtype, shared by the model walk and the
+        # compiled cost analysis
+        lowered = stall.lower_step_programs(cfg, batch)
+        model = stall.step_byte_model(
+            cfg, batch=batch, top_n=6 if dt == "bfloat16" else 0,
+            lowered=lowered,
+        )
+        out["backend"] = model["backend"]
+        entry = {
+            "model_raw_bytes": model["raw_bytes"],
+            "model_fused_bytes": model["fused_bytes"],
+        }
+        if dt == "bfloat16":
+            out["top_byte_movers"] = model["top_byte_movers"]
+        if do_compile:
+            costs = stall.step_costs(cfg, batch=batch, lowered=lowered)
+            entry.update({
+                "cost_bytes_accessed": costs["bytes_accessed"],
+                "cost_peak_bytes": costs["peak_bytes"],
+                "flops": costs["flops"],
+            })
+        out[name] = entry
+
+    def ratio(a, b):
+        if not a or not b:
+            return None
+        return round(a / b, 3)
+
+    out["bytes_ratio_f32_over_bf16"] = ratio(
+        out["f32"]["model_fused_bytes"], out["bf16"]["model_fused_bytes"]
+    )
+    out["raw_bytes_ratio_f32_over_bf16"] = ratio(
+        out["f32"]["model_raw_bytes"], out["bf16"]["model_raw_bytes"]
+    )
+    if do_compile:
+        out["cost_bytes_ratio_f32_over_bf16"] = ratio(
+            out["f32"]["cost_bytes_accessed"],
+            out["bf16"]["cost_bytes_accessed"],
+        )
+        out["peak_ratio_f32_over_bf16"] = ratio(
+            out["f32"]["cost_peak_bytes"], out["bf16"]["cost_peak_bytes"]
+        )
+    return out
+
+
+def _measure_dtype_main() -> None:
+    """`--measure dtype` with the cached-fallback/staleness machinery the
+    flagship paths already have: a live failure (the CPU compile half can
+    still die on a wedged machine, and on-TPU invocations ride the same
+    flaky relay as everything else) re-emits the committed
+    evidence/dtype_bench.json as the final line — explicitly `cached:
+    true`, stamped with the live error as `probe_failure` and with its
+    age (stale beyond BENCH_CACHED_MAX_AGE_S exits 1) — so a flaky window
+    degrades DIAGNOSABLY instead of flatlining the dtype trajectory."""
+    try:
+        print(json.dumps(measure_dtype()), flush=True)
+        raise SystemExit(0)
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — every failure must degrade
+        failure = {"error": f"{type(e).__name__}: {e}"}
+    cached_path = os.path.join(_BENCH_DIR, "evidence", "dtype_bench.json")
+    try:
+        with open(cached_path) as f:
+            cached = json.loads(f.read().strip().splitlines()[-1])
+    except (OSError, ValueError, IndexError):
+        _emit({"error": "dtype measure failed and no cached "
+                        "evidence/dtype_bench.json exists",
+               "probe_failure": failure})
+        raise SystemExit(1)
+    cached["cached"] = True
+    cached["probe_failure"] = failure
+    cached["measured_at"] = cached.get("ts")
+    age = _cached_age_s(cached)
+    cached["cached_age_s"] = None if age == float("inf") else round(age, 1)
+    if age > CACHED_MAX_AGE_S:
+        cached["stale"] = True
+        _emit(cached)
+        raise SystemExit(1)
+    _emit(cached)
+    raise SystemExit(0)
+
+
 def _fail(error_obj: dict) -> None:
     """Terminal failure path: emit the live diagnostics, then — if a watcher
     window ever captured a real number — the cached result as the final line
@@ -995,6 +1138,13 @@ def main() -> None:
         # two reference-batch paths come FIRST so a deadline-truncated run
         # still records the head-to-head at the reference's batch 80
         plan.append((f"fused_b{BEST_BATCH}", "fused", BEST_BATCH))
+    if BEST_BATCH > 0 and F32_BATCH > 0:
+        # the f32-vs-bf16 head-to-head at the throughput-optimal batch:
+        # the flagship IS bf16 (flagship_config), so the dtype win needs a
+        # measured f32 line beside it or it stays a cost-model claim.
+        # Bonus entry (2 attempts max), gated on BEST_BATCH like the other
+        # bonus lines so CI smoke runs skip it.
+        plan.append((f"fused_f32_b{F32_BATCH}", "fused_f32", F32_BATCH))
     if BEST_BATCH > 0 and REMAT_BATCH > 0:
         # the r4 batch-512 DNF, retried with layer1-only selective remat:
         # rematting just the cheap-but-wide 112^2 stage trades ~12% of the
@@ -1051,19 +1201,29 @@ if __name__ == "__main__":
             # hermetic trunk/bank-split microbench (no probe, CPU-friendly)
             print(json.dumps(measure_overlap()))
             raise SystemExit(0)
+        if measure == "dtype":
+            # hermetic f32-vs-bf16 byte microbench, with the cached-
+            # fallback/staleness degrade (ISSUE 12)
+            _measure_dtype_main()
         if len(sys.argv) == 4:
             BATCH = int(sys.argv[3])
         if BATCH <= 0:
             raise SystemExit(f"batch must be > 0, got {BATCH}")
         valid = (
-            "unfused", "fused", "fused_remat_l1", "eval_unfused", "eval_fused"
+            "unfused", "fused", "fused_remat_l1", "fused_f32",
+            "eval_unfused", "eval_fused",
         )
         if measure not in valid:
             raise SystemExit(f"--measure must be one of {valid}, got {measure!r}")
         print(json.dumps(run_config(
-            fused=measure in ("fused", "fused_remat_l1", "eval_fused"),
+            fused=measure in ("fused", "fused_remat_l1", "fused_f32",
+                              "eval_fused"),
             eval_mode=measure.startswith("eval"),
             remat_stages=("layer1",) if measure == "fused_remat_l1" else (),
+            # the f32 head-to-head: same fused path, f32 trunk — the
+            # measured counterpart of the bf16 flagship (ISSUE 12)
+            compute_dtype="float32" if measure == "fused_f32"
+            else "bfloat16",
         )))
     else:
         main()

@@ -104,6 +104,13 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no_fused_scoring", dest="fused_scoring",
                    action="store_false",
                    help="force the XLA scoring path")
+    p.add_argument("--fused_epilogue", action="store_true", default=None,
+                   help="force the Pallas BN+shortcut-add+ReLU block "
+                        "epilogue on (default: auto — on for TPU resnet "
+                        "trunks, off elsewhere; ops/fused_epilogue.py)")
+    p.add_argument("--no_fused_epilogue", dest="fused_epilogue",
+                   action="store_false",
+                   help="force the plain XLA block epilogue")
     p.add_argument("--remat", action="store_true",
                    help="checkpoint backbone blocks (HBM for FLOPs)")
     p.add_argument("--remat_stages", default="",
@@ -278,6 +285,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             pretrained=not args.no_pretrained,
             compute_dtype=args.compute_dtype,
             fused_scoring=args.fused_scoring,
+            fused_epilogue=args.fused_epilogue,
             remat=args.remat,
             remat_stages=tuple(
                 s for s in args.remat_stages.split(",") if s

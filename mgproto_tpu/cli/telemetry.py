@@ -415,6 +415,14 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
         except ValueError:
             pass
 
+    # perf: the newest stall-budget attribution report in the dir (written
+    # by scripts/trace_report.py --out, or copied in beside the metrics) —
+    # the bucket split and the byte-ranked fusion work list ride into the
+    # one-pager next to the throughput they explain (ISSUE 12)
+    perf = _perf_section(d)
+    if perf is not None:
+        summary["perf"] = perf
+
     # recovery events (resilience subsystem): retries, sentinel rows,
     # skipped non-finite steps, rollbacks, preemption saves, chaos faults
     from mgproto_tpu.resilience.metrics import ALL_COUNTERS
@@ -479,6 +487,51 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
                 )
             }
     return summary
+
+
+def _perf_section(d: str) -> Optional[Dict[str, Any]]:
+    """The newest `stall_report*.json` in the telemetry dir, reduced to the
+    summarize one-pager: bucket fractions, MFU line items, byte source and
+    the top byte movers (full rows stay in the report file / --json)."""
+    import glob as _glob
+
+    candidates = sorted(
+        _glob.glob(os.path.join(d, "stall_report*.json")),
+        key=os.path.getmtime,
+    )
+    if not candidates:
+        return None
+    path = candidates[-1]
+    try:
+        with open(path) as f:
+            rep = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not rep.get("stall_report"):
+        return None
+    out: Dict[str, Any] = {
+        "stall_report": os.path.basename(path),
+        "source": rep.get("source"),
+        "byte_source": rep.get("byte_source"),
+        "compute_dtype": rep.get("compute_dtype"),
+        "step_time_s": rep.get("step_time_s"),
+        "measured_mfu": rep.get("measured_mfu"),
+        "attainable_mfu": rep.get("attainable_mfu"),
+        "bytes_accessed": rep.get("bytes_accessed"),
+    }
+    for name, b in (rep.get("buckets") or {}).items():
+        out[f"{name}_fraction"] = (b or {}).get("fraction")
+    movers = (rep.get("top_byte_movers") or {}).get("rows") or []
+    out["top_byte_movers"] = [
+        {
+            "name": r.get("name"),
+            "bucket": r.get("bucket"),
+            "bytes_accessed": r.get("bytes_accessed"),
+            "bytes_fraction": r.get("bytes_fraction"),
+        }
+        for r in movers[:5]
+    ]
+    return out
 
 
 def _fmt_gb(v: Any) -> str:
@@ -549,6 +602,21 @@ def render_table(summary: Dict[str, Any]) -> str:
             if k == "autotune" and isinstance(v, dict):
                 v = _fmt_autotune(v)
             rows.append((k, v))
+    if "perf" in summary:
+        section("perf (stall attribution + byte-ranked fusion targets)")
+        for k, v in summary["perf"].items():
+            if k == "top_byte_movers":
+                for i, r in enumerate(v):
+                    frac = r.get("bytes_fraction")
+                    rows.append((
+                        f"byte_mover_{i + 1}",
+                        f"{r.get('name')} "
+                        f"[{_fmt_gb(r.get('bytes_accessed'))}"
+                        + (f", {frac:.1%} of step bytes]"
+                           if isinstance(frac, float) else "]"),
+                    ))
+            else:
+                rows.append((k, v))
     if "resilience" in summary:
         section("resilience (recovery events)")
         for k, v in summary["resilience"].items():
@@ -946,6 +1014,89 @@ def drift_drill_gates(record: Dict[str, Any]) -> Dict[str, Any]:
             "failed": sum(not r["ok"] for r in rows), "rows": rows}
 
 
+def stall_report_gates(
+    record: Dict[str, Any],
+    baseline: Optional[Dict[str, Any]] = None,
+    bytes_rel_tol: float = 0.05,
+    hbm_abs_tol: float = 0.02,
+) -> Dict[str, Any]:
+    """Gate a stall-budget report (scripts/trace_report.py) — schema sanity
+    alone, or byte/stall regression against a committed baseline report.
+
+    With a baseline, the two reports must share a byte source (comparing
+    XLA cost-analysis bytes against the hlo_model would gate noise) AND a
+    comparable step time (fractions are fractions OF the reported step —
+    a slower window dilutes hbm_bound into bubble, so gating across step
+    times would pass real regressions), the new report's `bytes_accessed`
+    must not exceed the baseline's by more than `bytes_rel_tol` (THE
+    byte-regression gate: a change that quietly re-materializes trunk
+    traffic fails here before it ever reaches a TPU window), and the
+    hbm_bound fraction must not grow past the baseline's by more than
+    `hbm_abs_tol`."""
+    rows: List[Dict[str, Any]] = []
+
+    def gate(key, ok, why="", baseline_v=None, value=None):
+        rows.append({"key": key, "ok": bool(ok), "why": "" if ok else why,
+                     "baseline": baseline_v, "value": value,
+                     "direction": "stall"})
+
+    gate("stall.schema", bool(record.get("stall_report")),
+         "not a stall report (missing stall_report marker)")
+    frac_sum = record.get("fraction_sum")
+    gate("stall.fractions_sum_to_one",
+         isinstance(frac_sum, (int, float)) and abs(frac_sum - 1.0) < 1e-4,
+         f"fraction_sum={frac_sum}")
+    movers = (record.get("top_byte_movers") or {}).get("rows")
+    gate("stall.top_byte_movers_present", bool(movers),
+         "report carries no ranked top_byte_movers rows")
+    if baseline is not None:
+        b_src = (baseline.get("byte_source"), baseline.get("source"))
+        n_src = (record.get("byte_source"), record.get("source"))
+        gate("stall.byte_source_matches", b_src == n_src,
+             f"baseline measured via {b_src}, new via {n_src}",
+             baseline_v=str(b_src), value=str(n_src))
+        b_t = baseline.get("step_time_s")
+        n_t = record.get("step_time_s")
+        if isinstance(b_t, (int, float)) and isinstance(n_t, (int, float)):
+            gate("stall.step_time_comparable",
+                 abs(n_t - b_t) <= 0.05 * abs(b_t),
+                 f"step_time_s {n_t:.4g} vs baseline {b_t:.4g} — "
+                 "fractions are not comparable across step times; "
+                 "regenerate at the baseline's measured step",
+                 baseline_v=b_t, value=n_t)
+        else:
+            gate("stall.step_time_comparable", False,
+                 "step_time_s missing from report or baseline",
+                 baseline_v=b_t, value=n_t)
+        b_bytes = baseline.get("bytes_accessed")
+        n_bytes = record.get("bytes_accessed")
+        if isinstance(b_bytes, (int, float)) and isinstance(
+                n_bytes, (int, float)):
+            allowed = b_bytes * (1.0 + bytes_rel_tol)
+            gate("stall.bytes_accessed", n_bytes <= allowed,
+                 f"{n_bytes:.4g} > allowed {allowed:.4g}",
+                 baseline_v=b_bytes, value=n_bytes)
+        else:
+            gate("stall.bytes_accessed", False,
+                 "bytes_accessed missing from report or baseline",
+                 baseline_v=b_bytes, value=n_bytes)
+        b_hbm = ((baseline.get("buckets") or {}).get("hbm_bound")
+                 or {}).get("fraction")
+        n_hbm = ((record.get("buckets") or {}).get("hbm_bound")
+                 or {}).get("fraction")
+        if isinstance(b_hbm, (int, float)) and isinstance(
+                n_hbm, (int, float)):
+            gate("stall.hbm_bound_fraction", n_hbm <= b_hbm + hbm_abs_tol,
+                 f"{n_hbm:.4f} > allowed {b_hbm + hbm_abs_tol:.4f}",
+                 baseline_v=b_hbm, value=n_hbm)
+        else:
+            gate("stall.hbm_bound_fraction", False,
+                 "hbm_bound fraction missing", baseline_v=b_hbm,
+                 value=n_hbm)
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
 def _lookup(summary: Dict[str, Any], dotted: str):
     node: Any = summary
     for part in dotted.split("."):
@@ -1021,6 +1172,20 @@ def check(summary: Dict[str, Any], baseline: Dict[str, Any]) -> Dict:
             "failed": sum(not r["ok"] for r in rows), "rows": rows}
 
 
+def _print_gate_result(result: Dict[str, Any], json_mode: bool) -> None:
+    """Render one gate-suite result ({ok, checked, failed, rows}) — the
+    shared formatter of the stall-report and drift-drill branches."""
+    if json_mode:
+        print(json.dumps(result, indent=2))
+        return
+    width = max(len(r["key"]) for r in result["rows"])
+    for r in result["rows"]:
+        status = "ok  " if r["ok"] else "FAIL"
+        detail = f" ({r['why']})" if r["why"] else ""
+        print(f"{status} {r['key']:<{width}}{detail}")
+    print(f"{result['checked']} checked, {result['failed']} failed")
+
+
 def check_main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(
         prog="mgproto-telemetry check",
@@ -1039,6 +1204,16 @@ def check_main(argv: Optional[list] = None) -> int:
                         "correction, zero drops/recompiles, poison "
                         "rejection, accuracy dip+recovery — exit 1 on any "
                         "failure")
+    p.add_argument("--stall-report", default=None, metavar="FILE",
+                   help="gate a stall-budget report (scripts/"
+                        "trace_report.py output): schema sanity, and with "
+                        "--stall-baseline the byte-regression gate — "
+                        "bytes_accessed and the hbm_bound fraction must "
+                        "not grow past the committed report's band")
+    p.add_argument("--stall-baseline", default=None, metavar="FILE",
+                   help="committed stall report to gate --stall-report "
+                        "against (e.g. evidence/stall_report_b256_bf16"
+                        ".json)")
     p.add_argument("--write-baseline", action="store_true",
                    help="summarize the dir and WRITE --baseline from it "
                         "(no checking)")
@@ -1051,6 +1226,45 @@ def check_main(argv: Optional[list] = None) -> int:
     p.add_argument("--json", action="store_true",
                    help="emit the check result as one JSON object")
     args = p.parse_args(argv)
+    # `--json` must emit ONE JSON document however many gate suites run:
+    # json-mode suite results are deferred into this dict and flushed once
+    # at every exit point (a single suite prints its bare result object —
+    # the pre-existing contract for `check DIR --baseline --json`)
+    json_suites: Dict[str, Dict[str, Any]] = {}
+
+    def _emit_suite(name: str, result: Dict[str, Any]) -> None:
+        if args.json:
+            json_suites[name] = result
+        else:
+            _print_gate_result(result, False)
+
+    def _flush_json() -> None:
+        if not args.json or not json_suites:
+            return
+        if len(json_suites) == 1:
+            print(json.dumps(next(iter(json_suites.values())), indent=2))
+        else:
+            print(json.dumps(json_suites, indent=2))
+
+    stall_ok = True
+    if args.stall_report:
+        def _read_json(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError) as e:
+                raise SystemExit(f"cannot read stall report {path}: {e}")
+
+        record = _read_json(args.stall_report)
+        baseline_rep = (
+            _read_json(args.stall_baseline) if args.stall_baseline else None
+        )
+        result = stall_report_gates(record, baseline_rep)
+        _emit_suite("stall_report", result)
+        if args.dir is None and not args.drift_drill:
+            _flush_json()
+            return 0 if result["ok"] else 1
+        stall_ok = result["ok"]
     if args.drift_drill:
         try:
             with open(args.drift_drill) as f:
@@ -1060,25 +1274,17 @@ def check_main(argv: Optional[list] = None) -> int:
                 f"cannot read drift-drill record {args.drift_drill}: {e}"
             )
         result = drift_drill_gates(record)
-        if args.json:
-            print(json.dumps(result, indent=2))
-        else:
-            width = max(len(r["key"]) for r in result["rows"])
-            for r in result["rows"]:
-                status = "ok  " if r["ok"] else "FAIL"
-                detail = f" ({r['why']})" if r["why"] else ""
-                print(f"{status} {r['key']:<{width}}{detail}")
-            print(f"{result['checked']} checked, "
-                  f"{result['failed']} failed")
+        _emit_suite("drift_drill", result)
         if args.dir is None:
-            return 0 if result["ok"] else 1
+            _flush_json()
+            return 0 if result["ok"] and stall_ok else 1
         drill_ok = result["ok"]
     else:
         drill_ok = True
     if args.dir is None or args.baseline is None:
         raise SystemExit(
             "check needs a telemetry dir AND --baseline (or --drift-drill "
-            "FILE alone)"
+            "/ --stall-report FILE alone)"
         )
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")
@@ -1110,7 +1316,11 @@ def check_main(argv: Optional[list] = None) -> int:
             json.dump(baseline, f, indent=2, sort_keys=True)
         print(f"wrote {len(baseline['entries'])} gate entries to "
               f"{args.baseline}")
-        return 0
+        # writing a baseline skips the dir CHECK, but any gate suite that
+        # already ran (--stall-report / --drift-drill) still decides the
+        # exit code — and its deferred --json output still flushes
+        _flush_json()
+        return 0 if stall_ok and drill_ok else 1
     try:
         with open(args.baseline) as f:
             baseline = json.load(f)
@@ -1123,7 +1333,8 @@ def check_main(argv: Optional[list] = None) -> int:
         )
     result = check(summary, baseline)
     if args.json:
-        print(json.dumps(result, indent=2))
+        json_suites["baseline"] = result
+        _flush_json()
     else:
         width = max((len(r["key"]) for r in result["rows"]), default=3)
         for r in result["rows"]:
@@ -1133,7 +1344,7 @@ def check_main(argv: Optional[list] = None) -> int:
                   f"base={_fmt(r['baseline'])} new={_fmt(r['value'])}"
                   f"{detail}")
         print(f"{result['checked']} checked, {result['failed']} failed")
-    return 0 if result["ok"] and drill_ok else 1
+    return 0 if result["ok"] and drill_ok and stall_ok else 1
 
 
 def main(argv: Optional[list] = None) -> Optional[int]:

@@ -338,8 +338,18 @@ def run_training(
 
     if telem:
         # run-config context next to the metric artifacts (summarize "meta")
+        from mgproto_tpu.ops.fused_epilogue import resolve_fused_epilogue
+        from mgproto_tpu.perf.precision import policy_meta
+
         telem.write_meta({
             **run_meta,
+            # the full mixed-precision policy (perf/precision.py): what ran
+            # in which dtype, next to the throughput it bought
+            "precision_policy": policy_meta(trainer.precision),
+            # RESOLVED (None = auto -> what this backend actually ran)
+            "fused_epilogue": resolve_fused_epilogue(
+                cfg.model.fused_epilogue, cfg.model.arch
+            ),
             "prefetch_depth": cfg.data.prefetch_depth,
             "em_max_active_classes": trainer._em_cfg.max_active_classes,
             "remat": cfg.model.remat,

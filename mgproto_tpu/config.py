@@ -30,7 +30,11 @@ class ModelConfig:
     init_sigma: float = 1.0 / math.sqrt(2.0 * math.pi)
     pretrained: bool = False
     # dtype policy: params/activations compute dtype. Density math is always f32
-    # (OoD thresholds depend on p(x) scale; see SURVEY.md §7.3.5).
+    # (OoD thresholds depend on p(x) scale; see SURVEY.md §7.3.5). The full
+    # statement of what runs in which dtype — and what is deliberately NOT a
+    # knob (f32 master params, optimizer moments, EM statistics, bank,
+    # scores) — is perf/precision.py's PrecisionPolicy; "float32" and
+    # "bfloat16" are the supported values (validated there).
     compute_dtype: str = "float32"
     # Route density + top-T through the fused Pallas kernel
     # (ops/fused_scoring.py). Identical numerics (tests/test_fused_scoring.py).
@@ -53,6 +57,18 @@ class ModelConfig:
     # of the HBM headroom at a fraction of full-remat's recompute tax.
     # Ignored when `remat` is True (full-trunk remat wins).
     remat_stages: Tuple[str, ...] = ()
+    # Fused BN+residual+ReLU block epilogue (ops/fused_epilogue.py): the
+    # residual tail of every ResNet block — BatchNorm apply + shortcut add
+    # + ReLU — runs as ONE Pallas VMEM pass instead of a chain of
+    # elementwise ops XLA may or may not fuse across the residual
+    # junction. The top entry of the byte-ranked fusion table
+    # (scripts/trace_report.py top_byte_movers) at flagship shapes is this
+    # epilogue at layer1's 112^2 resolution. Identical numerics: the
+    # backward is the exact VJP of the XLA reference (recomputed, remat-
+    # style), parity-pinned in tests/test_fused_epilogue.py. None = auto:
+    # ON for TPU backends with a resnet trunk, OFF elsewhere (the CPU
+    # interpret-mode kernel is correct but slow). True/False force.
+    fused_epilogue: Optional[bool] = None
     # Online class addition (online/classes.py): build the class axis at
     # num_classes rounded UP to a multiple of this bucket, mirroring the
     # serving batch buckets — padded slots carry zero priors (inert for

@@ -58,6 +58,7 @@ import optax
 from mgproto_tpu.config import EMConfig
 from mgproto_tpu.core.memory import Memory, clear_updated, memory_push
 from mgproto_tpu.core.mgproto import GMMState
+from mgproto_tpu.perf.precision import assert_f32_stats
 from mgproto_tpu.ops.em_kernels import em_estep_stats
 from mgproto_tpu.ops.gaussian import (
     diag_gaussian_log_prob,
@@ -109,6 +110,14 @@ def bank_update(
     All gates are traced scalars under lax.cond: one compiled program,
     zero steady-state recompiles.
     """
+    # the f32-statistics invariant (perf/precision.py): under the mixed-
+    # precision policy the trunk may run bf16, but the mixture, the bank
+    # and the enqueue candidates entering it must still be f32 — checked
+    # here at trace time, at the ONE entry both train modes share
+    assert_f32_stats(gmm.means, "gmm.means")
+    assert_f32_stats(gmm.priors, "gmm.priors")
+    assert_f32_stats(memory.feats, "memory bank feats")
+    assert_f32_stats(feats, "memory enqueue candidates")
     mem = jax.lax.cond(
         finite,
         lambda m: memory_push(m, feats, classes, valid),

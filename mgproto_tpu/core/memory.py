@@ -82,6 +82,12 @@ def memory_push(
     jit-friendly equivalent; a batch never realistically exceeds capacity).
     """
     with jax.named_scope("memory_push"):
+        from mgproto_tpu.perf.precision import assert_f32_stats
+
+        # the bank is a statistics buffer (EM fits the mixture to it): it
+        # must never be demoted below f32, whatever the trunk's compute
+        # dtype (perf/precision.py). Trace-time check, free in the program.
+        assert_f32_stats(mem.feats, "memory bank feats")
         n, _ = feats.shape
         if n == 0:  # static shape: nothing to enqueue
             return mem

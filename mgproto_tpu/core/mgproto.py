@@ -163,6 +163,21 @@ class MGProtoFeatures(nn.Module):
                     f"{self.cfg.arch!r}; options: {sorted(known)}"
                 )
             kw["remat_stages"] = tuple(self.cfg.remat_stages)
+        # fused block epilogue (ops/fused_epilogue.py): resnet family only —
+        # resolved per backend like fused_scoring (Mosaic on TPU, interpret
+        # elsewhere); the kernel's backward is the exact VJP of the XLA
+        # reference, so this is a byte-traffic switch, not a numerics one
+        from mgproto_tpu.ops.fused_epilogue import resolve_fused_epilogue
+
+        if self.cfg.arch.startswith("resnet"):
+            kw["fused_epilogue"] = resolve_fused_epilogue(
+                self.cfg.fused_epilogue, self.cfg.arch
+            )
+        elif self.cfg.fused_epilogue:
+            raise ValueError(
+                "fused_epilogue=True is implemented for resnet blocks only "
+                f"(got arch={self.cfg.arch!r}); leave it None/False here"
+            )
         self.features = build_backbone(self.cfg.arch, **kw)
         self.add_on = AddOnLayers(
             proto_dim=self.cfg.proto_dim,

@@ -157,6 +157,8 @@ def artifact_meta(cfg, checkpoint_path: Optional[str],
     identifies the mixture the weights carry (serving/calibration.py) —
     the serving gate matches it against the embedded calibration's stamp
     and fails closed on disagreement."""
+    from mgproto_tpu.perf.precision import policy_meta, resolve_policy
+
     return {
         "gmm_fingerprint": gmm_fingerprint,
         "static_batch": None if dynamic_batch else static_batch,
@@ -167,6 +169,11 @@ def artifact_meta(cfg, checkpoint_path: Optional[str],
         "proto_dim": cfg.model.proto_dim,
         "img_size": cfg.model.img_size,
         "compute_dtype": cfg.model.compute_dtype,
+        # the full precision policy (perf/precision.py): what the exported
+        # program computes in, and the f32 invariants it was trained under.
+        # The serving TrustGate matches the calibration's dtype stamp
+        # against this and fails closed on disagreement.
+        "precision_policy": policy_meta(resolve_policy(cfg)),
         "input": "float32 [batch, img_size, img_size, 3], normalized",
         "outputs": {
             "logits": "[batch, num_classes] class log-likelihoods log p(x|c)",

@@ -57,6 +57,7 @@ from mgproto_tpu.core.state import (
     split_state,
 )
 from mgproto_tpu.ops.augment import augment_tail, resolve_device_augment
+from mgproto_tpu.perf.precision import resolve_policy
 
 
 def resolve_async_bank(flag: Optional[bool]) -> bool:
@@ -145,6 +146,12 @@ class Trainer:
         # TPU); a static python bool, so the traced program has no augment
         # code at all when off.
         self._device_augment = resolve_device_augment(cfg.data.device_augment)
+        # the mixed-precision policy (perf/precision.py): validates the
+        # configured compute_dtype up front and is the provenance block
+        # telemetry meta + exported artifacts record. The trunk honors
+        # compute_dtype via the model's flax dtype; the bank phase's f32-
+        # statistics invariant is asserted at trace time in core/em.py.
+        self.precision = resolve_policy(cfg)
         self.joint_tx = make_joint_optimizer(cfg, steps_per_epoch)
         self.warm_tx = make_warm_optimizer(cfg)
         self.proto_tx = make_mean_optimizer(cfg.em)

@@ -19,16 +19,23 @@ from typing import Any, List, Sequence, Tuple
 import flax.linen as nn
 
 from mgproto_tpu.models.common import BatchNorm, ConvInfo, conv, max_pool
+from mgproto_tpu.ops.fused_epilogue import BNEpilogue
 
 
 class BasicBlock(nn.Module):
-    """Two 3x3 convs + identity shortcut (reference resnet_features.py:27-69)."""
+    """Two 3x3 convs + identity shortcut (reference resnet_features.py:27-69).
+
+    `fused_epilogue` routes the block tail (bn2 + shortcut add + ReLU)
+    through the Pallas epilogue kernel (ops/fused_epilogue.py) — identical
+    param/stat layout under the same "bn2" mount, parity-pinned — instead
+    of the plain nn.BatchNorm chain."""
 
     planes: int
     stride: int = 1
     has_downsample: bool = False
     expansion: int = 1
     dtype: Any = None
+    fused_epilogue: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -37,7 +44,6 @@ class BasicBlock(nn.Module):
         out = BatchNorm(name="bn1", dtype=self.dtype)(out, use_running_average=not train)
         out = nn.relu(out)
         out = conv(self.planes, 3, 1, 1, name="conv2", dtype=self.dtype)(out)
-        out = BatchNorm(name="bn2", dtype=self.dtype)(out, use_running_average=not train)
         if self.has_downsample:
             identity = conv(
                 self.planes, 1, self.stride, 0, name="downsample_conv", dtype=self.dtype
@@ -45,6 +51,11 @@ class BasicBlock(nn.Module):
             identity = BatchNorm(name="downsample_bn", dtype=self.dtype)(
                 identity, use_running_average=not train
             )
+        if self.fused_epilogue:
+            return BNEpilogue(name="bn2", dtype=self.dtype)(
+                out, identity, use_running_average=not train
+            )
+        out = BatchNorm(name="bn2", dtype=self.dtype)(out, use_running_average=not train)
         return nn.relu(out + identity)
 
     @staticmethod
@@ -60,6 +71,7 @@ class Bottleneck(nn.Module):
     has_downsample: bool = False
     expansion: int = 4
     dtype: Any = None
+    fused_epilogue: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -71,7 +83,6 @@ class Bottleneck(nn.Module):
         out = BatchNorm(name="bn2", dtype=self.dtype)(out, use_running_average=not train)
         out = nn.relu(out)
         out = conv(self.planes * 4, 1, 1, 0, name="conv3", dtype=self.dtype)(out)
-        out = BatchNorm(name="bn3", dtype=self.dtype)(out, use_running_average=not train)
         if self.has_downsample:
             identity = conv(
                 self.planes * 4, 1, self.stride, 0, name="downsample_conv",
@@ -80,6 +91,11 @@ class Bottleneck(nn.Module):
             identity = BatchNorm(name="downsample_bn", dtype=self.dtype)(
                 identity, use_running_average=not train
             )
+        if self.fused_epilogue:
+            return BNEpilogue(name="bn3", dtype=self.dtype)(
+                out, identity, use_running_average=not train
+            )
+        out = BatchNorm(name="bn3", dtype=self.dtype)(out, use_running_average=not train)
         return nn.relu(out + identity)
 
     @staticmethod
@@ -104,6 +120,9 @@ class ResNetFeatures(nn.Module):
     # recompute but hold the widest activations in the trunk (PERF.md).
     # Ignored when `remat` is True.
     remat_stages: Tuple[str, ...] = ()
+    # fuse each block's BN+shortcut-add+ReLU tail into one Pallas VMEM pass
+    # (ops/fused_epilogue.py; resolved per-backend by core/mgproto.py)
+    fused_epilogue: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -130,6 +149,7 @@ class ResNetFeatures(nn.Module):
                     has_downsample=needs_ds and bi == 0,
                     name=f"layer{li + 1}_{bi}",
                     dtype=self.dtype,
+                    fused_epilogue=self.fused_epilogue,
                 )(x, train)
                 inplanes = planes * self.block_cls.expansion
         return x

@@ -44,6 +44,7 @@ import glob
 import gzip
 import json
 import os
+import re
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 # v5e reference peaks (overridable everywhere): bf16 MXU peak and HBM BW
@@ -172,18 +173,19 @@ def attribute_trace(
 
 
 # ------------------------------------------------------------ cost-model side
-def step_costs(cfg, batch: Optional[int] = None) -> Dict[str, Any]:
-    """FLOPs / bytes-accessed / peak-bytes of the production step program(s)
-    for `cfg` at `batch` (per-chip), from XLA's compiled-module analyses —
-    hermetic on CPU. Async-bank configs report trunk + bank separately and
-    summed; sync configs the monolithic step. Shapes only: the state is
-    `eval_shape`d, nothing real is allocated. Also the `cost_provider`
-    behind ProfilerWindow's off-TPU fallback capture."""
+def lower_step_programs(cfg, batch: Optional[int] = None):
+    """Lower (NOT compile) the production step program(s) for `cfg` at
+    `batch`: {"trunk", "bank"} under async-bank configs, {"step"} for the
+    monolithic one. Shapes only (the state is `eval_shape`d). The ONE
+    lowering both `step_costs` (which compiles for XLA's cost analysis)
+    and `step_byte_model` (which parses the lowered StableHLO — no compile)
+    consume, so the two byte sources can never describe different programs.
+    Returns (programs dict, info dict)."""
     import jax
     import jax.numpy as jnp
 
     from mgproto_tpu.engine.train import Trainer
-    from mgproto_tpu.perf.planner import _program_peak, lower_split_programs
+    from mgproto_tpu.perf.planner import lower_split_programs
 
     trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
     state = jax.eval_shape(
@@ -199,6 +201,40 @@ def step_costs(cfg, batch: Optional[int] = None) -> Dict[str, Any]:
     use_mine = jnp.asarray(1.0, jnp.float32)
     update_gmm = jnp.asarray(True, bool)
 
+    programs: Dict[str, Any] = {}
+    if trainer.async_bank:
+        trunk_l, bank_l = lower_split_programs(
+            trainer, state, images, labels, seeds, use_mine, update_gmm
+        )
+        programs["trunk"] = trunk_l
+        programs["bank"] = bank_l
+    else:
+        programs["step"] = trainer._train_step.lower(
+            state, images, labels, seeds, use_mine, update_gmm, warm=False,
+        )
+    info = {
+        "batch": b,
+        "backend": jax.default_backend(),
+        "async_bank": trainer.async_bank,
+        "compute_dtype": cfg.model.compute_dtype,
+    }
+    return programs, info
+
+
+def step_costs(cfg, batch: Optional[int] = None,
+               lowered=None) -> Dict[str, Any]:
+    """FLOPs / bytes-accessed / peak-bytes of the production step program(s)
+    for `cfg` at `batch` (per-chip), from XLA's compiled-module analyses —
+    hermetic on CPU. Async-bank configs report trunk + bank separately and
+    summed; sync configs the monolithic step. Shapes only: the state is
+    `eval_shape`d, nothing real is allocated. Also the `cost_provider`
+    behind ProfilerWindow's off-TPU fallback capture.
+
+    `lowered` takes a pre-built `lower_step_programs(cfg, batch)` result so
+    a caller that also runs `step_byte_model` (trace_report, bench
+    --measure dtype) traces the flagship step ONCE, not per consumer."""
+    from mgproto_tpu.perf.planner import _program_peak
+
     def _costs(compiled) -> Dict[str, Any]:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -213,24 +249,17 @@ def step_costs(cfg, batch: Optional[int] = None) -> Dict[str, Any]:
             "peak_bytes": int(peak),
         }
 
-    programs: Dict[str, Dict[str, Any]] = {}
-    if trainer.async_bank:
-        trunk_l, bank_l = lower_split_programs(
-            trainer, state, images, labels, seeds, use_mine, update_gmm
-        )
-        programs["trunk"] = _costs(trunk_l.compile())
-        programs["bank"] = _costs(bank_l.compile())
-    else:
-        programs["step"] = _costs(
-            trainer._train_step.lower(
-                state, images, labels, seeds, use_mine, update_gmm,
-                warm=False,
-            ).compile()
-        )
+    programs_lowered, info = (
+        lowered if lowered is not None else lower_step_programs(cfg, batch)
+    )
+    programs = {
+        name: _costs(low.compile())
+        for name, low in programs_lowered.items()
+    }
     return {
-        "batch": b,
-        "backend": jax.default_backend(),
-        "async_bank": trainer.async_bank,
+        "batch": info["batch"],
+        "backend": info["backend"],
+        "async_bank": info["async_bank"],
         "programs": programs,
         "flops": sum(p["flops"] for p in programs.values()),
         "bytes_accessed": sum(
@@ -238,6 +267,313 @@ def step_costs(cfg, batch: Optional[int] = None) -> Dict[str, Any]:
         ),
         "peak_bytes": sum(p["peak_bytes"] for p in programs.values()),
     }
+
+
+# ---------------------------------------------- dtype-aware HLO byte model
+# XLA's compiled-module `bytes accessed` is the committed stall reports'
+# historical byte source, but it has two blind spots the mixed-precision
+# work exposes: (1) CPU float-normalization rewrites bf16 programs into
+# f32-with-converts, so a bf16 flagship REPORTS MORE bytes on the CPU
+# fallback while moving half the bytes on TPU; (2) CPU fusion is far less
+# aggressive than TPU's, so the totals are pessimistic (the committed
+# b256 report is `hbm_model_clamped` for exactly this reason). This model
+# instead walks the PRE-OPTIMIZATION StableHLO — where every tensor still
+# carries its LOGICAL dtype (bf16 stays 2 bytes) and shapes are backend-
+# neutral, the same artifact scripts/mfu_headroom.py reads — and charges
+# each op its operand + result bytes. Two totals come out:
+#
+#   raw_bytes    every op charged — the UNFUSED view. This is what a
+#                fusion kills, so the top_byte_movers ranking uses it:
+#                the #1 row is the next kernel to write.
+#   fused_bytes  only "memory-major" ops charged (conv/dot/reduce/gather/
+#                scatter/sort/custom_call/concat/dus); elementwise, casts,
+#                broadcasts, transposes and pads are assumed fused into a
+#                neighboring major op's read or write — the IDEAL-FUSION
+#                floor a TPU-class compiler (or the Pallas epilogue
+#                kernels) approaches. The roofline's HBM bucket uses this.
+#
+# Known approximations (deliberate, documented): both branches of a
+# lax.cond count (like XLA's own cost analysis); a multiply-called helper
+# function counts once; while-loop bodies count one trip. All are shared
+# by the f32 and bf16 walks, so the dtype RATIO — the number the
+# acceptance gates on — is clean.
+_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+_HLO_OP_RE = re.compile(r"=\s+\"?([A-Za-z_][\w]*\.[\w]+)")
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8e4m3fn": 1, "f8e5m2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "index": 8,
+    "complex<f32>": 8, "complex<f64>": 16,
+}
+# ops whose operand/result traffic survives ideal fusion (everything else
+# is an elementwise/layout op a TPU-class fusion pass folds into these)
+_MAJOR_OPS = frozenset((
+    "convolution", "dot_general", "dot", "reduce", "reduce_window",
+    "select_and_scatter", "gather", "scatter", "dynamic_slice",
+    "dynamic_update_slice", "sort", "custom_call", "concatenate",
+    "while", "rng_bit_generator", "fft", "cholesky", "triangular_solve",
+))
+
+
+def _tensor_nbytes(sig: str) -> int:
+    """Bytes of one `tensor<...>` signature ('256x112x112x64xbf16',
+    'f32', '2xindex'). Unknown element types charge 4 bytes."""
+    parts = sig.split("x")
+    dtype = parts[-1]
+    n = 1
+    for p in parts[:-1]:
+        if not p.isdigit():  # dynamic/symbolic dims: charge as 1
+            continue
+        n *= int(p)
+    return n * _HLO_DTYPE_BYTES.get(dtype, 4)
+
+
+# ops a fusing compiler folds into the consumer that reads them: charging
+# a major op's operand THROUGH these at the source signature models e.g. a
+# reduce over convert(bf16 -> f32) as reading the bf16 bytes (accumulation
+# is in-register f32) — exactly what TPU fusion emits for the f32
+# BatchNorm statistics over a bf16 trunk
+_FOLDABLE_OPS = frozenset((
+    "convert", "reshape", "transpose", "bitcast_convert",
+))
+_OPERAND_RE = re.compile(r"%[A-Za-z0-9_#.]+")
+_RESULT_RE = re.compile(r"^\s*(%[A-Za-z0-9_#.]+)\s*=")
+
+
+def _fold_operand(name: str, defs: Dict[str, Tuple], sig: str,
+                  depth: int = 8) -> str:
+    """Follow `name` back through foldable producers; the signature at the
+    chain's head is what a fused consumer actually streams from memory."""
+    while depth > 0:
+        d = defs.get(name)
+        if d is None:
+            return sig
+        op_short, operands, op_types, _ = d
+        if op_short not in _FOLDABLE_OPS or not operands or not op_types:
+            return sig
+        # the foldable op's own input: what a fused reader would stream
+        sig = op_types[0]
+        name = operands[0]
+        depth -= 1
+    return sig
+
+
+def parse_hlo_bytes(text: str) -> Dict[str, Any]:
+    """Per-op byte charges from a pre-optimization StableHLO module (see the
+    model notes above). Returns {"raw_bytes", "fused_bytes", "ops": {key ->
+    {"op", "result", "count", "bytes", "fused_bytes", "fused"}}} where key
+    groups identical (op kind, result signature) pairs. The raw view
+    charges every op exactly as written; the fused view charges only major
+    ops, with operands folded through convert/reshape/transpose chains to
+    the signature a fused reader would stream from memory."""
+    # pass 1: def sites — %name -> (short op, operands, op types, result)
+    defs: Dict[str, Tuple] = {}
+    parsed_lines = []
+    for line in text.splitlines():
+        stripped = line.strip()
+        if (
+            not stripped.startswith("%")
+            and not stripped.startswith("stablehlo.")
+        ):
+            # func/module headers, returns, braces: their tensors are
+            # charged at the ops that actually read/write them
+            continue
+        m = _HLO_OP_RE.search(line) if "=" in line else None
+        if m is None:
+            continue
+        op = m.group(1)
+        short = op.rsplit(".", 1)[-1]
+        sig_at = line.rfind(" : ")
+        if sig_at < 0:
+            continue
+        sig = line[sig_at + 3:]
+        body = line[m.end(): sig_at]
+        operands = _OPERAND_RE.findall(body)
+        if "->" in sig:
+            op_types = _TENSOR_RE.findall(sig.split("->", 1)[0])
+            res_types = _TENSOR_RE.findall(sig.split("->", 1)[1])
+        else:
+            listed = _TENSOR_RE.findall(sig)
+            # short elementwise form ('add %a, %b : tensor<T>'): the last
+            # listed type is the result; operands take the listed types in
+            # order, unlisted ones sharing the last — truncated to the real
+            # operand count (a zero-operand constant/iota charges its
+            # result ONCE, not as a phantom operand too)
+            if listed:
+                op_types = (
+                    listed + [listed[-1]] * max(
+                        len(operands) - len(listed), 0
+                    )
+                )[: len(operands)]
+                res_types = listed[-1:]
+            else:
+                op_types, res_types = [], []
+        if not res_types:
+            continue
+        rm = _RESULT_RE.match(line)
+        if rm is not None:
+            defs[rm.group(1)] = (short, operands, op_types, res_types[-1])
+        parsed_lines.append((op, short, operands, op_types, res_types))
+
+    # pass 2: charges
+    raw_total = 0.0
+    fused_total = 0.0
+    ops: Dict[str, Dict[str, Any]] = {}
+    for op, short, operands, op_types, res_types in parsed_lines:
+        raw = sum(_tensor_nbytes(t) for t in op_types) + sum(
+            _tensor_nbytes(t) for t in res_types
+        )
+        is_major = short in _MAJOR_OPS
+        fused = 0.0
+        if is_major:
+            fused = sum(_tensor_nbytes(t) for t in res_types)
+            for i, t in enumerate(op_types):
+                name = operands[i] if i < len(operands) else None
+                folded = _fold_operand(name, defs, t) if name else t
+                # a fold can only shrink what the fused reader streams
+                fused += min(_tensor_nbytes(folded), _tensor_nbytes(t))
+        raw_total += raw
+        fused_total += fused
+        result = res_types[-1]
+        key = f"{op} -> tensor<{result}>"
+        row = ops.setdefault(key, {
+            "op": op, "result": result, "count": 0, "bytes": 0.0,
+            "fused_bytes": 0.0, "fused": is_major,
+        })
+        row["count"] += 1
+        row["bytes"] += raw
+        row["fused_bytes"] += fused
+    return {
+        "raw_bytes": raw_total,
+        "fused_bytes": fused_total,
+        "ops": ops,
+    }
+
+
+def _mover_rows(ops: Dict[str, Dict[str, Any]], total: float,
+                top_n: int) -> List[Dict[str, Any]]:
+    rows = []
+    for key, row in sorted(
+        ops.items(), key=lambda kv: kv[1]["bytes"], reverse=True
+    )[: max(top_n, 0)]:
+        short = row["op"].rsplit(".", 1)[-1].replace("_", "-")
+        rows.append({
+            "name": key,
+            "bucket": classify_op(short),
+            "count": int(row["count"]),
+            "bytes_accessed": float(row["bytes"]),
+            "bytes_fraction": (
+                float(row["bytes"]) / total if total > 0 else 0.0
+            ),
+            "seconds": None,
+            "time_fraction": None,
+        })
+    return rows
+
+
+def step_byte_model(cfg, batch: Optional[int] = None,
+                    top_n: int = 12, lowered=None) -> Dict[str, Any]:
+    """The dtype-aware byte model of the production step program(s): lowers
+    (never compiles) through `lower_step_programs` and walks the StableHLO.
+    Returns totals (raw + ideal-fusion views), per-program splits, and the
+    ranked `top_byte_movers` table — the fusion work list. `lowered`
+    shares a pre-built lowering, as in `step_costs`."""
+    lowered, info = (
+        lowered if lowered is not None else lower_step_programs(cfg, batch)
+    )
+    per_program: Dict[str, Dict[str, float]] = {}
+    merged: Dict[str, Dict[str, Any]] = {}
+    raw_total = 0.0
+    fused_total = 0.0
+    for name, low in lowered.items():
+        parsed = parse_hlo_bytes(low.as_text())
+        per_program[name] = {
+            "raw_bytes": parsed["raw_bytes"],
+            "fused_bytes": parsed["fused_bytes"],
+        }
+        raw_total += parsed["raw_bytes"]
+        fused_total += parsed["fused_bytes"]
+        for key, row in parsed["ops"].items():
+            agg = merged.setdefault(
+                key, dict(row, count=0, bytes=0.0, fused_bytes=0.0)
+            )
+            agg["count"] += row["count"]
+            agg["bytes"] += row["bytes"]
+            agg["fused_bytes"] += row["fused_bytes"]
+    return {
+        "byte_model": "hlo_dtype",
+        **info,
+        "raw_bytes": raw_total,
+        "fused_bytes": fused_total,
+        "programs": per_program,
+        "top_byte_movers": {
+            "source": "hlo_model",
+            "total_bytes": raw_total,
+            "rows": _mover_rows(merged, raw_total, top_n),
+        },
+    }
+
+
+def top_byte_movers_from_trace(
+    events: Iterable[Dict[str, Any]], top_n: int = 12
+) -> Dict[str, Any]:
+    """The ranked byte-movers table from a captured device trace: device-op
+    events on the busiest lane grouped by name, ranked by `bytes_accessed`
+    from the event args when the profiler recorded it, by duration
+    otherwise (bytes then stay null rather than invented). Same row schema
+    as the hlo_model source, so the committed-report guard covers both."""
+    lanes: Dict[Tuple[Any, Any], float] = {}
+    per_lane: Dict[Tuple[Any, Any], List] = {}
+    for e in events:
+        if e.get("ph", "X") != "X":
+            continue
+        dur = float(e.get("dur", 0.0)) / 1e6
+        if dur <= 0:
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        lanes[key] = lanes.get(key, 0.0) + dur
+        per_lane.setdefault(key, []).append(e)
+    if not lanes:
+        return {"source": "trace", "total_bytes": None, "rows": []}
+    device_lane = max(lanes, key=lanes.get)
+    busy = lanes[device_lane]
+    groups: Dict[str, Dict[str, Any]] = {}
+    for e in per_lane[device_lane]:
+        name = str(e.get("name", "?"))
+        args = e.get("args") or {}
+        b = args.get("bytes_accessed", args.get("bytes accessed"))
+        g = groups.setdefault(name, {"count": 0, "seconds": 0.0,
+                                     "bytes": None})
+        g["count"] += 1
+        g["seconds"] += float(e.get("dur", 0.0)) / 1e6
+        if b is not None:
+            g["bytes"] = (g["bytes"] or 0.0) + float(b)
+    known = [g["bytes"] for g in groups.values() if g["bytes"] is not None]
+    total_bytes = sum(known) if known else None
+    rows = []
+    for name, g in sorted(
+        groups.items(),
+        key=lambda kv: (
+            kv[1]["bytes"] if kv[1]["bytes"] is not None else -1.0,
+            kv[1]["seconds"],
+        ),
+        reverse=True,
+    )[: max(top_n, 0)]:
+        rows.append({
+            "name": name,
+            "bucket": classify_op(name),
+            "count": int(g["count"]),
+            "bytes_accessed": g["bytes"],
+            "bytes_fraction": (
+                g["bytes"] / total_bytes
+                if g["bytes"] is not None and total_bytes else None
+            ),
+            "seconds": g["seconds"],
+            "time_fraction": g["seconds"] / busy if busy > 0 else 0.0,
+        })
+    return {"source": "trace", "total_bytes": total_bytes, "rows": rows}
 
 
 def roofline_buckets(

@@ -1,0 +1,226 @@
+"""Fused Pallas kernel: BatchNorm apply + residual add + ReLU block epilogue.
+
+The byte-ranked fusion table (obs/stall.py `top_byte_movers`, ISSUE 12) names
+the ResNet block epilogue at layer1's 112^2 resolution as the top non-MXU
+byte mover of the flagship step: the BN normalize, the shortcut add and the
+ReLU each stream the full [B, 112, 112, 64] activation (1.6 GB at batch 256
+in bf16) through HBM when XLA materializes the chain — and whether XLA fuses
+across the residual junction is a per-program fusion-heuristic outcome, not
+a contract. This kernel makes it a contract: given the per-channel
+normalization constants, ONE VMEM pass reads x and the shortcut and writes
+relu((x - mean) * rsqrt(var + eps) * scale + bias + shortcut) — the byte
+floor (2 reads + 1 write) instead of up to 4 reads + 3 writes.
+
+Gradient contract: the backward is the EXACT VJP of the XLA reference
+implementation (`epilogue_reference`), obtained by re-running it under
+`jax.vjp` at backward time — remat-style recompute of a cheap elementwise
+chain, so the fused forward can never diverge from the reference gradients
+(including the batch-statistics terms: `mean`/`var` are differentiable
+INPUTS here, so the train-mode BN backward through the statistics happens
+in the caller's XLA graph exactly as without the kernel). Parity is pinned
+in tests/test_fused_epilogue.py (CPU interpret mode, `pallas` marker).
+
+`BNEpilogue` is the flax wrapper the resnet blocks mount when
+`ModelConfig.fused_epilogue` resolves on: parameter/stat names mirror
+nn.BatchNorm (params `scale`/`bias`; batch_stats `mean`/`var`, flax
+momentum/fast-variance semantics) so checkpoints interchange with the
+unfused blocks bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def resolve_fused_epilogue(flag: Optional[bool], arch: str) -> bool:
+    """None = auto, like fused_scoring: the Mosaic lowering is TPU-only and
+    the kernel is mounted by the resnet block family; every other backend/
+    arch keeps the plain XLA path. Explicit True/False always honored
+    (tests force ON on CPU, where the kernel runs in interpret mode)."""
+    if flag is not None:
+        return bool(flag)
+    return jax.default_backend() == "tpu" and arch.startswith("resnet")
+
+
+def epilogue_reference(x, mean, var, scale, bias, residual, eps, compute_dtype):
+    """The XLA reference: flax nn.BatchNorm's apply arithmetic (promote to
+    the compute dtype, y = (x - mean) * rsqrt(var + eps) * scale + bias) +
+    shortcut add + ReLU. The ONE definition of the epilogue's math — the
+    fused path's backward is this function's VJP, so the two cannot drift."""
+    dt = jnp.dtype(compute_dtype)
+    mul = jax.lax.rsqrt(var.astype(dt) + jnp.asarray(eps, dt))
+    mul = mul * scale.astype(dt)
+    y = (x.astype(dt) - mean.astype(dt)) * mul
+    y = y + bias.astype(dt) + residual.astype(dt)
+    return jnp.maximum(y, jnp.asarray(0, dt))
+
+
+# ------------------------------------------------------------------- kernel
+def _epilogue_kernel(x_ref, res_ref, a_ref, b_ref, o_ref):
+    """One [TILE_M, C] row tile: o = max(x * a + b + res, 0). `a`/`b` are the
+    folded per-channel constants (a = scale * rsqrt(var + eps),
+    b = bias - mean * a), f32; the multiply-add runs in f32 regardless of
+    the wire dtype (never LESS precise than the reference) and the output
+    is cast back to the activation dtype."""
+    x = x_ref[...].astype(jnp.float32)
+    r = res_ref[...].astype(jnp.float32)
+    y = x * a_ref[...] + b_ref[...] + r
+    o_ref[...] = jnp.maximum(y, 0.0).astype(o_ref.dtype)
+
+
+_TILE_M = 512
+
+
+def _pick_row_tile(m: int) -> int:
+    """Largest sublane-aligned (multiple-of-8) row tile <= _TILE_M that
+    DIVIDES m, or 0 when none exists. An exact divisor means no operand
+    padding: a padded tile would cost jnp.pad copies of x and the shortcut
+    plus an output slice — whole-tensor HBM round trips on the exact path
+    whose purpose is removing them (e.g. layer4 at batch 256: m = 12544
+    divides by 448, not 512)."""
+    for t in range(min(_TILE_M, m - m % 8), 7, -8):
+        if m % t == 0:
+            return t
+    return 0
+
+
+def _epilogue_call(x, mean, var, scale, bias, residual, eps, dt, interpret):
+    """Flatten [B, H, W, C] -> [M, C], tile rows, one grid pass."""
+    shape = x.shape
+    c = shape[-1]
+    m = x.size // c
+    xd = x.reshape(m, c).astype(dt)
+    rd = residual.reshape(m, c).astype(dt)
+    a = (jax.lax.rsqrt(var.astype(jnp.float32) + jnp.float32(eps))
+         * scale.astype(jnp.float32))
+    b = bias.astype(jnp.float32) - mean.astype(jnp.float32) * a
+    tile = _pick_row_tile(m)
+    if tile:
+        m_pad = m
+    else:  # no aligned divisor (tiny/ragged m): pad, slice back after
+        tile = min(_TILE_M, _round_up(m, 8))
+        m_pad = _round_up(m, tile)
+        xd = jnp.pad(xd, ((0, m_pad - m), (0, 0)))
+        rd = jnp.pad(rd, ((0, m_pad - m), (0, 0)))
+    out = pl.pallas_call(
+        _epilogue_kernel,
+        grid=(m_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, c), lambda i: (i, 0)),
+            pl.BlockSpec((tile, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, c), jnp.dtype(dt)),
+        interpret=interpret,
+    )(xd, rd, a[None, :], b[None, :])
+    return out[:m].reshape(shape[:-1] + (c,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _bn_add_relu(x, mean, var, scale, bias, residual, eps, dt, interpret):
+    return _epilogue_call(x, mean, var, scale, bias, residual, eps, dt,
+                          interpret)
+
+
+def _bn_add_relu_fwd(x, mean, var, scale, bias, residual, eps, dt, interpret):
+    y = _epilogue_call(x, mean, var, scale, bias, residual, eps, dt,
+                       interpret)
+    return y, (x, mean, var, scale, bias, residual)
+
+
+def _bn_add_relu_bwd(eps, dt, interpret, saved, g):
+    # the exact VJP of the XLA reference: recompute the cheap elementwise
+    # forward under jax.vjp (remat-style) so fused and unfused training
+    # trajectories share one gradient definition
+    _, vjp = jax.vjp(
+        lambda *a: epilogue_reference(*a, eps, dt), *saved
+    )
+    return vjp(g)
+
+
+_bn_add_relu.defvjp(_bn_add_relu_fwd, _bn_add_relu_bwd)
+
+
+def fused_bn_epilogue(x, mean, var, scale, bias, residual,
+                      eps: float = 1e-5,
+                      compute_dtype: Any = None,
+                      interpret: Optional[bool] = None):
+    """Public entry: fused BN apply + residual add + ReLU.
+
+    Args:
+      x:        [B, H, W, C] conv output (any float dtype).
+      mean/var: [C] normalization statistics (batch stats in train mode —
+                differentiable inputs, so the BN stats backward stays in
+                the caller's graph — or running averages in eval mode).
+      scale/bias: [C] BN affine params (f32 masters).
+      residual: [B, H, W, C] shortcut branch.
+      compute_dtype: output/accumulate wire dtype (None = x.dtype).
+      interpret: None = auto (Mosaic on TPU, interpreter elsewhere).
+    """
+    dt = jnp.dtype(compute_dtype) if compute_dtype is not None else x.dtype
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _bn_add_relu(x, mean, var, scale, bias, residual,
+                        float(eps), str(jnp.dtype(dt)), bool(interpret))
+
+
+# ------------------------------------------------------------- flax wrapper
+class BNEpilogue(nn.Module):
+    """BatchNorm + residual add + ReLU with the elementwise tail fused.
+
+    Parameter/stat layout mirrors nn.BatchNorm exactly (params:
+    `scale`, `bias`; batch_stats: `mean`, `var`; f32 masters; flax
+    fast-variance batch statistics and momentum running-average update), so
+    a checkpoint written by the unfused blocks restores here unchanged —
+    the module NAME at the mount point ("bn2"/"bn3") is the same either
+    way. The fused kernel only replaces the elementwise apply."""
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None  # compute dtype (None = input dtype), like nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, residual, use_running_average: bool):
+        c = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # flax _compute_stats semantics: f32 statistics regardless of
+            # the compute dtype, fast variance max(E[x^2] - E[x]^2, 0)
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value
+                    + (1.0 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value
+                    + (1.0 - self.momentum) * var
+                )
+        return fused_bn_epilogue(
+            x, mean, var, scale, bias, residual,
+            eps=self.epsilon, compute_dtype=self.dtype or x.dtype,
+        )

@@ -12,7 +12,10 @@ The reference evaluates this with python-blocked broadcast/pow loops
 the quadratic so the cross term is ONE [N, d] x [d, P] matmul on the MXU and
 the rest are rank-1 broadcasts — no blocking, no python loops; XLA fuses the
 elementwise epilogue. Density math stays in float32 regardless of the model's
-compute dtype (OoD p(x) thresholds depend on its scale, SURVEY.md §7.3.5).
+compute dtype (OoD p(x) thresholds depend on its scale, SURVEY.md §7.3.5) —
+this is the `score_dtype` leg of the mixed-precision policy
+(perf/precision.py): the explicit f32 casts below are what lets the TRUNK
+run bf16 while every p(x) a calibration ever thresholds stays on one scale.
 """
 
 from __future__ import annotations

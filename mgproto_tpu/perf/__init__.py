@@ -1,6 +1,14 @@
-"""Performance planning: compiled-module memory models and the HBM-budget
-auto-tuner (`--auto_tune`). See perf/planner.py."""
+"""Performance planning: compiled-module memory models, the HBM-budget
+auto-tuner (`--auto_tune`), and the mixed-precision policy. See
+perf/planner.py and perf/precision.py."""
 
+from mgproto_tpu.perf.precision import (  # noqa: F401
+    PrecisionError,
+    PrecisionPolicy,
+    assert_f32_stats,
+    policy_meta,
+    resolve_policy,
+)
 from mgproto_tpu.perf.planner import (  # noqa: F401
     HBMPlanner,
     PlanCandidate,

@@ -1,5 +1,5 @@
-"""HBM-budget auto-tuner: pick (batch, remat, prefetch, augment, async_bank)
-from a memory model instead of by DNF.
+"""HBM-budget auto-tuner: pick (batch, remat, prefetch, augment, async_bank,
+compute_dtype) from a memory model instead of by DNF.
 
 The batch-512 DNF (PERF.md "MFU headroom") and the hand-curated sweep showed
 run sizing was still trial-and-error: a config either fit the chip's HBM or
@@ -55,14 +55,24 @@ _REMAT_ARCH_PREFIXES = ("resnet", "densenet")
 
 @dataclasses.dataclass(frozen=True)
 class PlanCandidate:
-    """One (batch, remat, prefetch, augment, async_bank) tuple under
-    consideration. `batch` is the GLOBAL train batch size."""
+    """One (batch, remat, prefetch, augment, async_bank, dtype) tuple under
+    consideration. `batch` is the GLOBAL train batch size.
+
+    `compute_dtype` is the dtype axis (ISSUE 12): "" inherits the base
+    config's compute dtype; "bfloat16"/"float32" override it. On TPU the
+    compiled-module measurement then sees bf16's halved activation bytes
+    directly — which is what finally lets `fused_b512_remat_l1` fit the
+    v5e budget (the batch-512 DNF, PERF.md). NOTE the CPU backend cannot
+    measure this axis (XLA float normalization rewrites bf16 programs to
+    f32-with-converts), so off-TPU the bf16 candidates predict ~f32 peaks
+    — conservative, never unsafe."""
 
     batch: int
     remat_stages: Tuple[str, ...] = ()
     prefetch_depth: int = 2
     device_augment: bool = False
     async_bank: bool = False
+    compute_dtype: str = ""  # "" = the base config's dtype
 
     @property
     def name(self) -> str:
@@ -74,6 +84,11 @@ class PlanCandidate:
             parts.append("u8")
         if self.async_bank:
             parts.append("async")
+        if self.compute_dtype:
+            parts.append(
+                "bf16" if self.compute_dtype == "bfloat16"
+                else self.compute_dtype
+            )
         return "_".join(parts)
 
 
@@ -96,6 +111,7 @@ class PlanReport:
             "prefetch_depth": self.candidate.prefetch_depth,
             "device_augment": self.candidate.device_augment,
             "async_bank": self.candidate.async_bank,
+            "compute_dtype": self.candidate.compute_dtype,
             "peak_bytes": int(self.peak_bytes),
             "fits": bool(self.fits),
             **({"error": self.error} if self.error else {}),
@@ -193,6 +209,8 @@ def plan_config(base_cfg, cand: PlanCandidate):
     model = dataclasses.replace(
         base_cfg.model, remat_stages=tuple(cand.remat_stages)
     )
+    if cand.compute_dtype:
+        model = dataclasses.replace(model, compute_dtype=cand.compute_dtype)
     em = dataclasses.replace(base_cfg.em, async_bank=cand.async_bank)
     return base_cfg.replace(data=data, model=model, em=em)
 
@@ -334,7 +352,7 @@ def make_cached_measure(base_cfg) -> Callable:
     def measure(cand: PlanCandidate) -> Tuple[int, Dict]:
         key = (
             cand.batch, tuple(cand.remat_stages),
-            cand.device_augment, cand.async_bank,
+            cand.device_augment, cand.async_bank, cand.compute_dtype,
         )
         if key not in cache:
             cache[key] = measure_candidate(
@@ -415,6 +433,11 @@ class HBMPlanner:
             fitting,
             key=lambda r: (
                 r.candidate.batch,
+                # at equal batch, keep the run's own numerics: a dtype
+                # override (the bf16 axis) wins only when it is what makes
+                # a LARGER batch fit — the auto-tuner must never flip
+                # training numerics for free
+                not r.candidate.compute_dtype,
                 -len(r.candidate.remat_stages),
                 r.candidate.prefetch_depth,
             ),
@@ -433,6 +456,7 @@ def candidate_plans(
     batches: Optional[Sequence[int]] = None,
     device_augment: Optional[bool] = None,
     async_bank: Optional[bool] = None,
+    dtypes: Optional[Sequence[str]] = None,
 ) -> List[PlanCandidate]:
     """The default candidate ladder for a base config: the configured batch
     and its 2x/4x, each with the configured remat plus — for rematable
@@ -443,7 +467,15 @@ def candidate_plans(
     arithmetic, see make_cached_measure — and the tie-break prefers deeper
     prefetch, so pf0 only wins when the headroom is what did not fit).
     Augment/async default to the config's own resolution so the plan
-    measures what the run will actually execute."""
+    measures what the run will actually execute.
+
+    `dtypes` is the opt-in dtype axis (ISSUE 12): each extra entry (e.g.
+    "bfloat16") re-emits the whole ladder under that compute dtype. It is
+    OPT-IN (`--auto_tune` alone never changes training numerics): pass it
+    explicitly or set MGPROTO_AUTOTUNE_DTYPES=bfloat16. The tie-break in
+    HBMPlanner.plan prefers the config's own dtype at equal batch, so a
+    dtype override is chosen only when it buys a strictly larger batch —
+    the `fused_b512_remat_l1` resolution path."""
     import jax
 
     b0 = cfg.data.train_batch_size * jax.process_count()
@@ -466,17 +498,27 @@ def candidate_plans(
             remat_options.append(l1)
     prefetch_options = sorted({int(cfg.data.prefetch_depth), 0},
                               reverse=True)
+    if dtypes is None:
+        raw = os.environ.get("MGPROTO_AUTOTUNE_DTYPES", "")
+        dtypes = tuple(s.strip() for s in raw.split(",") if s.strip())
+    # "" = the config's own dtype, always first; an override equal to the
+    # config's dtype would compile the identical program twice — drop it
+    dtype_options = [""] + [
+        d for d in dtypes if d and d != cfg.model.compute_dtype
+    ]
     out: List[PlanCandidate] = []
     for b in sorted(set(batches)):
-        for stages in remat_options:
-            for pf in prefetch_options:
-                out.append(PlanCandidate(
-                    batch=int(b),
-                    remat_stages=stages,
-                    prefetch_depth=pf,
-                    device_augment=bool(device_augment),
-                    async_bank=bool(async_bank),
-                ))
+        for dt in dtype_options:
+            for stages in remat_options:
+                for pf in prefetch_options:
+                    out.append(PlanCandidate(
+                        batch=int(b),
+                        remat_stages=stages,
+                        prefetch_depth=pf,
+                        device_augment=bool(device_augment),
+                        async_bank=bool(async_bank),
+                        compute_dtype=dt,
+                    ))
     return out
 
 

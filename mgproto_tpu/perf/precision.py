@@ -1,0 +1,117 @@
+"""PrecisionPolicy: the one statement of what runs in which dtype.
+
+The mixed-precision flagship (ROADMAP item 2, ISSUE 12) runs the backbone
+TRUNK — convs, BatchNorm apply, add-on 1x1s, and therefore the whole
+backward through them — in `compute_dtype=bfloat16`, halving the trunk's
+activation/gradient HBM traffic (the 43.7% HBM-bound stall budget in
+evidence/stall_report_b256.json is almost entirely trunk bytes). Everything
+whose ABSOLUTE SCALE carries meaning stays float32:
+
+  * master params + optimizer moments (flax param_dtype default; optax
+    states follow the params),
+  * BatchNorm batch statistics (flax computes them in f32 regardless of
+    the module dtype) and running stats,
+  * the EM sufficient statistics and the [C, cap, d] memory bank
+    (core/em.py, core/memory.py — a bf16 bank would quantize the very
+    features the mixture is fit to),
+  * density math and log p(x) scores (ops/gaussian.py pins f32 +
+    HIGHEST matmul precision; OoD thresholds ride on the p(x) scale,
+    SURVEY.md §7.3.5),
+  * serving calibration thresholds (host-side float64).
+
+This module is the policy's single home: `resolve_policy` derives it from a
+Config, `policy_meta` is the provenance block recorded in telemetry meta
+and in exported-artifact `meta.json` (the serving TrustGate fails closed on
+a dtype mismatch the same way it does on a GMM-fingerprint mismatch), and
+`assert_f32_stats` is the trace-time guard the EM/bank entry points call so
+a future refactor cannot silently demote the f32-statistics invariant
+(scripts/check_dtype_discipline.py enforces the same invariant statically).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+SUPPORTED_COMPUTE_DTYPES = ("float32", "bfloat16")
+
+# dtypes that must never appear in EM statistics / bank / calibration math
+HALF_DTYPES = ("bfloat16", "float16")
+
+
+class PrecisionError(TypeError):
+    """A tensor violated the precision policy's f32-statistics invariant."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """What runs in which dtype. Only `compute_dtype` is a knob; the f32
+    fields are stated (not configurable) because the system's correctness
+    arguments depend on them — they are recorded so artifacts and
+    telemetry carry the full story, and so a future knob would have to
+    touch this type (and its assertions) explicitly."""
+
+    compute_dtype: str = "float32"  # trunk activations AND their gradients
+    param_dtype: str = "float32"  # master params + optimizer moments
+    stats_dtype: str = "float32"  # EM sufficient stats, bank, BN stats
+    score_dtype: str = "float32"  # density / log p(x) / calibration math
+
+    def __post_init__(self):
+        if self.compute_dtype not in SUPPORTED_COMPUTE_DTYPES:
+            raise ValueError(
+                f"compute_dtype must be one of {SUPPORTED_COMPUTE_DTYPES}, "
+                f"got {self.compute_dtype!r}"
+            )
+        for field in ("param_dtype", "stats_dtype", "score_dtype"):
+            if getattr(self, field) != "float32":
+                raise ValueError(
+                    f"{field} is not a knob: it must stay float32 "
+                    f"(got {getattr(self, field)!r}); see module docstring"
+                )
+
+    @property
+    def mixed(self) -> bool:
+        return self.compute_dtype != "float32"
+
+
+def resolve_policy(cfg) -> PrecisionPolicy:
+    """The policy a Config implies (cfg.model.compute_dtype is the knob)."""
+    return PrecisionPolicy(compute_dtype=cfg.model.compute_dtype)
+
+
+def policy_meta(policy: PrecisionPolicy) -> Dict[str, Any]:
+    """Provenance block for telemetry meta.json and exported artifacts."""
+    return {
+        "compute_dtype": policy.compute_dtype,
+        "param_dtype": policy.param_dtype,
+        "stats_dtype": policy.stats_dtype,
+        "score_dtype": policy.score_dtype,
+        "mixed": policy.mixed,
+    }
+
+
+def is_half_dtype(dtype: Any) -> bool:
+    """True for bf16/f16 in any spelling (str, np/jnp dtype, scalar type)."""
+    try:
+        import numpy as np
+
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = str(dtype)
+    return name in HALF_DTYPES
+
+
+def assert_f32_stats(x: Any, what: str) -> Any:
+    """Trace-time guard: raise PrecisionError if a statistics tensor is
+    half-precision. Called at the EM/bank entry points (core/em.py) on the
+    tensors the f32-statistics invariant protects; a static python check,
+    so it costs nothing in the compiled program. Returns `x` unchanged."""
+    dtype = getattr(x, "dtype", None)
+    if dtype is not None and is_half_dtype(dtype):
+        raise PrecisionError(
+            f"{what} is {dtype} but the precision policy pins EM/bank/"
+            "score statistics to float32 (perf/precision.py): a half-"
+            "precision statistic silently shifts the p(x) scale every "
+            "calibrated threshold depends on"
+        )
+    return x

@@ -66,6 +66,12 @@ class Calibration:
     gmm_fingerprint: str
     num_id_samples: int
     source: str = ""  # provenance: where the ID scores came from
+    # compute dtype of the model the ID scores were measured under
+    # (perf/precision.py): a bf16-measured threshold applied to an f32
+    # serve (or vice versa) shifts the operating point the same way a
+    # stale fingerprint does, so the gate fails closed on mismatch.
+    # "" = unknown (pre-policy calibration): honored for back-compat.
+    compute_dtype: str = ""
 
     # ---------------------------------------------------------------- derive
     @staticmethod
@@ -76,6 +82,7 @@ class Calibration:
         percentile: float = DEFAULT_PERCENTILE,
         percentiles: Sequence[float] = DEFAULT_PERCENTILES,
         source: str = "",
+        compute_dtype: str = "",
     ) -> "Calibration":
         """Build from per-sample held-out ID scores (log p(x) [N] and class
         log-likelihoods [N, C]), host-side float64 like the eval driver."""
@@ -113,6 +120,7 @@ class Calibration:
             gmm_fingerprint=str(fingerprint),
             num_id_samples=int(scores.size),
             source=source,
+            compute_dtype=str(compute_dtype),
         )
 
     # ---------------------------------------------------------------- lookup
@@ -161,6 +169,8 @@ class Calibration:
                 gmm_fingerprint=str(d["gmm_fingerprint"]),
                 num_id_samples=int(d["num_id_samples"]),
                 source=str(d.get("source", "")),
+                # absent in pre-policy calibrations: "" = unknown, honored
+                compute_dtype=str(d.get("compute_dtype", "")),
             )
         except (KeyError, TypeError, ValueError) as e:
             raise CalibrationError(f"malformed calibration payload: {e}")
@@ -191,6 +201,9 @@ def calibrate(
         percentile=percentile,
         percentiles=percentiles,
         source=source,
+        # stamp the precision policy the scores were measured under: the
+        # gate refuses to apply these thresholds to a different dtype
+        compute_dtype=trainer.cfg.model.compute_dtype,
     )
 
 

@@ -86,6 +86,7 @@ class ServingEngine:
         num_classes: int,
         calibration: Optional[Calibration] = None,
         expected_fingerprint: Optional[str] = None,
+        expected_compute_dtype: Optional[str] = None,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         percentile: Optional[float] = None,
         queue_capacity: int = 64,
@@ -113,6 +114,7 @@ class ServingEngine:
             calibration,
             expected_fingerprint=expected_fingerprint,
             percentile=percentile,
+            expected_compute_dtype=expected_compute_dtype,
         )
         self.queue = AdmissionQueue(
             capacity=queue_capacity,
@@ -158,6 +160,7 @@ class ServingEngine:
             num_classes=trainer.cfg.model.num_classes,
             calibration=calibration,
             expected_fingerprint=gmm_fingerprint(state.gmm),
+            expected_compute_dtype=trainer.cfg.model.compute_dtype,
             **kw,
         )
 
@@ -189,12 +192,20 @@ class ServingEngine:
                 exported.in_avals[0].shape[0]
             )
             kw["buckets"] = (int(static),)
+        # the dtype the artifact's program actually computes in: the policy
+        # block when present (post-ISSUE-12 exports), the bare meta field
+        # otherwise — a calibration stamped with a DIFFERENT dtype fails
+        # closed in the gate, exactly like a fingerprint mismatch
+        policy = meta.get("precision_policy") or {}
         return cls(
             exported.call,
             img_size=int(meta["img_size"]),
             num_classes=int(meta["num_classes"]),
             calibration=calibration,
             expected_fingerprint=meta.get("gmm_fingerprint"),
+            expected_compute_dtype=(
+                policy.get("compute_dtype") or meta.get("compute_dtype")
+            ),
             **kw,
         )
 

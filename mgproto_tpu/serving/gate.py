@@ -20,6 +20,14 @@ mismatch the gate drops to degraded mode and counts
 `serving_fingerprint_mismatch_total`, rather than gating with wrong
 thresholds.
 
+Fail-closed PRECISION discipline (ISSUE 12): a calibration additionally
+carries the compute dtype its ID scores were measured under
+(perf/precision.py). Serving the same weights under a different trunk
+dtype (bf16 vs f32) shifts the p(x) distribution the thresholds slice, so
+a dtype mismatch is treated exactly like a fingerprint mismatch — degraded
+mode plus `serving_precision_mismatch_total`. Calibrations with no dtype
+stamp (pre-policy artifacts) are honored unchanged.
+
 The trailing abstain rate is exported as the `serving_abstain_rate` gauge —
 the first dashboard signal that live traffic has drifted away from the
 calibration set.
@@ -54,8 +62,10 @@ class TrustGate:
         expected_fingerprint: Optional[str] = None,
         percentile: Optional[float] = None,
         window: int = 256,
+        expected_compute_dtype: Optional[str] = None,
     ):
         self.fingerprint_mismatch = False
+        self.precision_mismatch = False
         if (
             calibration is not None
             and expected_fingerprint is not None
@@ -64,6 +74,20 @@ class TrustGate:
             _m.counter(_m.FINGERPRINT_MISMATCHES).inc()
             self.fingerprint_mismatch = True
             calibration = None  # fail closed: degrade, don't misgate
+        if (
+            calibration is not None
+            and expected_compute_dtype
+            and calibration.compute_dtype
+            and calibration.compute_dtype != expected_compute_dtype
+        ):
+            # precision-policy discipline (perf/precision.py): thresholds
+            # measured under one compute dtype do not transfer to another —
+            # the p(x) distribution shifts with the trunk's rounding. Same
+            # fail-closed contract as a fingerprint mismatch. A calibration
+            # with no dtype stamp ("" — pre-policy artifact) is honored.
+            _m.counter(_m.PRECISION_MISMATCHES).inc()
+            self.precision_mismatch = True
+            calibration = None
         self.calibration = calibration
         self.threshold: Optional[float] = None
         if calibration is not None:

@@ -28,6 +28,7 @@ SHED = "serving_shed_total"
 BREAKER_STATE = "serving_breaker_state"
 BREAKER_TRANSITIONS = "serving_breaker_transitions_total"
 FINGERPRINT_MISMATCHES = "serving_fingerprint_mismatch_total"
+PRECISION_MISMATCHES = "serving_precision_mismatch_total"
 DEGRADED_REQUESTS = "serving_degraded_requests_total"
 DEVICE_ERRORS = "serving_device_errors_total"
 BATCH_FILL = "serving_batch_fill_ratio"
@@ -52,6 +53,10 @@ COUNTER_HELP = {
     FINGERPRINT_MISMATCHES:
         "calibrations rejected because the served GMM does not match the "
         "fingerprint the thresholds were derived from",
+    PRECISION_MISMATCHES:
+        "calibrations rejected because the served compute dtype does not "
+        "match the precision policy the thresholds were measured under "
+        "(perf/precision.py; a dtype change moves the p(x) scale)",
     DEGRADED_REQUESTS: "requests answered WITHOUT OoD gating (degraded mode)",
     DEVICE_ERRORS: "inference dispatches that raised a device error",
     DISPATCHES:

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Lint: EM/bank/calibration code must never cast to or compute in half
+precision — the f32-statistics invariant, enforced statically.
+
+The mixed-precision policy (mgproto_tpu/perf/precision.py) runs the trunk
+in bf16 but pins everything whose ABSOLUTE SCALE carries meaning to f32:
+EM sufficient statistics, the [C, cap, d] memory bank, log p(x) scores,
+and the serving calibration math. The runtime guard (`assert_f32_stats`)
+catches a half-precision tensor arriving at the EM entry points; this lint
+catches the refactor BEFORE it runs — any `bfloat16`/`float16` reference
+appearing in the protected modules:
+
+    mgproto_tpu/core/em.py          EM statistics + mean optimizer
+    mgproto_tpu/core/memory.py      the per-class feature bank
+    mgproto_tpu/serving/calibration.py  threshold/temperature math
+    mgproto_tpu/online/*.py         the continual-learning EM loop
+
+Flagged forms (AST walk, so comments/docstrings never false-positive):
+  * attribute references: `jnp.bfloat16`, `np.float16`, `.half` (the
+    torch-style cast attribute);
+  * bare names `bfloat16`/`float16` (an imported dtype symbol) — NOT the
+    bare word `half`, which is an ordinary identifier (`half = n // 2`)
+    far more often than a dtype;
+  * string dtype literals in CALLS or keywords: `x.astype("bfloat16")`,
+    `jnp.zeros(..., dtype="float16")` (a bare string constant elsewhere —
+    e.g. an error-message fragment — is fine).
+
+Run from anywhere:  python scripts/check_dtype_discipline.py [repo_root]
+Exit 0 when clean, 1 with one `path:line: finding` per offender. Wired
+into tier-1 via tests/test_precision.py (with violation-detection
+coverage, like the other check_* lints).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import sys
+from typing import List
+
+# attribute accesses flag all three ('half' is np.half / the torch-style
+# .half() cast); bare names and dtype strings flag only the unambiguous two
+HALF_ATTRS = ("bfloat16", "float16", "half")
+HALF_NAMES = ("bfloat16", "float16")
+
+PROTECTED = (
+    os.path.join("mgproto_tpu", "core", "em.py"),
+    os.path.join("mgproto_tpu", "core", "memory.py"),
+    os.path.join("mgproto_tpu", "serving", "calibration.py"),
+    os.path.join("mgproto_tpu", "online", "*.py"),
+)
+
+
+def _check_file(path: str, rel: str) -> List[str]:
+    with open(path) as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: unparseable ({e.msg})"]
+    found: List[str] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        found.append(
+            f"{rel}:{getattr(node, 'lineno', '?')}: {what} — EM/bank/"
+            "calibration statistics are pinned to float32 "
+            "(perf/precision.py); route any half-precision compute through "
+            "the trunk's compute_dtype instead"
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in HALF_ATTRS:
+            flag(node, f"half-precision dtype attribute `.{node.attr}`")
+        elif isinstance(node, ast.Name) and node.id in HALF_NAMES:
+            flag(node, f"half-precision dtype name `{node.id}`")
+        elif isinstance(node, ast.Call):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value in HALF_NAMES
+                ):
+                    flag(arg, f"half-precision dtype string {arg.value!r} "
+                              "passed to a call")
+    return found
+
+
+def findings(repo_root: str) -> List[str]:
+    found: List[str] = []
+    for pattern in PROTECTED:
+        paths = sorted(glob.glob(os.path.join(repo_root, pattern)))
+        for path in paths:
+            rel = os.path.relpath(path, repo_root)
+            found.extend(_check_file(path, rel))
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = findings(root)
+    for f in found:
+        print(f)
+    if found:
+        return 1
+    print("check_dtype_discipline: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,11 +18,23 @@ sources, one output schema (see mgproto_tpu/obs/stall.py):
                       the bubble bucket is the real residual; without it
                       the modeled time stands in and the report says so.
 
-Buckets always sum to ~100% of the reported step time (asserted in tier-1).
+Buckets always sum to ~100% of the reported step time (asserted in tier-1),
+and every report carries a ranked `top_byte_movers` table (ISSUE 12): the
+per-op byte charges that name the next fusion target — from per-op trace
+durations/bytes in trace mode, from the dtype-aware StableHLO byte model
+(obs/stall.py `step_byte_model`) in fallback mode. `--byte-source
+hlo_model` additionally makes that model the roofline's byte input (the
+CPU compiled-module bytes are bf16-blind: float normalization rewrites
+bf16 programs to f32-with-converts), and `--dtype` overrides the flagship
+compute dtype — together the bf16-vs-f32 attribution knobs.
 
     # the committed evidence artifact (flagship b256, measured TPU step):
     python scripts/trace_report.py --step-time-s 0.1925 \
         --out evidence/stall_report_b256.json
+
+    # the bf16 counterpart under the dtype-aware byte model:
+    python scripts/trace_report.py --step-time-s 0.1925 \
+        --byte-source hlo_model --out evidence/stall_report_b256_bf16.json
 
     # attribute a captured window:
     python scripts/trace_report.py --trace evidence/trace_spike_step000042/
@@ -52,19 +64,51 @@ def cost_analysis_report(
     attainable: Optional[float],
     tiny: bool = False,
     collective_wait_s: float = 0.0,
+    dtype: str = "",
+    byte_source: str = "cost_analysis",
+    top_n: int = 12,
 ) -> dict:
     """The hermetic fallback: flagship (or tiny, for smoke tests) config
-    lowered through the shared planner helper, roofline-attributed."""
+    lowered through the shared planner helper, roofline-attributed.
+
+    `dtype` overrides the config's compute dtype (the f32-vs-bf16
+    comparison knob); `byte_source` picks the roofline's byte input:
+
+      cost_analysis  XLA's compiled-module bytes (the committed-report
+                     historical source; fusion-pessimistic on CPU and
+                     BLIND to bf16 there — CPU float-normalization
+                     rewrites bf16 to f32-with-converts),
+      hlo_model      the dtype-aware ideal-fusion StableHLO byte model
+                     (obs/stall.py step_byte_model) — required for a
+                     faithful bf16 attribution from the CPU fallback.
+
+    Either way the report carries BOTH byte figures plus the ranked
+    top-byte-movers table (the fusion work list)."""
+    import dataclasses
+
     from bench import flagship_config
 
     from mgproto_tpu.config import tiny_test_config
     from mgproto_tpu.obs import stall
 
     cfg = tiny_test_config() if tiny else flagship_config(fused=False)
-    costs = stall.step_costs(cfg, batch=batch)
+    if dtype:
+        cfg = cfg.replace(
+            model=dataclasses.replace(cfg.model, compute_dtype=dtype)
+        )
+    # ONE trace/lowering feeds both byte sources (the flagship trace alone
+    # is tens of seconds on CPU)
+    lowered = stall.lower_step_programs(cfg, batch)
+    costs = stall.step_costs(cfg, batch=batch, lowered=lowered)
+    model = stall.step_byte_model(cfg, batch=batch, top_n=top_n,
+                                  lowered=lowered)
+    if byte_source == "hlo_model":
+        roofline_bytes = model["fused_bytes"]
+    else:
+        roofline_bytes = costs["bytes_accessed"]
     attribution = stall.roofline_buckets(
         costs["flops"],
-        costs["bytes_accessed"],
+        roofline_bytes,
         step_time_s=step_time_s,
         host_infeed_s=host_infeed_s,
         collective_wait_s=collective_wait_s,
@@ -81,8 +125,14 @@ def cost_analysis_report(
             "batch": costs["batch"],
             "backend": costs["backend"],
             "async_bank": costs["async_bank"],
-            "bytes_accessed": costs["bytes_accessed"],
+            "compute_dtype": cfg.model.compute_dtype,
+            "byte_source": byte_source,
+            "bytes_accessed": roofline_bytes,
+            "cost_analysis_bytes": costs["bytes_accessed"],
+            "model_raw_bytes": model["raw_bytes"],
+            "model_fused_bytes": model["fused_bytes"],
             "programs": costs["programs"],
+            "top_byte_movers": model["top_byte_movers"],
             "hbm_bytes_per_s": hbm_bytes_per_s,
         },
     )
@@ -94,6 +144,7 @@ def trace_mode_report(
     peak_flops: float,
     flops: Optional[float],
     attainable: Optional[float],
+    top_n: int = 12,
 ) -> dict:
     from mgproto_tpu.obs import stall
 
@@ -104,7 +155,12 @@ def trace_mode_report(
         flops=flops,
         peak_flops=peak_flops,
         attainable_mfu=attainable,
-        extra={"trace": os.path.abspath(trace_path)},
+        extra={
+            "trace": os.path.abspath(trace_path),
+            "top_byte_movers": stall.top_byte_movers_from_trace(
+                events, top_n=top_n
+            ),
+        },
     )
 
 
@@ -140,6 +196,19 @@ def main(argv=None) -> int:
     p.add_argument("--attainable", type=float, default=None,
                    help="attainable MFU ceiling (default: the committed "
                         "evidence/mfu_headroom_b256.json tiling bound)")
+    p.add_argument("--dtype", default="",
+                   choices=("", "float32", "bfloat16"),
+                   help="fallback mode: override the config's compute "
+                        "dtype (the f32-vs-bf16 comparison knob)")
+    p.add_argument("--byte-source", default="cost_analysis",
+                   choices=("cost_analysis", "hlo_model"),
+                   help="fallback mode: roofline byte input — XLA's "
+                        "compiled-module bytes (committed-report "
+                        "historical source; bf16-blind and fusion-"
+                        "pessimistic on CPU) or the dtype-aware ideal-"
+                        "fusion StableHLO model (obs/stall.py)")
+    p.add_argument("--top-movers", type=int, default=12,
+                   help="rows in the ranked top-byte-movers table")
     p.add_argument("--flops", type=float, default=None,
                    help="trace mode: step FLOPs for the MFU line (fallback "
                         "mode reads them from cost analysis)")
@@ -153,13 +222,15 @@ def main(argv=None) -> int:
     if args.trace:
         report = trace_mode_report(
             args.trace, args.host_infeed_s, peak_flops, args.flops,
-            args.attainable,
+            args.attainable, top_n=args.top_movers,
         )
     else:
         report = cost_analysis_report(
             args.batch, args.step_time_s, args.host_infeed_s, peak_flops,
             hbm, args.attainable, tiny=args.tiny,
             collective_wait_s=args.collective_wait_s,
+            dtype=args.dtype, byte_source=args.byte_source,
+            top_n=args.top_movers,
         )
     line = json.dumps(report, sort_keys=True)
     print(line)

@@ -1,0 +1,166 @@
+"""Pallas fused BN+residual+ReLU epilogue parity (ops/fused_epilogue.py).
+
+The kernel replaces the ResNet block tail the byte-ranked fusion table
+(obs/stall.py top_byte_movers) names as the flagship's #1 non-MXU byte
+mover. Its contract: numerics indistinguishable from the XLA reference —
+forward within float tolerance, gradients EXACT by construction (the
+backward is jax.vjp of the reference), parameter/stat trees bit-identical
+so checkpoints interchange. All tests run the kernel in CPU interpret mode
+(`pallas` marker, tier-1)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.models.resnet import BasicBlock, Bottleneck
+from mgproto_tpu.ops.fused_epilogue import (
+    epilogue_reference,
+    fused_bn_epilogue,
+    resolve_fused_epilogue,
+)
+
+pytestmark = pytest.mark.pallas
+
+
+def _inputs(shape=(2, 9, 9, 64), seed=0, dtype=jnp.float32):
+    k = jax.random.PRNGKey(seed)
+    kx, kr, km, kv = jax.random.split(k, 4)
+    c = shape[-1]
+    x = jax.random.normal(kx, shape, jnp.float32).astype(dtype)
+    res = jax.random.normal(kr, shape, jnp.float32).astype(dtype)
+    mean = jax.random.normal(km, (c,), jnp.float32) * 0.1
+    var = jax.nn.softplus(jax.random.normal(kv, (c,), jnp.float32)) + 0.1
+    scale = jnp.linspace(0.5, 1.5, c)
+    bias = jnp.linspace(-0.2, 0.2, c)
+    return x, mean, var, scale, bias, res
+
+
+def test_kernel_matches_reference_forward():
+    x, mean, var, scale, bias, res = _inputs()
+    got = fused_bn_epilogue(x, mean, var, scale, bias, res)
+    want = epilogue_reference(x, mean, var, scale, bias, res, 1e-5,
+                              jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    # non-tile-aligned row counts exercise the padding path
+    x2, m2, v2, s2, b2, r2 = _inputs(shape=(1, 7, 5, 32), seed=1)
+    got = fused_bn_epilogue(x2, m2, v2, s2, b2, r2)
+    want = epilogue_reference(x2, m2, v2, s2, b2, r2, 1e-5, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_kernel_matches_reference_gradients_exactly():
+    """The backward IS the reference's VJP (remat-style recompute), so for
+    a GIVEN cotangent the gradients — including through mean/var, the
+    train-mode BN statistics backward — match bit-for-bit. (Through a
+    downstream loss the cotangents themselves inherit the forward's
+    last-ulp differences, so end-to-end grads are allclose, not equal —
+    covered by the block-level test below.)"""
+    args = _inputs(seed=2)
+    _, vjp_f = jax.vjp(lambda *a: fused_bn_epilogue(*a), *args)
+    _, vjp_r = jax.vjp(
+        lambda *a: epilogue_reference(*a, 1e-5, jnp.float32), *args
+    )
+    g = jax.random.normal(jax.random.PRNGKey(9), args[0].shape, jnp.float32)
+    for a, b in zip(vjp_f(g), vjp_r(g)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kernel_bf16_wire_dtype():
+    x, mean, var, scale, bias, res = _inputs(dtype=jnp.bfloat16, seed=3)
+    got = fused_bn_epilogue(x, mean, var, scale, bias, res)
+    assert got.dtype == jnp.bfloat16
+    want = epilogue_reference(x, mean, var, scale, bias, res, 1e-5,
+                              jnp.bfloat16)
+    # the kernel accumulates in f32 (never less precise than the bf16
+    # reference); agreement is to bf16 resolution
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=0.05, atol=0.05,
+    )
+
+
+# BasicBlock IS the flagship's (R34) block — tier-1; the Bottleneck
+# variant exercises the same mount at bn3 and rides the slow lane
+@pytest.mark.parametrize("block_cls,planes", [
+    (BasicBlock, 32),
+    pytest.param(Bottleneck, 16, marks=pytest.mark.slow),
+])
+def test_block_fused_vs_unfused_parity(block_cls, planes):
+    """Same variables, both modes, train AND eval: outputs close, updated
+    batch_stats identical, param structures interchangeable."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 32), jnp.float32)
+    ref = block_cls(planes=planes, has_downsample=True)
+    fus = block_cls(planes=planes, has_downsample=True, fused_epilogue=True)
+    v = ref.init(jax.random.PRNGKey(1), x, True)
+    vf = fus.init(jax.random.PRNGKey(1), x, True)
+    assert (
+        jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(vf)
+    )
+    yr, mr = ref.apply(v, x, True, mutable=["batch_stats"])
+    yf, mf = fus.apply(v, x, True, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yr), np.asarray(yf),
+                               rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(mr),
+                    jax.tree_util.tree_leaves(mf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ref.apply(v, x, False)), np.asarray(fus.apply(v, x, False)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    def loss(mod, params):
+        y, _ = mod.apply(
+            {"params": params, "batch_stats": v["batch_stats"]}, x, True,
+            mutable=["batch_stats"],
+        )
+        return jnp.sum(y ** 2)
+
+    gr = jax.grad(lambda p: loss(ref, p))(v["params"])
+    gf = jax.grad(lambda p: loss(fus, p))(v["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(gr),
+                    jax.tree_util.tree_leaves(gf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_model_level_wiring_and_gating():
+    """MGProtoFeatures mounts the epilogue for resnets when the flag
+    resolves on; the forward matches the unfused model; non-resnet archs
+    refuse an explicit True."""
+    from mgproto_tpu.core.mgproto import MGProtoFeatures
+
+    base = tiny_test_config(arch="resnet18", img_size=32)
+    off = MGProtoFeatures(cfg=dataclasses.replace(
+        base.model, fused_epilogue=False))
+    on = MGProtoFeatures(cfg=dataclasses.replace(
+        base.model, fused_epilogue=True))
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3), jnp.float32)
+    v = off.init(jax.random.PRNGKey(1), x, train=False)
+    pm_off, emb_off = off.apply(v, x, train=False)
+    pm_on, emb_on = on.apply(v, x, train=False)
+    np.testing.assert_allclose(np.asarray(pm_off), np.asarray(pm_on),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(emb_off), np.asarray(emb_on),
+                               rtol=1e-5, atol=1e-5)
+
+    with pytest.raises(ValueError, match="resnet blocks only"):
+        MGProtoFeatures(cfg=dataclasses.replace(
+            tiny_test_config().model, fused_epilogue=True
+        )).init(jax.random.PRNGKey(0), x, train=False)
+
+
+def test_resolution_rule():
+    # None = auto: on only for TPU backends with a resnet trunk — off CPU
+    assert resolve_fused_epilogue(None, "resnet34") == (
+        jax.default_backend() == "tpu"
+    )
+    assert resolve_fused_epilogue(None, "vgg11") is False
+    assert resolve_fused_epilogue(True, "resnet34") is True
+    assert resolve_fused_epilogue(False, "resnet34") is False

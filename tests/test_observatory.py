@@ -546,6 +546,240 @@ def test_stall_report_evidence_committed():
     assert 0.8 < rep["attainable_mfu"] < 1.0
 
 
+# ------------------------------------- byte-ranked fusion targets (ISSUE 12)
+_MOVER_ROW_KEYS = {
+    "name", "bucket", "count", "bytes_accessed", "bytes_fraction",
+    "seconds", "time_fraction",
+}
+
+
+def _assert_movers_schema(movers):
+    """The one `top_byte_movers` contract, shared by both sources."""
+    assert movers["source"] in ("hlo_model", "trace")
+    assert "total_bytes" in movers
+    assert movers["rows"], "ranked table must not be empty"
+    for row in movers["rows"]:
+        assert set(row) == _MOVER_ROW_KEYS, row
+        assert row["bucket"] in stall.BUCKETS
+    byte_vals = [r["bytes_accessed"] for r in movers["rows"]
+                 if r["bytes_accessed"] is not None]
+    assert byte_vals == sorted(byte_vals, reverse=True)
+
+
+def test_parse_hlo_bytes_dtype_and_fusion_model():
+    """The StableHLO walk: logical dtypes (bf16 = 2 bytes), short and full
+    signature forms, major-vs-fused charging, and convert folding (a
+    reduce over convert(bf16->f32) streams the bf16 bytes)."""
+    text = "\n".join([
+        "module @m {",
+        "  func.func public @main(%arg0: tensor<8x128xbf16>) "
+        "-> tensor<8x128xf32> {",
+        "    %0 = stablehlo.convert %arg0 : (tensor<8x128xbf16>) "
+        "-> tensor<8x128xf32>",
+        "    %1 = stablehlo.add %0, %0 : tensor<8x128xf32>",
+        "    %cst = stablehlo.constant dense<0.0> : tensor<f32>",
+        "    %2 = stablehlo.reduce(%0 init: %cst) applies stablehlo.add "
+        "across dimensions = [0] : (tensor<8x128xf32>, tensor<f32>) "
+        "-> tensor<128xf32>",
+        "    return %1 : tensor<8x128xf32>",
+        "  }",
+        "}",
+    ])
+    parsed = stall.parse_hlo_bytes(text)
+    n = 8 * 128
+    # raw: convert (2n + 4n) + add (3 * 4n) + constant (result once — a
+    # zero-operand op must not charge a phantom operand) + reduce
+    # (operands 4n + 4, result 4 * 128)
+    assert parsed["raw_bytes"] == pytest.approx(
+        (2 * n + 4 * n) + 3 * 4 * n + 4 + (4 * n + 4 + 4 * 128)
+    )
+    # fused: ONLY the reduce is major, and its big operand folds through
+    # the convert to the bf16 source
+    assert parsed["fused_bytes"] == pytest.approx(2 * n + 4 + 4 * 128)
+    keys = list(parsed["ops"])
+    assert any("reduce" in k for k in keys)
+
+
+def test_step_byte_model_tiny_and_dtype_ratio():
+    """The model on the real tiny production program: totals ordered, the
+    ranked table well-formed, and bf16 strictly cheaper than f32 under
+    the fused view (the dtype axis works end to end)."""
+    import dataclasses
+
+    from mgproto_tpu.config import tiny_test_config
+
+    cfg = tiny_test_config()
+    rep = stall.step_byte_model(cfg, batch=4, top_n=6)
+    assert rep["byte_model"] == "hlo_dtype"
+    assert rep["raw_bytes"] > rep["fused_bytes"] > 0
+    _assert_movers_schema(rep["top_byte_movers"])
+    frac = sum(
+        r["bytes_fraction"] for r in rep["top_byte_movers"]["rows"]
+    )
+    assert 0 < frac <= 1.0
+    bf = stall.step_byte_model(
+        cfg.replace(model=dataclasses.replace(
+            cfg.model, compute_dtype="bfloat16")),
+        batch=4,
+    )
+    assert bf["fused_bytes"] < rep["fused_bytes"]
+
+
+def test_top_byte_movers_from_trace():
+    events = [
+        _event("fusion.1", 0, 500),
+        _event("fusion.1", 500, 300),
+        _event("convolution.2", 800, 200),
+    ]
+    events[0].setdefault("args", {})["bytes_accessed"] = 1000.0
+    events[1].setdefault("args", {})["bytes_accessed"] = 500.0
+    movers = stall.top_byte_movers_from_trace(events)
+    _assert_movers_schema(movers)
+    assert movers["total_bytes"] == 1500.0
+    top = movers["rows"][0]
+    assert top["name"] == "fusion.1" and top["count"] == 2
+    assert top["bytes_fraction"] == pytest.approx(1.0)
+    # bytes unknown for the conv: null, never invented
+    conv = [r for r in movers["rows"] if r["name"] == "convolution.2"][0]
+    assert conv["bytes_accessed"] is None
+    assert conv["seconds"] == pytest.approx(200 / 1e6)
+
+
+def test_trace_report_byte_source_and_dtype_knobs():
+    """Fallback mode with --byte-source hlo_model: the roofline consumes
+    the model bytes, the report says so, and the ranked table rides along
+    with the schema both sources share."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from trace_report import cost_analysis_report
+    finally:
+        sys.path.pop(0)
+    rep = cost_analysis_report(
+        batch=4, step_time_s=0.05, host_infeed_s=0.0,
+        peak_flops=197e12, hbm_bytes_per_s=819e9, attainable=None,
+        tiny=True, byte_source="hlo_model", dtype="bfloat16",
+    )
+    assert rep["byte_source"] == "hlo_model"
+    assert rep["compute_dtype"] == "bfloat16"
+    assert rep["bytes_accessed"] == rep["model_fused_bytes"]
+    assert rep["cost_analysis_bytes"] > 0
+    assert rep["fraction_sum"] == pytest.approx(1.0)
+    _assert_movers_schema(rep["top_byte_movers"])
+
+
+def test_stall_report_bf16_evidence_committed():
+    """Acceptance: the regenerated bf16 stall report sits beside the f32
+    one, uses the dtype-aware byte model, and its hbm_bound fraction is
+    STRICTLY below the committed 0.4366 at the same measured step time."""
+    path = os.path.join(REPO, "evidence", "stall_report_b256_bf16.json")
+    rep = json.loads(open(path).read().strip())
+    base = json.loads(open(
+        os.path.join(REPO, "evidence", "stall_report_b256.json")
+    ).read().strip())
+    assert rep["stall_report"] and rep["config"] == "flagship"
+    assert rep["compute_dtype"] == "bfloat16"
+    assert rep["byte_source"] == "hlo_model"
+    assert rep["step_time_s"] == pytest.approx(base["step_time_s"])
+    assert rep["fraction_sum"] == pytest.approx(1.0, abs=1e-6)
+    assert (
+        rep["buckets"]["hbm_bound"]["fraction"]
+        < base["buckets"]["hbm_bound"]["fraction"]
+    )
+    _assert_movers_schema(rep["top_byte_movers"])
+
+
+def test_stall_report_gates():
+    """`mgproto-telemetry check --stall-report`: schema sanity alone, and
+    the byte-regression gate against a baseline report."""
+    from mgproto_tpu.cli.telemetry import stall_report_gates
+
+    path = os.path.join(REPO, "evidence", "stall_report_b256_bf16.json")
+    rep = json.loads(open(path).read().strip())
+    assert stall_report_gates(rep)["ok"]
+    assert not stall_report_gates({"not": "a report"})["ok"]
+    # self-vs-self passes; inflated bytes or hbm fraction fails
+    assert stall_report_gates(rep, rep)["ok"]
+    worse = json.loads(json.dumps(rep))
+    worse["bytes_accessed"] = rep["bytes_accessed"] * 1.2
+    res = stall_report_gates(worse, rep)
+    assert not res["ok"]
+    assert any(r["key"] == "stall.bytes_accessed" and not r["ok"]
+               for r in res["rows"])
+    worse = json.loads(json.dumps(rep))
+    worse["buckets"]["hbm_bound"]["fraction"] += 0.1
+    assert not stall_report_gates(worse, rep)["ok"]
+    # cross-source comparisons are refused, not silently gated
+    other = json.loads(json.dumps(rep))
+    other["byte_source"] = "cost_analysis"
+    res = stall_report_gates(other, rep)
+    assert any(r["key"] == "stall.byte_source_matches" and not r["ok"]
+               for r in res["rows"])
+    # fractions are fractions OF the step: a report measured at a
+    # different step time must be refused, not gated (a slower window
+    # dilutes hbm_bound into bubble and would pass real regressions)
+    slower = json.loads(json.dumps(rep))
+    slower["step_time_s"] = rep["step_time_s"] * 1.5
+    res = stall_report_gates(slower, rep)
+    assert any(r["key"] == "stall.step_time_comparable" and not r["ok"]
+               for r in res["rows"])
+
+
+def test_check_cli_stall_report_gate():
+    """The CLI wiring: a clean committed report exits 0 standalone, and
+    regenerate-vs-committed regression runs exit 1 on a perturbed copy."""
+    import tempfile
+
+    base = os.path.join(REPO, "evidence", "stall_report_b256_bf16.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "check",
+         "--stall-report", base],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(open(base).read().strip())
+    rep["bytes_accessed"] *= 2.0
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        json.dump(rep, f)
+        bad = f.name
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "mgproto_tpu.cli.telemetry", "check",
+             "--stall-report", bad, "--stall-baseline", base],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 1
+        assert "stall.bytes_accessed" in out.stdout
+    finally:
+        os.unlink(bad)
+
+
+def test_summarize_renders_perf_section(tmp_path):
+    """A stall report dropped into the telemetry dir surfaces in
+    `mgproto-telemetry summarize` — buckets, byte source, and the top
+    byte movers — in both the dict and the rendered table."""
+    from mgproto_tpu.cli.telemetry import render_table, summarize
+    from mgproto_tpu.telemetry.session import TelemetrySession
+
+    d = str(tmp_path / "telem")
+    session = TelemetrySession(d, primary=True)
+    session.monitor.observe_step(4, 0.01, check_recompiles=False)
+    session.close()
+    src = os.path.join(REPO, "evidence", "stall_report_b256_bf16.json")
+    with open(os.path.join(d, "stall_report.json"), "w") as f:
+        f.write(open(src).read())
+    summary = summarize(d)
+    perf = summary["perf"]
+    assert perf["stall_report"] == "stall_report.json"
+    assert perf["byte_source"] == "hlo_model"
+    assert perf["hbm_bound_fraction"] is not None
+    assert perf["top_byte_movers"]
+    table = render_table(summary)
+    assert "byte_mover_1" in table
+    assert "stall attribution" in table
+
+
 # ---------------------------------------------------------- regression gate
 def _make_telemetry_dir(tmp_path, ips=100.0):
     """A real TelemetrySession with a few observed steps."""
