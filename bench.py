@@ -992,29 +992,30 @@ def measure_dtype() -> dict:
     return out
 
 
-def _measure_dtype_main() -> None:
-    """`--measure dtype` with the cached-fallback/staleness machinery the
-    flagship paths already have: a live failure (the CPU compile half can
-    still die on a wedged machine, and on-TPU invocations ride the same
-    flaky relay as everything else) re-emits the committed
-    evidence/dtype_bench.json as the final line — explicitly `cached:
-    true`, stamped with the live error as `probe_failure` and with its
-    age (stale beyond BENCH_CACHED_MAX_AGE_S exits 1) — so a flaky window
-    degrades DIAGNOSABLY instead of flatlining the dtype trajectory."""
+def _measure_with_cached_fallback(measure_fn, evidence_name: str) -> None:
+    """The ONE cached-fallback/staleness wrapper hermetic measures share
+    (`--measure dtype` / `--measure coldstart`): emit the live result and
+    exit 0, or — on ANY failure (the CPU compile half can die on a wedged
+    machine, and on-TPU invocations ride the same flaky relay as
+    everything else) — re-emit the committed evidence/<name> as the final
+    line, explicitly `cached: true`, stamped with the live error as
+    `probe_failure` and its age (stale beyond BENCH_CACHED_MAX_AGE_S
+    exits 1), so a flaky window degrades DIAGNOSABLY instead of
+    flatlining the trajectory."""
     try:
-        print(json.dumps(measure_dtype()), flush=True)
+        print(json.dumps(measure_fn()), flush=True)
         raise SystemExit(0)
     except SystemExit:
         raise
     except BaseException as e:  # noqa: BLE001 — every failure must degrade
         failure = {"error": f"{type(e).__name__}: {e}"}
-    cached_path = os.path.join(_BENCH_DIR, "evidence", "dtype_bench.json")
+    cached_path = os.path.join(_BENCH_DIR, "evidence", evidence_name)
     try:
         with open(cached_path) as f:
             cached = json.loads(f.read().strip().splitlines()[-1])
     except (OSError, ValueError, IndexError):
-        _emit({"error": "dtype measure failed and no cached "
-                        "evidence/dtype_bench.json exists",
+        _emit({"error": f"measure failed and no cached "
+                        f"evidence/{evidence_name} exists",
                "probe_failure": failure})
         raise SystemExit(1)
     cached["cached"] = True
@@ -1028,6 +1029,106 @@ def _measure_dtype_main() -> None:
         raise SystemExit(1)
     _emit(cached)
     raise SystemExit(0)
+
+
+def measure_coldstart() -> dict:
+    """Hermetic cold-vs-warm replica-start microbench (`python bench.py
+    --measure coldstart`, CPU-friendly): the ISSUE-13 AOT executable
+    cache's before/after. Two ServingEngines over the same tiny state and
+    a fresh ExecutableCache:
+
+      * COLD  — empty cache: every bucket misses, compiles, and is
+        lazily stored (compile-everything warmup, the pre-cache world,
+        plus the one-time serialization cost);
+      * WARM  — same cache: every bucket deserializes (the mmap-and-go
+        replica start a scale-up or blue/green swap pays).
+
+    Per-bucket breakdown from `ServingEngine.warmup_report`, one JSON
+    line; the committed artifact is evidence/coldstart_bench.json (schema
+    in evidence/README.md). The WARM engine must perform ZERO XLA
+    compiles — asserted here through the StepMonitor-backed warmup return,
+    not just reported.
+
+    Env knobs: BENCH_COLDSTART_BUCKETS (default "1,2,4,8")."""
+    if os.environ.get("BENCH_FAIL_INJECT"):
+        # deterministic failure for the cached-fallback contract tests
+        raise RuntimeError("BENCH_FAIL_INJECT: simulated coldstart failure")
+    import shutil
+    import tempfile
+
+    import jax
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.serving import metrics as sm
+    from mgproto_tpu.serving.aotcache import ExecutableCache
+    from mgproto_tpu.serving.engine import ServingEngine
+    from mgproto_tpu.telemetry.registry import (
+        MetricRegistry,
+        set_current_registry,
+    )
+
+    buckets = tuple(
+        int(b)
+        for b in os.environ.get("BENCH_COLDSTART_BUCKETS", "1,2,4,8")
+        .split(",") if b.strip()
+    )
+    registry = MetricRegistry()
+    prev = set_current_registry(registry)
+    cache_dir = tempfile.mkdtemp(prefix="mgproto_cold_")
+    try:
+        sm.register_serving_metrics(registry)
+        cfg = tiny_test_config()
+        trainer = Trainer(cfg, steps_per_epoch=1)
+        state = trainer.init_state(jax.random.PRNGKey(0))
+        cache = ExecutableCache(cache_dir)
+
+        def run(label):
+            engine = ServingEngine.from_live(
+                trainer, state, buckets=buckets, aot_cache=cache
+            )
+            t0 = time.perf_counter()
+            compiles = engine.warmup()
+            total = time.perf_counter() - t0
+            return {
+                "total_s": round(total, 6),
+                "compiles": compiles,
+                "per_bucket": [
+                    {**row, "seconds": round(row["seconds"], 6)}
+                    for row in engine.warmup_report
+                ],
+            }
+
+        cold = run("cold")
+        warm = run("warm")
+        if warm["compiles"] != 0:
+            raise RuntimeError(
+                f"warm start compiled {warm['compiles']}x — the AOT cache "
+                "was bypassed or every entry was rejected"
+            )
+        return {
+            "metric": "coldstart",
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "backend": jax.default_backend(),
+            "config": "tiny",
+            "buckets": list(buckets),
+            "cold": cold,
+            "warm": warm,
+            "speedup_cold_over_warm": (
+                round(cold["total_s"] / warm["total_s"], 2)
+                if warm["total_s"] > 0 else None
+            ),
+            "aot": {
+                "hits": registry.counter(sm.AOT_HITS).value(),
+                "misses": registry.counter(sm.AOT_MISSES).value(),
+                "stores_ok": registry.counter(sm.AOT_STORES).value(
+                    result="ok"
+                ),
+            },
+        }
+    finally:
+        set_current_registry(prev)
+        shutil.rmtree(cache_dir, ignore_errors=True)
 
 
 def _fail(error_obj: dict) -> None:
@@ -1204,7 +1305,13 @@ if __name__ == "__main__":
         if measure == "dtype":
             # hermetic f32-vs-bf16 byte microbench, with the cached-
             # fallback/staleness degrade (ISSUE 12)
-            _measure_dtype_main()
+            _measure_with_cached_fallback(measure_dtype, "dtype_bench.json")
+        if measure == "coldstart":
+            # hermetic cold-vs-warm replica-start microbench (AOT
+            # executable cache), same degrade machinery (ISSUE 13)
+            _measure_with_cached_fallback(
+                measure_coldstart, "coldstart_bench.json"
+            )
         if len(sys.argv) == 4:
             BATCH = int(sys.argv[3])
         if BATCH <= 0:

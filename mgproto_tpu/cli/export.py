@@ -58,6 +58,16 @@ def main(argv: Optional[list] = None) -> None:
                    help="ID percentile for the default abstention "
                         "operating point (matches evaluate_with_ood's "
                         "threshold convention)")
+    p.add_argument("--aot-cache", "--aot_cache", dest="aot_cache",
+                   action="store_true",
+                   help="prebuild the AOT executable cache beside the "
+                        "artifact (<out>.aotcache/): compile each "
+                        "--aot_buckets serving bucket and serialize the "
+                        "executable, so replica starts on matching "
+                        "hardware warm with ZERO compiles "
+                        "(serving/aotcache.py)")
+    p.add_argument("--aot_buckets", default="1,2,4,8",
+                   help="bucket sizes to precompile into the AOT cache")
     args = p.parse_args(argv)
     cfg = config_from_args(args)
 
@@ -96,14 +106,24 @@ def main(argv: Optional[list] = None) -> None:
             cfg, trainer, state, percentile=args.calib_percentile
         )
     save_artifact(args.out, exported, meta, calibration=calib)
-    print(json.dumps({
+    line = {
         "artifact": args.out,
         "bytes": os.path.getsize(args.out),
         "calibrated": calib is not None,
         **{k: meta[k] for k in ("arch", "num_classes", "img_size",
                                 "dynamic_batch", "checkpoint",
                                 "gmm_fingerprint")},
-    }))
+    }
+    if args.aot_cache:
+        from mgproto_tpu.engine.export import export_aot_cache
+
+        line["aot_cache"] = export_aot_cache(
+            args.out,
+            buckets=tuple(
+                int(b) for b in args.aot_buckets.split(",") if b.strip()
+            ),
+        )
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -96,6 +96,31 @@ def _engine_kw(args, monitor: Optional[StepMonitor] = None):
     )
 
 
+def _resolve_aot_cache(args, cfg=None):
+    """The ONE ExecutableCache for every engine this process builds (the
+    cache is content-addressed, so sharing is safe), or None when
+    --aot-cache is off. "auto" resolves the sidecar convention: next to
+    the artifact, or under the live face's model_dir."""
+    raw = getattr(args, "aot_cache", "") or ""
+    if not raw:
+        return None
+    from mgproto_tpu.serving.aotcache import (
+        ExecutableCache,
+        default_cache_dir,
+    )
+
+    if raw != "auto":
+        return ExecutableCache(raw)
+    if args.artifact:
+        return ExecutableCache(default_cache_dir(args.artifact))
+    if cfg is not None and cfg.model_dir:
+        return ExecutableCache(os.path.join(cfg.model_dir, "aotcache"))
+    raise SystemExit(
+        "--aot-cache auto needs --artifact or --model_dir to anchor the "
+        "sidecar cache dir; pass an explicit directory instead"
+    )
+
+
 def make_engine_factory(
     args, monitor_factory: Optional[Callable[[], StepMonitor]] = None
 ) -> Callable:
@@ -119,10 +144,19 @@ def make_engine_factory(
 
     if args.artifact:
         path, allow = args.artifact, args.allow_uncalibrated
+        cache = _resolve_aot_cache(args)
+        aot_fp = None
+        if cache is not None:
+            # hash the artifact ONCE here, not per engine: every replica
+            # (re)start would otherwise re-read the whole file
+            from mgproto_tpu.engine.export import artifact_aot_fingerprint
+
+            aot_fp = artifact_aot_fingerprint(path)
 
         def factory():
             return ServingEngine.from_artifact(
-                path, allow_uncalibrated=allow, monitor=_monitor(), **_kw()
+                path, allow_uncalibrated=allow, monitor=_monitor(),
+                aot_cache=cache, aot_fingerprint=aot_fp, **_kw()
             )
 
         return factory
@@ -162,9 +196,21 @@ def make_engine_factory(
             "(degraded mode, no OoD abstention)"
         )
 
+    cache = _resolve_aot_cache(args, cfg)
+    aot_fp = None
+    if cache is not None:
+        # the live face's program identity must cover the FULL restored
+        # state, not just the mixture: pytree_digest hashes every leaf
+        # (one pass at startup — the price of never serving a stale
+        # executable for a touched-up checkpoint)
+        from mgproto_tpu.utils.checkpoint import pytree_digest
+
+        aot_fp = pytree_digest(state)
+
     def factory():
         return ServingEngine.from_live(
-            trainer, state, calibration=calib, monitor=_monitor(), **_kw()
+            trainer, state, calibration=calib, monitor=_monitor(),
+            aot_cache=cache, aot_fingerprint=aot_fp, **_kw()
         )
 
     # the online plane (--online) needs the heavy live context the factory
@@ -318,6 +364,26 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--heartbeat_timeout_s", type=float, default=2.0,
                    help="replica heartbeat staleness before the supervisor "
                         "drains + restarts it")
+    # elastic serving (ISSUE 13): AOT executable cache + autoscaler
+    p.add_argument("--aot-cache", "--aot_cache", dest="aot_cache",
+                   default="",
+                   help="AOT executable cache dir (serving/aotcache.py): "
+                        "warmup deserializes cached bucket executables "
+                        "instead of compiling (mmap-and-go cold start) and "
+                        "lazily stores misses; 'auto' = the sidecar next "
+                        "to --artifact (<artifact>.aotcache/) or "
+                        "<model_dir>/aotcache. Empty = off")
+    p.add_argument("--autoscale", default="",
+                   help="MIN:MAX replica bounds for the observatory-driven "
+                        "autoscaler (network face): the pump grows the "
+                        "fleet on queue-depth/shed-rate/p99 saturation and "
+                        "shrinks it after sustained calm with a zero-drop "
+                        "drain (serving/autoscale.py). --replicas sets the "
+                        "starting size (clamped into the bounds). Empty = "
+                        "fixed fleet")
+    p.add_argument("--autoscale_interval_s", type=float, default=0.25,
+                   help="autoscaler decision cadence (pump-hook polling "
+                        "on the plane's clock; never sleeps)")
     # performance observatory (ISSUE 8)
     p.add_argument("--trace_requests", action="store_true",
                    help="end-to-end request tracing: frontend->batcher->"
@@ -443,15 +509,33 @@ def _apply_auto_tune(args, engine, telem) -> None:
 
 def _swap_factory(args, path: str) -> Callable:
     """Engine factory for a swap target artifact, sharing the serve knobs
-    (buckets/deadline/queue) with the running fleet."""
+    (buckets/deadline/queue) with the running fleet. With --aot-cache the
+    green fleet warms through the TARGET artifact's cache too (its own
+    sidecar under 'auto', the shared content-addressed dir otherwise) —
+    the cheap-swap story is precisely why the cache exists."""
     from mgproto_tpu.serving.engine import ServingEngine
 
     kw = _engine_kw(args)
     kw.pop("monitor")
+    cache = None
+    aot_fp = None
+    if getattr(args, "aot_cache", ""):
+        from mgproto_tpu.engine.export import artifact_aot_fingerprint
+        from mgproto_tpu.serving.aotcache import (
+            ExecutableCache,
+            default_cache_dir,
+        )
+
+        cache = ExecutableCache(
+            default_cache_dir(path) if args.aot_cache == "auto"
+            else args.aot_cache
+        )
+        aot_fp = artifact_aot_fingerprint(path)  # hashed once, not per engine
 
     def factory():
         return ServingEngine.from_artifact(
-            path, allow_uncalibrated=args.allow_uncalibrated, **kw
+            path, allow_uncalibrated=args.allow_uncalibrated,
+            aot_cache=cache, aot_fingerprint=aot_fp, **kw
         )
 
     return factory
@@ -628,16 +712,39 @@ def _build_plane(args, telem):
     # engine, and the factory reads the tuned bucket set late, so the fleet
     # and every restart agree with the plan
     factory = make_engine_factory(args)
+    engine_prep = None
     if args.auto_tune:
         probe = factory()
         _apply_auto_tune(args, probe, telem)
         del probe
+        # per-replica right-sizing: every engine a scale-up or restart
+        # builds re-plans ITS bucket ladder against its own device budget
+        # (heterogeneous hardware gets heterogeneous ladders; the probe
+        # above already shrank the homogeneous baseline)
+        from mgproto_tpu.serving.autoscale import hbm_bucket_prep
+
+        engine_prep = hbm_bucket_prep()
     return ReplicaSet(
         factory,
         replicas=args.replicas,
         heartbeat_timeout_s=args.heartbeat_timeout_s,
         batcher_config=BatcherConfig(max_linger_s=args.linger_ms / 1000.0),
+        engine_prep=engine_prep,
     )
+
+
+def _parse_autoscale(raw: str):
+    """'MIN:MAX' -> (min, max) or None when unset."""
+    if not raw:
+        return None
+    mn, _, mx = raw.partition(":")
+    try:
+        bounds = (int(mn), int(mx))
+    except ValueError:
+        raise SystemExit(f"--autoscale must be MIN:MAX, got {raw!r}")
+    if bounds[0] < 1 or bounds[1] < bounds[0]:
+        raise SystemExit(f"--autoscale needs 1 <= MIN <= MAX, got {raw!r}")
+    return bounds
 
 
 def _main_batch_plane(args, handler, telem) -> None:
@@ -693,10 +800,29 @@ def _main_listen(args, handler, telem) -> None:
     host, _, port = args.listen.rpartition(":")
     if not host or not port:
         raise SystemExit(f"--listen must be HOST:PORT, got {args.listen!r}")
+    bounds = _parse_autoscale(args.autoscale)
+    if bounds is not None:
+        # --replicas is the STARTING size, clamped into the bounds
+        args.replicas = min(max(args.replicas, bounds[0]), bounds[1])
     rs = _build_plane(args, telem)
     with _warmup_profile(args) as capture_dir:
         compiled = rs.start()
         _write_warmup_costs(capture_dir, _first_engine(rs))
+    autoscaler = None
+    if bounds is not None:
+        from mgproto_tpu.serving.autoscale import (
+            Autoscaler,
+            AutoscalerConfig,
+        )
+
+        autoscaler = Autoscaler(
+            rs,
+            AutoscalerConfig(
+                min_replicas=bounds[0],
+                max_replicas=bounds[1],
+                interval_s=args.autoscale_interval_s,
+            ),
+        )
     frontend = Frontend(
         rs,
         host=host,
@@ -704,6 +830,7 @@ def _main_listen(args, handler, telem) -> None:
         preemption_handler=handler,
         swap_factory_builder=lambda path: _swap_factory(args, path),
         require_calibrated_swap=not args.allow_uncalibrated,
+        autoscaler=autoscaler,
     )
 
     async def _run():
@@ -713,6 +840,7 @@ def _main_listen(args, handler, telem) -> None:
             "host": host,
             "port": frontend.port,
             "replicas": args.replicas,
+            "autoscale": args.autoscale or None,
             "buckets": _parse_buckets(args.buckets),
             "warmup_compiles": compiled,
         }), flush=True)

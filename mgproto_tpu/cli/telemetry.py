@@ -283,6 +283,36 @@ def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
     return section
 
 
+def _autoscale_section(last: Dict) -> Optional[Dict[str, Any]]:
+    """Elastic-serving story (ISSUE 13): autoscaler decisions + the AOT
+    executable cache's hit/miss/reject ledger. Present whenever the
+    serving family is (pre-registered — explicit zeros mean "fixed fleet,
+    cold compiles", which an operator should see, not infer); None only
+    for telemetry dirs that never served."""
+    from mgproto_tpu.serving import metrics as sm  # jax-free
+
+    names = (
+        sm.AUTOSCALE_TARGET, sm.AUTOSCALE_EVENTS,
+        sm.AOT_HITS, sm.AOT_MISSES, sm.AOT_REJECTS,
+    )
+    if not any(name in last for name in names):
+        return None
+    return {
+        "replicas_target": _series_value(last, sm.AUTOSCALE_TARGET),
+        "events_by_direction": _series_by_label(
+            last, sm.AUTOSCALE_EVENTS, "direction"
+        ),
+        "aot_hits": _series_value(last, sm.AOT_HITS),
+        "aot_misses": _series_value(last, sm.AOT_MISSES),
+        "aot_rejects_by_reason": _series_by_label(
+            last, sm.AOT_REJECTS, "reason"
+        ),
+        "aot_stores_by_result": _series_by_label(
+            last, sm.AOT_STORES, "result"
+        ),
+    }
+
+
 def _drift_section(last: Dict) -> Optional[Dict[str, Any]]:
     """Online-learning drift story (ISSUE 11): p(x) sketch divergence,
     per-class bank shift top-k, captures by outcome, consolidation +
@@ -442,6 +472,10 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     serving = _serving_section(last)
     if serving is not None:
         summary["serving"] = serving
+
+    autoscale = _autoscale_section(last)
+    if autoscale is not None:
+        summary["autoscale"] = autoscale
 
     drift = _drift_section(last)
     if drift is not None:
@@ -624,6 +658,14 @@ def render_table(summary: Dict[str, Any]) -> str:
     if "drift" in summary:
         section("drift (online learning)")
         for k, v in summary["drift"].items():
+            if isinstance(v, dict):
+                v = " ".join(
+                    f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())
+                ) or "-"
+            rows.append((k, v))
+    if "autoscale" in summary:
+        section("autoscale (elastic serving + AOT cache)")
+        for k, v in summary["autoscale"].items():
             if isinstance(v, dict):
                 v = " ".join(
                     f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())
@@ -1014,6 +1056,98 @@ def drift_drill_gates(record: Dict[str, Any]) -> Dict[str, Any]:
             "failed": sum(not r["ok"] for r in rows), "rows": rows}
 
 
+def autoscale_gates(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Gate a committed autoscale load-test record (evidence/
+    autoscale_baseline.json) — the elastic-serving acceptance criteria
+    (ISSUE 13), re-derived from the record's RAW numbers:
+
+      * the ramp past min-fleet capacity triggered scale-OUT (>= 1 up
+        event, peak above the starting size, within [min, max]);
+      * scale-up warmups went through the AOT cache (every post-cold
+        warmup a hit, zero rejects) — cheap by construction, verified;
+      * p99 stayed in the flat band: every phase's p99 under the request
+        deadline, and the post-ramp calm phase within 1.5x of the
+        pre-ramp calm phase (the fleet scaled back down AND latency
+        recovered);
+      * shed rate stayed bounded through the overrun (<= 20% in the
+        storm phase, zero in the calm phases);
+      * scale-DOWN followed the ramp (a down event after the last up,
+        final size back at min) with ZERO dropped requests and zero
+        steady-state recompiles."""
+    rows: List[Dict[str, Any]] = []
+
+    def gate(key: str, ok: bool, why: str = "") -> None:
+        rows.append({"key": key, "ok": bool(ok),
+                     "why": "" if ok else why, "baseline": None,
+                     "value": None, "direction": "autoscale"})
+
+    a = record.get("autoscale") or {}
+    gate("autoscale.record", bool(a),
+         "record has no 'autoscale' section — not an autoscale drill")
+    events = a.get("events") or []
+    ups = [e for e in events if e.get("direction") == "up"]
+    downs = [e for e in events if e.get("direction") == "down"]
+    start = a.get("start_replicas") or 0
+    peak = a.get("replicas_peak") or 0
+    gate("autoscale.scaled_out",
+         len(ups) >= 1 and peak > start,
+         f"ups={len(ups)} peak={peak} start={start}")
+    gate("autoscale.bounded",
+         (a.get("min") or 0) <= (a.get("replicas_final") or 0)
+         and peak <= (a.get("max") or 0),
+         f"final={a.get('replicas_final')} peak={peak} "
+         f"bounds=[{a.get('min')},{a.get('max')}]")
+    last_up_t = max((e.get("t") or 0 for e in ups), default=None)
+    gate("autoscale.scaled_down_after_ramp",
+         len(downs) >= 1 and last_up_t is not None
+         and all((e.get("t") or 0) > last_up_t for e in downs)
+         and a.get("replicas_final") == a.get("min"),
+         f"downs={len(downs)} final={a.get('replicas_final')} "
+         f"min={a.get('min')}")
+    aot = a.get("aot") or {}
+    nb = len((record.get("config") or {}).get("buckets") or [])
+    gate("autoscale.scale_up_via_cache",
+         not aot.get("rejects")
+         and (aot.get("hits") or 0) >= len(ups) * nb > 0,
+         f"hits={aot.get('hits')} expected>={len(ups) * nb} "
+         f"rejects={aot.get('rejects')}")
+    overall = record.get("overall") or {}
+    gate("autoscale.zero_dropped", overall.get("zero_dropped") is True,
+         "storm dropped requests")
+    gate("autoscale.zero_steady_recompiles",
+         record.get("steady_state_recompiles") == 0,
+         f"recompiled in steady state: "
+         f"{record.get('steady_state_recompiles')}")
+    phases = record.get("phases") or []
+    deadline_ms = (record.get("config") or {}).get("deadline_ms")
+    if len(phases) >= 3 and isinstance(deadline_ms, (int, float)):
+        rps = [p.get("rps") or 0 for p in phases]
+        storm_i = rps.index(max(rps))
+        storm = phases[storm_i]
+        calm_before, calm_after = phases[0], phases[-1]
+        p99s = [p.get("p99_ms") for p in phases]
+        gate("autoscale.p99_under_deadline",
+             all(isinstance(v, (int, float)) and v <= deadline_ms
+                 for v in p99s),
+             f"phase p99s {p99s} vs deadline {deadline_ms}")
+        b, after = calm_before.get("p99_ms"), calm_after.get("p99_ms")
+        gate("autoscale.p99_recovered",
+             isinstance(b, (int, float)) and isinstance(after, (int, float))
+             and after <= 1.5 * b,
+             f"calm-after p99 {after} vs 1.5x calm-before {b}")
+        gate("autoscale.shed_bounded",
+             (storm.get("shed_rate") or 0) <= 0.20
+             and (calm_before.get("shed_rate") or 0) == 0
+             and (calm_after.get("shed_rate") or 0) == 0,
+             f"storm shed {storm.get('shed_rate')}, calm "
+             f"{calm_before.get('shed_rate')}/{calm_after.get('shed_rate')}")
+    else:
+        gate("autoscale.phases_present", False,
+             "needs >= 3 phases (calm, storm, calm) and a deadline_ms")
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
 def stall_report_gates(
     record: Dict[str, Any],
     baseline: Optional[Dict[str, Any]] = None,
@@ -1204,6 +1338,12 @@ def check_main(argv: Optional[list] = None) -> int:
                         "correction, zero drops/recompiles, poison "
                         "rejection, accuracy dip+recovery — exit 1 on any "
                         "failure")
+    p.add_argument("--autoscale", default=None, metavar="FILE",
+                   help="gate a committed autoscale load-test record "
+                        "(e.g. evidence/autoscale_baseline.json): "
+                        "scale-out under the ramp, AOT-cached scale-up "
+                        "warmups, p99 flat band, bounded shed, zero-drop "
+                        "scale-down — exit 1 on any failure")
     p.add_argument("--stall-report", default=None, metavar="FILE",
                    help="gate a stall-budget report (scripts/"
                         "trace_report.py output): schema sanity, and with "
@@ -1246,45 +1386,44 @@ def check_main(argv: Optional[list] = None) -> int:
         else:
             print(json.dumps(json_suites, indent=2))
 
-    stall_ok = True
-    if args.stall_report:
-        def _read_json(path):
-            try:
-                with open(path) as f:
-                    return json.load(f)
-            except (OSError, ValueError) as e:
-                raise SystemExit(f"cannot read stall report {path}: {e}")
+    def _read_json(path, what):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"cannot read {what} {path}: {e}")
 
-        record = _read_json(args.stall_report)
+    suites_ok = True
+    any_suite = False
+    if args.stall_report:
+        any_suite = True
+        record = _read_json(args.stall_report, "stall report")
         baseline_rep = (
-            _read_json(args.stall_baseline) if args.stall_baseline else None
+            _read_json(args.stall_baseline, "stall baseline")
+            if args.stall_baseline else None
         )
         result = stall_report_gates(record, baseline_rep)
         _emit_suite("stall_report", result)
-        if args.dir is None and not args.drift_drill:
-            _flush_json()
-            return 0 if result["ok"] else 1
-        stall_ok = result["ok"]
+        suites_ok = suites_ok and result["ok"]
     if args.drift_drill:
-        try:
-            with open(args.drift_drill) as f:
-                record = json.load(f)
-        except (OSError, ValueError) as e:
-            raise SystemExit(
-                f"cannot read drift-drill record {args.drift_drill}: {e}"
-            )
+        any_suite = True
+        record = _read_json(args.drift_drill, "drift-drill record")
         result = drift_drill_gates(record)
         _emit_suite("drift_drill", result)
-        if args.dir is None:
-            _flush_json()
-            return 0 if result["ok"] and stall_ok else 1
-        drill_ok = result["ok"]
-    else:
-        drill_ok = True
+        suites_ok = suites_ok and result["ok"]
+    if args.autoscale:
+        any_suite = True
+        record = _read_json(args.autoscale, "autoscale record")
+        result = autoscale_gates(record)
+        _emit_suite("autoscale", result)
+        suites_ok = suites_ok and result["ok"]
+    if args.dir is None and any_suite:
+        _flush_json()
+        return 0 if suites_ok else 1
     if args.dir is None or args.baseline is None:
         raise SystemExit(
             "check needs a telemetry dir AND --baseline (or --drift-drill "
-            "/ --stall-report FILE alone)"
+            "/ --stall-report / --autoscale FILE alone)"
         )
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")
@@ -1320,7 +1459,7 @@ def check_main(argv: Optional[list] = None) -> int:
         # already ran (--stall-report / --drift-drill) still decides the
         # exit code — and its deferred --json output still flushes
         _flush_json()
-        return 0 if stall_ok and drill_ok else 1
+        return 0 if suites_ok else 1
     try:
         with open(args.baseline) as f:
             baseline = json.load(f)
@@ -1344,7 +1483,7 @@ def check_main(argv: Optional[list] = None) -> int:
                   f"base={_fmt(r['baseline'])} new={_fmt(r['value'])}"
                   f"{detail}")
         print(f"{result['checked']} checked, {result['failed']} failed")
-    return 0 if result["ok"] and drill_ok and stall_ok else 1
+    return 0 if result["ok"] and suites_ok else 1
 
 
 def main(argv: Optional[list] = None) -> Optional[int]:

@@ -32,7 +32,7 @@ import dataclasses
 import json
 import os
 import zipfile
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -137,6 +137,68 @@ def load_exported(path: str) -> Tuple[Any, Dict[str, Any]]:
         exported = jax_export.deserialize(z.read(_BLOB_NAME))
         meta = json.loads(z.read(_META_NAME))
     return exported, meta
+
+
+def artifact_aot_fingerprint(path: str) -> str:
+    """The artifact face's AOT-cache program fingerprint: sha256 of the
+    `.mgproto` file + the mixture fingerprint from its meta. The ONE
+    formula `export_aot_cache`, `ServingEngine.from_artifact` and the
+    serve CLI share — any re-export changes the file hash, so stale
+    executables miss instead of serving."""
+    from mgproto_tpu.serving.aotcache import file_fingerprint
+
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read(_META_NAME))
+    return file_fingerprint(path) + ":" + (meta.get("gmm_fingerprint") or "")
+
+
+def export_aot_cache(
+    path: str,
+    buckets: Sequence[int] = (1, 2, 4, 8),
+    cache_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Prebuild the AOT executable cache for an exported artifact: compile
+    the artifact's program at every serving bucket on THIS machine and
+    serialize each executable into the sidecar cache (serving/aotcache.py;
+    default `<path>.aotcache/`). A replica starting on hardware matching
+    this machine's (device kind, topology, jax/jaxlib) then warms every
+    bucket with ZERO compiles — the mmap-and-go cold start. Other hardware
+    simply misses (the key carries the environment) and compiles normally,
+    lazily repopulating its own entries.
+
+    Returns a summary dict: per-bucket store outcome + the cache key's
+    environment half (`mgproto-export --aot-cache` prints it)."""
+    from mgproto_tpu.serving.aotcache import (
+        ExecutableCache,
+        default_cache_dir,
+        environment_fingerprint,
+    )
+
+    exported, meta = load_exported(path)
+    cache = ExecutableCache(cache_dir or default_cache_dir(path))
+    fingerprint = artifact_aot_fingerprint(path)
+    policy = meta.get("precision_policy") or {}
+    dtype = policy.get("compute_dtype") or meta.get("compute_dtype") or ""
+    img = int(meta["img_size"])
+    if not meta.get("dynamic_batch", True):
+        static = meta.get("static_batch") or int(
+            exported.in_avals[0].shape[0]
+        )
+        buckets = (int(static),)
+    jit_call = jax.jit(exported.call)
+    stored: Dict[str, bool] = {}
+    for b in sorted(set(int(x) for x in buckets)):
+        spec = jax.ShapeDtypeStruct((b, img, img, 3), jnp.float32)
+        compiled = jit_call.lower(spec).compile()
+        key = cache.key(fingerprint, (b, img, img, 3), dtype)
+        stored[f"b{b}"] = cache.store(key, compiled)
+    return {
+        "cache_dir": cache.cache_dir,
+        "program_fingerprint": fingerprint,
+        "compute_dtype": dtype,
+        "stored": stored,
+        "environment": environment_fingerprint(),
+    }
 
 
 def load_artifact(path: str) -> Tuple[Callable, Dict[str, Any]]:

@@ -1,0 +1,291 @@
+"""AOT executable cache: mmap-and-go cold start for serving replicas.
+
+The serving plane (PR 7) proves zero *steady-state* recompiles, but every
+replica start and blue/green swap still pays compile-everything warmup —
+the largest latency cliff between "2 replicas on one host" and elastic
+scale-out. Following the whole-program-compilation line of "Automatic Full
+Compilation ... to Cloud TPUs" and the portable O(1) inference-caching
+argument (PAPERS.md): compile once, SERIALIZE the executable, and make
+every subsequent start a deserialization, not a compilation.
+
+Each warmed bucket's compiled program (`jit(infer).lower(shape).compile()`)
+is serialized via `jax.experimental.serialize_executable` into a
+content-addressed entry keyed by everything that makes a compiled binary
+valid to reuse:
+
+    (program fingerprint, bucket shape, compute dtype,
+     device kind, topology (platform + device count),
+     jax version, jaxlib version)
+
+Any change to any component changes the digest, so a stale executable is
+simply ABSENT (a miss → normal compile), never served. The entry file
+additionally embeds its full key and a payload checksum: a digest-named
+file whose header disagrees with the requested key (collision, tampering,
+truncation) or whose payload fails its checksum / deserialization is a
+counted REJECT and the engine falls back to compiling — fail-safe by
+construction, a wrong or corrupt cache can only cost time, never serve a
+wrong program.
+
+Counters (serving/metrics.py, pre-registered):
+
+    serving_aot_hit_total      warmups served from the cache (zero compiles)
+    serving_aot_miss_total     key absent → normal compile (+ lazy store)
+    serving_aot_reject_total   entry present but unusable, by reason
+    serving_aot_store_total    store attempts by result (ok/unsupported/error)
+
+The cache directory conventionally sits beside the `.mgproto` artifact
+(`<artifact>.aotcache/` — see `default_cache_dir`) or wherever the operator
+points `mgproto-serve --aot-cache`. Entries are written atomically
+(tmp+rename, the checkpoint discipline), so concurrent replicas racing the
+same key at worst both compile and one rename wins.
+
+IMPORTANT key semantics: `program_fingerprint` must identify the FULL
+program — weights included. The artifact face hashes the `.mgproto` file
+itself (engine/export.py combines it with the gmm fingerprint); live-state
+faces that only pass the gmm fingerprint must own the lifecycle of their
+cache dir (the drill/bench pattern: a fresh dir per state).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from mgproto_tpu.serving import metrics as _m
+
+_MAGIC = b"MGAOTX1\n"
+_SUFFIX = ".aotx"
+
+REJECT_KEY_MISMATCH = "key_mismatch"
+REJECT_CORRUPT = "corrupt"
+REJECT_DESERIALIZE = "deserialize"
+REJECT_EXECUTE = "execute"
+
+STORE_OK = "ok"
+STORE_UNSUPPORTED = "unsupported"
+STORE_ERROR = "error"
+
+
+def environment_fingerprint() -> Dict[str, Any]:
+    """The executable-validity half of the key: a compiled binary is only
+    reusable on the same accelerator kind, the same local topology, and
+    the same jax/jaxlib (which pins the XLA that produced it)."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "")
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = ""
+    devices = jax.devices()
+    return {
+        "device_kind": devices[0].device_kind if devices else "",
+        "platform": jax.default_backend(),
+        "device_count": len(devices),
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+    }
+
+
+def cache_key(
+    program_fingerprint: str,
+    bucket_shape: Sequence[int],
+    compute_dtype: str,
+    env: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The full cache key as a flat JSON-able dict. `env` is injectable so
+    tests can simulate a jax upgrade / device change without one."""
+    key = {
+        "format": "mgproto-aotx-v1",
+        "program_fingerprint": str(program_fingerprint or ""),
+        "bucket_shape": [int(d) for d in bucket_shape],
+        "compute_dtype": str(compute_dtype or ""),
+    }
+    key.update(env if env is not None else environment_fingerprint())
+    return key
+
+
+def key_digest(key: Dict[str, Any]) -> str:
+    blob = json.dumps(key, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def default_cache_dir(artifact_path: str) -> str:
+    """Sidecar convention: the cache lives next to the artifact it caches."""
+    return artifact_path + ".aotcache"
+
+
+def file_fingerprint(path: str) -> str:
+    """sha256 of a file — the artifact face's program fingerprint (weights
+    and program identity in one hash; any re-export invalidates)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class ExecutableCache:
+    """Content-addressed store of serialized compiled executables.
+
+    `load` returns a ready-to-call `jax.stages.Compiled` (or None on any
+    miss/reject — the caller compiles); `store` serializes one. All
+    failure modes are counted, none raise into the serving path.
+    """
+
+    def __init__(
+        self, cache_dir: str, env: Optional[Dict[str, Any]] = None
+    ):
+        self.cache_dir = str(cache_dir)
+        self._env = env  # None = the real environment, resolved per key
+
+    # ------------------------------------------------------------------- keys
+    def key(
+        self,
+        program_fingerprint: str,
+        bucket_shape: Sequence[int],
+        compute_dtype: str,
+    ) -> Dict[str, Any]:
+        return cache_key(
+            program_fingerprint, bucket_shape, compute_dtype, env=self._env
+        )
+
+    def path_for(self, key: Dict[str, Any]) -> str:
+        return os.path.join(self.cache_dir, key_digest(key) + _SUFFIX)
+
+    # ------------------------------------------------------------------- load
+    def load(self, key: Dict[str, Any]):
+        """The deserialized executable for `key`, or None (counted as a
+        miss when the entry is absent, a reject when present-but-unusable).
+        Never raises.
+
+        NOTE: deserializing is not yet serving — the HIT is counted by
+        `note_hit()`, which the engine calls only after the executable
+        passes its verification run. An entry that deserializes but fails
+        verification is a `reject_loaded()` (and a compile), never a hit:
+        the hit counter's meaning stays 'warmed with zero compiles'."""
+        path = self.path_for(key)
+        if not os.path.isfile(path):
+            _m.counter(_m.AOT_MISSES).inc()
+            return None
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            header, blob = self._parse(raw)
+        except Exception:
+            self._reject(REJECT_CORRUPT, path)
+            return None
+        if header.get("key") != key:
+            # a digest-named entry whose embedded key disagrees with the
+            # requested one: collision or tampering — never trust it
+            self._reject(REJECT_KEY_MISMATCH, path)
+            return None
+        if hashlib.sha256(blob).hexdigest() != header.get("payload_sha256"):
+            self._reject(REJECT_CORRUPT, path)
+            return None
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = pickle.loads(blob)
+            compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:
+            self._reject(REJECT_DESERIALIZE, path)
+            return None
+        return compiled
+
+    def note_hit(self) -> None:
+        """Count one verified cache hit (see `load`)."""
+        _m.counter(_m.AOT_HITS).inc()
+
+    def reject_loaded(self, reason: str = REJECT_EXECUTE) -> None:
+        """Count a post-load rejection (a deserialized executable that
+        failed its verification run) — the engine's half of fail-safe."""
+        _m.counter(_m.AOT_REJECTS).inc(reason=reason)
+
+    @staticmethod
+    def _reject(reason: str, path: str) -> None:
+        _m.counter(_m.AOT_REJECTS).inc(reason=reason)
+
+    # ------------------------------------------------------------------ store
+    def store(self, key: Dict[str, Any], compiled) -> bool:
+        """Serialize `compiled` under `key` (atomic tmp+rename). Returns
+        True on success; failures are counted, never raised (a backend
+        that cannot serialize still serves — it just stays cold)."""
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+        except Exception as e:
+            result = (
+                STORE_UNSUPPORTED
+                if isinstance(e, ValueError) else STORE_ERROR
+            )
+            _m.counter(_m.AOT_STORES).inc(result=result)
+            return False
+        header = {
+            "key": key,
+            "payload_sha256": hashlib.sha256(blob).hexdigest(),
+            "payload_bytes": len(blob),
+        }
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            head = json.dumps(header, sort_keys=True).encode()
+            fd, tmp = tempfile.mkstemp(
+                dir=self.cache_dir, suffix=_SUFFIX + ".tmp"
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(_MAGIC)
+                    f.write(len(head).to_bytes(8, "big"))
+                    f.write(head)
+                    f.write(blob)
+                os.replace(tmp, self.path_for(key))
+            finally:
+                if os.path.exists(tmp):  # replace failed; don't leak tmp
+                    os.unlink(tmp)
+        except OSError:
+            _m.counter(_m.AOT_STORES).inc(result=STORE_ERROR)
+            return False
+        _m.counter(_m.AOT_STORES).inc(result=STORE_OK)
+        return True
+
+    # -------------------------------------------------------------- inventory
+    def entries(self) -> Dict[str, Dict[str, Any]]:
+        """{digest: header} of every parseable entry (operator surface:
+        the README runbook's `python -c` one-liner and the tests)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        try:
+            names = sorted(os.listdir(self.cache_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            path = os.path.join(self.cache_dir, name)
+            try:
+                with open(path, "rb") as f:
+                    header, _ = self._parse(f.read())
+                out[name[: -len(_SUFFIX)]] = header
+            except Exception:
+                out[name[: -len(_SUFFIX)]] = {"unparseable": True}
+        return out
+
+    # -------------------------------------------------------------- internals
+    @staticmethod
+    def _parse(raw: bytes) -> Tuple[Dict[str, Any], bytes]:
+        if raw[: len(_MAGIC)] != _MAGIC:
+            raise ValueError("bad magic")
+        off = len(_MAGIC)
+        head_len = int.from_bytes(raw[off:off + 8], "big")
+        off += 8
+        header = json.loads(raw[off:off + head_len])
+        blob = raw[off + head_len:]
+        if len(blob) != int(header.get("payload_bytes", -1)):
+            raise ValueError("truncated payload")
+        return header, blob

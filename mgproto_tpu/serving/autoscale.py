@@ -1,0 +1,398 @@
+"""Observatory-driven autoscaler: grow/shrink the ReplicaSet under load.
+
+The elastic half of ISSUE 13 (ROADMAP item 3): the serving plane already
+EMITS everything a scaling decision needs — queue depth, shed counts,
+per-request latency histograms, batch-fill fractions — into the telemetry
+registry (PR 7/8). This module closes the loop: a pump-hook control policy
+on the plane's injectable clock (no sleeps, no threads — the lint applies)
+reads those signals over a sliding decision window and steers the replica
+count within `[min_replicas, max_replicas]`:
+
+  * SCALE UP when the fleet is saturated — queue depth per ready replica,
+    windowed shed rate, or windowed p99 over their thresholds. The new
+    replica is added through `ReplicaSet.add_replica()` (a due-now backoff
+    entry: the next supervisor poll builds + warms it OFF any request's
+    critical path), and warmup is cheap BY CONSTRUCTION when the engine
+    factory carries an AOT executable cache (serving/aotcache.py): a
+    scale-up is a deserialize, not a compile storm.
+  * SCALE DOWN when the fleet has been calm for `down_patience`
+    consecutive evaluations — near-empty queues, zero window sheds, thin
+    batches. The victim drains through `ReplicaSet.remove_replica()`:
+    queued requests transfer to survivors via the same `drain_all/restore`
+    path a heartbeat failure uses, with zero dropped requests.
+
+Every applied decision is counted (`autoscale_events_total{direction=}`),
+steers the `autoscale_replicas_target` gauge, and lands on the flight
+recorder WITH the triggering signal snapshot — a scale event in a
+post-mortem always answers "what did the plane look like when you did
+that?".
+
+Windowed signals are COUNTER/HISTOGRAM DELTAS between evaluations (the
+registry is cumulative): p99 comes from diffing the request-latency
+histogram's bucket counts, so the decision sees the last window's tail,
+not the run's whole history.
+
+Per-replica bucket right-sizing: `hbm_bucket_prep` wraps the PR-6 HBM
+planner (`perf/planner.plan_serve_buckets`) into a `ReplicaSet`
+`engine_prep` hook — every engine a scale-up (or restart) builds gets its
+warmup bucket ladder shrunk to ITS device's budget before anything
+compiles, so heterogeneous hardware (v5e/v5p/GPU/CPU dev boxes) joins the
+fleet with heterogeneous ladders instead of OOMing on a uniform one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+from mgproto_tpu.obs.flightrec import record_event
+from mgproto_tpu.serving import metrics as _m
+from mgproto_tpu.serving.response import ServeResponse
+from mgproto_tpu.telemetry.registry import (
+    default_registry,
+    percentile_from_buckets,
+)
+
+DIRECTION_UP = "up"
+DIRECTION_DOWN = "down"
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Thresholds + pacing. Scale-up triggers are OR-ed (any saturation
+    signal suffices); scale-down needs EVERY calm condition for
+    `down_patience` consecutive evaluations (shrinking on a noisy window
+    would thrash the fleet — the republisher's confirmation discipline)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    interval_s: float = 0.25  # decision cadence on the injected clock
+    # -- scale-up saturation thresholds --
+    up_queue_per_replica: float = 6.0  # queued requests per ready replica
+    up_shed_rate: float = 0.02  # window sheds / window requests
+    up_p99_s: float = 0.0  # windowed request p99 (0 = signal disabled)
+    # -- scale-down calm thresholds --
+    down_queue_per_replica: float = 1.0
+    # windowed capacity utilization = window requests / (window dispatches
+    # x largest bucket). NOT the batch-fill histogram: pad-to-smallest-
+    # bucket makes per-dispatch fill ~1.0 by construction even at trickle
+    # traffic — utilization against the LARGEST bucket is what actually
+    # distinguishes a saturated fleet from an idle one
+    down_utilization: float = 0.5
+    down_patience: int = 3  # consecutive calm evaluations before shrink
+    # -- pacing --
+    up_cooldown_s: float = 0.5  # min spacing between scale-ups
+    down_cooldown_s: float = 1.0  # min spacing between scale-downs
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    """One applied decision (tick returns None when nothing changed)."""
+
+    t: float
+    direction: str
+    reason: str
+    replicas_before: int
+    replicas_after: int
+    signals: Dict[str, Any]
+    responses: List[ServeResponse] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "t": round(self.t, 6),
+            "direction": self.direction,
+            "reason": self.reason,
+            "replicas_before": self.replicas_before,
+            "replicas_after": self.replicas_after,
+            "signals": self.signals,
+        }
+
+
+def _merged_hist(snapshot: Dict, name: str) -> Optional[Dict[str, Any]]:
+    """One cumulative histogram series merged across label sets."""
+    m = snapshot.get(name)
+    if not m or m.get("type") != "histogram":
+        return None
+    merged: Optional[Dict[str, Any]] = None
+    for s in m.get("series", []):
+        if merged is None:
+            merged = {
+                "bounds": list(s["bounds"]),
+                "bucket_counts": list(s["bucket_counts"]),
+                "count": s["count"],
+                "sum": s["sum"],
+            }
+        else:
+            merged["bucket_counts"] = [
+                a + b
+                for a, b in zip(merged["bucket_counts"], s["bucket_counts"])
+            ]
+            merged["count"] += s["count"]
+            merged["sum"] += s["sum"]
+    return merged
+
+
+def _counter_total(snapshot: Dict, name: str) -> float:
+    m = snapshot.get(name) or {}
+    return sum(
+        s.get("value") or 0.0 for s in m.get("series", [])
+    )
+
+
+def _hist_delta(
+    cur: Optional[Dict[str, Any]], prev: Optional[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """cur - prev as a bucket dict (None when cur is absent/empty)."""
+    if cur is None:
+        return None
+    if prev is None:
+        return dict(cur)
+    return {
+        "bounds": cur["bounds"],
+        "bucket_counts": [
+            a - b
+            for a, b in zip(cur["bucket_counts"], prev["bucket_counts"])
+        ],
+        "count": cur["count"] - prev["count"],
+        "sum": cur["sum"] - prev["sum"],
+    }
+
+
+class Autoscaler:
+    """`tick(now)` is the whole interface: call it from the pump that
+    drives `ReplicaSet.poll()` (the HTTP frontend's executor step, the
+    batch drivers' `on_pump`, the load harness's loop). Returns the
+    applied `ScaleDecision` — whose `responses` the caller must surface,
+    they are real typed answers from a scale-down drain — or None."""
+
+    def __init__(
+        self,
+        replica_set,
+        config: Optional[AutoscalerConfig] = None,
+        clock: Optional[Callable[[], float]] = None,
+        registry=None,
+    ):
+        self.rs = replica_set
+        self.config = config if config is not None else AutoscalerConfig()
+        if self.config.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.config.max_replicas < self.config.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.clock = clock if clock is not None else replica_set.clock
+        self._registry = registry
+        self._last_eval: Optional[float] = None
+        self._last_up = -math.inf
+        self._last_down = -math.inf
+        self._calm_streak = 0
+        self._prev: Dict[str, Any] = {}
+        self.decisions: List[ScaleDecision] = []
+        _m.gauge(_m.AUTOSCALE_TARGET).set(float(len(self.rs.replicas)))
+
+    @property
+    def registry(self):
+        return (
+            self._registry if self._registry is not None
+            else default_registry()
+        )
+
+    # ---------------------------------------------------------------- signals
+    def _signals(self, now: float) -> Dict[str, Any]:
+        """One decision window's view of the observatory: counter and
+        histogram DELTAS since the previous evaluation + instantaneous
+        fleet state."""
+        snapshot = self.registry.snapshot()
+        ready = len(self.rs.ready_replicas())
+        total = len(self.rs.replicas)
+        depth = sum(
+            len(rep.engine.queue)
+            for rep in self.rs.replicas
+            if rep.engine is not None
+        )
+        requests = _counter_total(snapshot, _m.REQUESTS)
+        sheds = _counter_total(snapshot, _m.SHED)
+        lat = _merged_hist(snapshot, _m.REQUEST_SECONDS)
+        fill = _merged_hist(snapshot, _m.BATCH_FILL_HIST)
+        w_requests = requests - self._prev.get("requests", 0.0)
+        w_sheds = sheds - self._prev.get("sheds", 0.0)
+        w_lat = _hist_delta(lat, self._prev.get("lat"))
+        w_fill = _hist_delta(fill, self._prev.get("fill"))
+        self._prev = {
+            "requests": requests, "sheds": sheds, "lat": lat, "fill": fill,
+        }
+        p99 = None
+        if w_lat and w_lat["count"] > 0:
+            p99 = percentile_from_buckets(w_lat, 99.0)
+        fill_mean = None
+        w_dispatches = None
+        if w_fill and w_fill["count"] > 0:
+            fill_mean = w_fill["sum"] / w_fill["count"]
+            w_dispatches = w_fill["count"]
+        max_bucket = max(
+            (rep.engine.buckets[-1]
+             for rep in self.rs.replicas if rep.engine is not None),
+            default=0,
+        )
+        utilization = None
+        if w_dispatches and max_bucket:
+            utilization = w_requests / (w_dispatches * max_bucket)
+        return {
+            "t": round(now, 6),
+            "replicas": total,
+            "replicas_ready": ready,
+            "queue_depth": depth,
+            "queue_per_replica": depth / max(ready, 1),
+            "window_requests": w_requests,
+            "window_sheds": w_sheds,
+            "shed_rate": (w_sheds / w_requests) if w_requests > 0 else 0.0,
+            "window_p99_s": p99,
+            "window_batch_fill": fill_mean,
+            "window_dispatches": w_dispatches,
+            "window_utilization": utilization,
+        }
+
+    # --------------------------------------------------------------- decision
+    def _saturation_reason(self, sig: Dict[str, Any]) -> Optional[str]:
+        c = self.config
+        if sig["queue_per_replica"] >= c.up_queue_per_replica:
+            return "queue_depth"
+        if (
+            sig["window_requests"] > 0
+            and sig["shed_rate"] >= c.up_shed_rate
+        ):
+            return "shed_rate"
+        if (
+            c.up_p99_s > 0
+            and sig["window_p99_s"] is not None
+            and sig["window_p99_s"] >= c.up_p99_s
+        ):
+            return "p99"
+        return None
+
+    def _calm(self, sig: Dict[str, Any]) -> bool:
+        c = self.config
+        if sig["window_sheds"] > 0:
+            return False
+        if sig["queue_per_replica"] > c.down_queue_per_replica:
+            return False
+        util = sig["window_utilization"]
+        if util is not None and util > c.down_utilization:
+            return False
+        return True
+
+    def tick(self, now: Optional[float] = None) -> Optional[ScaleDecision]:
+        """Evaluate on cadence; apply at most one scale step. Consumes
+        ZERO time itself (clock injectable; nothing blocks — the lint
+        covers this module)."""
+        now = self.clock() if now is None else now
+        c = self.config
+        if (
+            self._last_eval is not None
+            and now - self._last_eval < c.interval_s
+        ):
+            return None
+        self._last_eval = now
+        sig = self._signals(now)
+        before = len(self.rs.replicas)
+        reason = self._saturation_reason(sig)
+        if (
+            reason is not None
+            and before < c.max_replicas
+            and now - self._last_up >= c.up_cooldown_s
+        ):
+            self._calm_streak = 0
+            self._last_up = now
+            self.rs.add_replica()
+            return self._applied(
+                now, DIRECTION_UP, reason, before, sig, []
+            )
+        if reason is not None:
+            # saturated but cannot grow (at max / cooling down): saturation
+            # still resets the calm streak so a shrink cannot follow
+            self._calm_streak = 0
+            return None
+        if not self._calm(sig):
+            self._calm_streak = 0
+            return None
+        self._calm_streak += 1
+        if (
+            self._calm_streak >= c.down_patience
+            and before > c.min_replicas
+            and now - self._last_down >= c.down_cooldown_s
+        ):
+            self._calm_streak = 0
+            self._last_down = now
+            responses = self.rs.remove_replica()
+            return self._applied(
+                now, DIRECTION_DOWN, "calm", before, sig, responses
+            )
+        return None
+
+    def _applied(
+        self,
+        now: float,
+        direction: str,
+        reason: str,
+        before: int,
+        sig: Dict[str, Any],
+        responses: List[ServeResponse],
+    ) -> ScaleDecision:
+        after = len(self.rs.replicas)
+        _m.counter(_m.AUTOSCALE_EVENTS).inc(direction=direction)
+        _m.gauge(_m.AUTOSCALE_TARGET).set(float(after))
+        record_event(
+            f"autoscale_{direction}", reason=reason,
+            replicas_before=before, replicas_after=after,
+            **{k: v for k, v in sig.items() if k != "t"},
+        )
+        decision = ScaleDecision(
+            t=now, direction=direction, reason=reason,
+            replicas_before=before, replicas_after=after,
+            signals=sig, responses=responses,
+        )
+        self.decisions.append(decision)
+        return decision
+
+    # ----------------------------------------------------------------- status
+    def status(self) -> Dict[str, Any]:
+        """Operator view (the frontend's GET /admin/autoscale)."""
+        return {
+            "min_replicas": self.config.min_replicas,
+            "max_replicas": self.config.max_replicas,
+            "replicas": len(self.rs.replicas),
+            "replicas_ready": len(self.rs.ready_replicas()),
+            "calm_streak": self._calm_streak,
+            "decisions": len(self.decisions),
+            "last_decision": (
+                self.decisions[-1].to_dict() if self.decisions else None
+            ),
+        }
+
+
+def hbm_bucket_prep(
+    budget_bytes: Optional[int] = None,
+    margin: Optional[float] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> Callable[[Any], None]:
+    """An `engine_prep` hook (ReplicaSet) that right-sizes EVERY new
+    engine's bucket ladder to its device's HBM budget via the PR-6 planner
+    (`perf/planner.plan_serve_buckets`) before warmup compiles anything.
+    Fail-closed like `mgproto-serve --auto_tune`: zero fitting buckets
+    raises, sending the replica to backoff instead of warming a predicted
+    OOM."""
+
+    def prep(engine) -> None:
+        from mgproto_tpu.perf.planner import plan_serve_buckets
+
+        fitting, outcome = plan_serve_buckets(
+            engine, budget_bytes=budget_bytes, margin=margin, log=log
+        )
+        if not fitting:
+            raise RuntimeError(
+                "hbm_bucket_prep: no warmup bucket fits the HBM budget "
+                f"({outcome.budget_bytes} bytes, margin {outcome.margin})"
+            )
+        if tuple(fitting) != engine.buckets:
+            engine.buckets = tuple(fitting)
+
+    return prep

@@ -46,12 +46,23 @@ TRIGGER_DRAIN = "drain"
 
 @dataclasses.dataclass(frozen=True)
 class BatcherConfig:
-    """Scheduling knobs (see module docstring for each trigger)."""
+    """Scheduling knobs (see module docstring for each trigger).
+
+    `device_busy_s > 0` models the device as OCCUPIED for that long after
+    each dispatch (on the injectable clock): `dispatch_due` holds further
+    batches until the window passes, so the queue reflects true backlog
+    instead of the host pump outrunning the device. This is what gives the
+    virtual-clock load harness real queueing dynamics per replica (N
+    replicas = N concurrent busy windows = N x capacity — the saturation
+    the autoscaler drill measures); production leaves it 0 (off — the
+    synchronous executor dispatch already paces the pump). `flush()`
+    ignores the window: a drain answers everything regardless."""
 
     cost_prior_s: float = 0.002  # dispatch-cost estimate before any sample
     cost_ema_alpha: float = 0.2  # weight of the newest measured dispatch
     slack_safety: float = 1.0  # dispatch when slack <= cost * safety
     max_linger_s: float = 0.02  # deadline-less requests wait at most this
+    device_busy_s: float = 0.0  # per-dispatch device occupancy model (off)
 
 
 class MicroBatcher:
@@ -74,6 +85,7 @@ class MicroBatcher:
         self.pre_dispatch = pre_dispatch
         self.dispatch_cost_s = float(self.config.cost_prior_s)
         self.dispatches = 0
+        self._busy_until = 0.0  # device-occupancy model (device_busy_s)
 
     # ---------------------------------------------------------------- triggers
     def dispatch_due(self) -> Optional[str]:
@@ -83,10 +95,12 @@ class MicroBatcher:
         depth = len(q)
         if depth == 0:
             return None
+        now = self.clock()
+        if now < self._busy_until:
+            return None  # device occupied: backlog builds, honestly
         if depth >= self.engine.buckets[-1]:
             return TRIGGER_BUCKET_FULL
         oldest = q.peek_oldest()
-        now = self.clock()
         if oldest.deadline is not None:
             slack = oldest.deadline - now
             if slack <= self.dispatch_cost_s * self.config.slack_safety:
@@ -148,6 +162,8 @@ class MicroBatcher:
         if dt > 0:  # a virtual clock that did not move leaves the prior
             a = self.config.cost_ema_alpha
             self.dispatch_cost_s = (1 - a) * self.dispatch_cost_s + a * dt
+        if self.config.device_busy_s > 0:
+            self._busy_until = self.clock() + self.config.device_busy_s
         return responses
 
     def _observe_depth(self) -> None:

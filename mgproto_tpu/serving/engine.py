@@ -94,6 +94,8 @@ class ServingEngine:
         breaker: Optional[CircuitBreaker] = None,
         clock: Callable[[], float] = time.monotonic,
         monitor: Optional[StepMonitor] = None,
+        aot_cache: Optional[Any] = None,
+        aot_fingerprint: Optional[str] = None,
     ):
         """`infer_fn` maps float32 images [b, H, W, 3] to
         {"logits": [b, C], "log_px": [b]} and is jit-wrapped here so the
@@ -132,6 +134,27 @@ class ServingEngine:
             phase="serve"
         )
         self.monitor.watch(self._jit)
+        # AOT executable cache (serving/aotcache.py): warmup consults it
+        # FIRST and a hit deserializes the bucket's compiled program with
+        # zero XLA compiles (mmap-and-go cold start). The key's program
+        # half defaults to the gmm fingerprint; callers with a stronger
+        # program identity (the artifact face hashes the .mgproto file)
+        # pass `aot_fingerprint` explicitly.
+        self.aot_cache = aot_cache
+        self.aot_fingerprint = str(
+            aot_fingerprint
+            if aot_fingerprint is not None
+            else (expected_fingerprint or "")
+        )
+        self.compute_dtype = str(expected_compute_dtype or "")
+        # per-bucket compiled executables: populated by warmup (cache hit
+        # or AOT compile); dispatch uses these, so the jit dispatch cache
+        # stays empty in steady state and the recompile detector's zero
+        # means literally zero compiles anywhere
+        self._exec: Dict[int, Any] = {}
+        # per-bucket warmup provenance: [{bucket, source, seconds}, ...]
+        # (source: "cache" = deserialized hit, "compile" = AOT compile)
+        self.warmup_report: List[Dict[str, Any]] = []
         self.warmed_up = False
         # readiness veto during a graceful drain or a blue/green flip: the
         # engine still ANSWERS (drains) but must not be routed new traffic
@@ -197,6 +220,15 @@ class ServingEngine:
         # otherwise — a calibration stamped with a DIFFERENT dtype fails
         # closed in the gate, exactly like a fingerprint mismatch
         policy = meta.get("precision_policy") or {}
+        if kw.get("aot_cache") is not None and "aot_fingerprint" not in kw:
+            # the artifact face's program identity is the FILE (weights and
+            # program in one hash): a re-export — even with an unchanged
+            # gmm fingerprint — misses the cache instead of serving a
+            # stale executable. Factories that build many engines hoist
+            # this (cli/serve computes it once and passes it explicitly).
+            from mgproto_tpu.engine.export import artifact_aot_fingerprint
+
+            kw["aot_fingerprint"] = artifact_aot_fingerprint(path)
         return cls(
             exported.call,
             img_size=int(meta["img_size"]),
@@ -210,18 +242,71 @@ class ServingEngine:
         )
 
     # ----------------------------------------------------------------- warmup
+    def _aot_key(self, bucket: int) -> Dict[str, Any]:
+        return self.aot_cache.key(
+            self.aot_fingerprint,
+            (bucket, self.img_size, self.img_size, 3),
+            self.compute_dtype,
+        )
+
     def warmup(self) -> int:
-        """Compile every bucket shape ahead of traffic; returns the number
-        of compiled variants. After this, any recompile the monitor sees in
-        steady state is a bug (the tier-1 chaos test asserts zero)."""
+        """Ready every bucket shape ahead of traffic; returns the number
+        of XLA compiles performed. With an AOT cache (serving/aotcache.py)
+        each bucket is CONSULTED FIRST: a hit deserializes the compiled
+        executable (zero compiles — the mmap-and-go cold start); a miss or
+        an unusable entry falls back to a normal compile, counted, and the
+        fresh executable is stored for the next start. After this, any
+        recompile the monitor sees in steady state is a bug (the tier-1
+        chaos test asserts zero). `scripts/check_aot_warmup.py` lints that
+        the cache consult precedes the compile (no silent bypass)."""
+        compiled_count = 0
+        self.warmup_report = []
         for b in self.buckets:
             zeros = np.zeros(
                 (b, self.img_size, self.img_size, 3), np.float32
             )
-            out = self._jit(zeros)
-            np.asarray(out["log_px"])  # block until compiled + executed
+            t0 = time.perf_counter()
+            exe = None
+            if self.aot_cache is not None:
+                exe = self.aot_cache.load(self._aot_key(b))
+                if exe is not None and not self._verify_exec(exe, zeros):
+                    # deserialized but cannot run: counted reject, fall
+                    # back to compiling — fail-safe, never fail-serve
+                    self.aot_cache.reject_loaded()
+                    exe = None
+                elif exe is not None:
+                    # hit = deserialized AND verified (zero compiles)
+                    self.aot_cache.note_hit()
+            source = "cache"
+            if exe is None:
+                exe = self._jit.lower(zeros).compile()
+                self.monitor.note_compiles(1)
+                compiled_count += 1
+                source = "compile"
+                out = exe(zeros)
+                np.asarray(out["log_px"])  # block until executed
+                if self.aot_cache is not None:
+                    self.aot_cache.store(self._aot_key(b), exe)
+            self._exec[b] = exe
+            self.warmup_report.append({
+                "bucket": int(b),
+                "source": source,
+                "seconds": time.perf_counter() - t0,
+            })
         self.warmed_up = True
-        return self.monitor.check_recompiles()
+        # any dispatch-cache growth (an engine whose infer_fn was already
+        # driven through self._jit before warmup) still folds in here
+        return compiled_count + self.monitor.check_recompiles()
+
+    @staticmethod
+    def _verify_exec(exe, zeros: np.ndarray) -> bool:
+        """One blocking verification run of a cache-loaded executable: the
+        output contract must hold before it may serve traffic."""
+        try:
+            out = exe(zeros)
+            return np.asarray(out["log_px"]).shape == (zeros.shape[0],)
+        except Exception:
+            return False
 
     def warmup_costs(self) -> Dict[str, Any]:
         """XLA cost analysis of the inference program at every bucket —
@@ -485,7 +570,12 @@ class ServingEngine:
                 raise _chaos.ChaosError(
                     f"chaos: simulated device failure at dispatch {seq}"
                 )
-            out = self._jit(padded)
+            # the warmed per-bucket executable (cache hit or AOT compile);
+            # an un-warmed bucket falls back to the jit dispatch path,
+            # where the monitor counts the resulting compile — a silent
+            # cache/warmup bypass is exactly what the detector flags
+            exe = self._exec.get(bucket)
+            out = exe(padded) if exe is not None else self._jit(padded)
             logits = np.asarray(out["logits"], np.float64)[:n]
             log_px = np.asarray(out["log_px"], np.float64)[:n]
         self.monitor.observe_step(n, time.perf_counter() - t0,

@@ -30,6 +30,9 @@ Endpoints:
   GET  /metrics      Prometheus text of the process-current registry.
   POST /admin/swap   {"artifact": path} -> blue/green hot swap (fail-closed;
                      see serving/swap.py). 200 committed, 409 rejected.
+  GET  /admin/autoscale  autoscaler status (bounds, current/ready replicas,
+                     last decision + its signal snapshot); 501 when no
+                     autoscaler is configured (serving/autoscale.py).
 
 `await asyncio.sleep` is the only waiting primitive here; `time.sleep` and
 friends are banned from the serving path (scripts/check_no_blocking_sleep).
@@ -84,6 +87,7 @@ class Frontend:
         preemption_handler=None,
         swap_factory_builder: Optional[Callable[[str], Callable]] = None,
         require_calibrated_swap: bool = True,
+        autoscaler=None,
     ):
         """`swap_factory_builder(path)` returns an engine factory for the
         artifact at `path` (the CLI wires the serve flags in); without it
@@ -101,6 +105,11 @@ class Frontend:
         self.preemption_handler = preemption_handler
         self.swap_factory_builder = swap_factory_builder
         self.require_calibrated_swap = bool(require_calibrated_swap)
+        # autoscaler (serving/autoscale.py): ticked ON the pump, where all
+        # ReplicaSet access already serializes — scale decisions can never
+        # race a poll, and a scale-down's drain responses resolve futures
+        # like any other pump output
+        self.autoscaler = autoscaler
         self._server: Optional[asyncio.AbstractServer] = None
         self._pump_task: Optional[asyncio.Task] = None
         self._inbox: Deque[Tuple[Any, str, Optional[float]]] = deque()
@@ -162,6 +171,10 @@ class Frontend:
                         )
                     )
                 out.extend(self.replicas.poll())
+                if self.autoscaler is not None:
+                    decision = self.autoscaler.tick()
+                    if decision is not None:
+                        out.extend(decision.responses)
                 return out, admin_results
 
             responses, admin_results = await loop.run_in_executor(None, step)
@@ -308,6 +321,14 @@ class Frontend:
             )
         if method == "POST" and target == "/admin/swap":
             return await self._swap(raw)
+        if method == "GET" and target == "/admin/autoscale":
+            if self.autoscaler is None:
+                return 501, json.dumps(
+                    {"error": "autoscaler_not_configured"}
+                ).encode(), None
+            return 200, json.dumps(
+                self.autoscaler.status()
+            ).encode(), None
         return 404, b'{"error": "not_found"}', None
 
     # ----------------------------------------------------------------- handlers

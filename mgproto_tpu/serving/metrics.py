@@ -45,6 +45,13 @@ SWAPS = "serving_swap_total"
 SWAP_TRANSFERRED = "serving_swap_transferred_total"
 # --- performance observatory (ISSUE 8): per-stage request latency ---
 STAGE_SECONDS = "serving_stage_seconds"
+# --- elastic serving (ISSUE 13): AOT executable cache + autoscaler ---
+AOT_HITS = "serving_aot_hit_total"
+AOT_MISSES = "serving_aot_miss_total"
+AOT_REJECTS = "serving_aot_reject_total"
+AOT_STORES = "serving_aot_store_total"
+AUTOSCALE_TARGET = "autoscale_replicas_target"
+AUTOSCALE_EVENTS = "autoscale_events_total"
 
 COUNTER_HELP = {
     REQUESTS: "requests by outcome (predict/abstain/reject/shed)",
@@ -68,6 +75,21 @@ COUNTER_HELP = {
     SWAP_TRANSFERRED:
         "queued requests transferred old->new engine during a hot swap "
         "(the zero-dropped-requests guarantee, made countable)",
+    AOT_HITS:
+        "bucket warmups served from the AOT executable cache "
+        "(deserialize instead of compile — zero XLA compiles)",
+    AOT_MISSES:
+        "bucket warmups whose cache key was absent (normal compile, "
+        "lazily stored for the next start)",
+    AOT_REJECTS:
+        "cache entries refused as unusable, by reason (key_mismatch/"
+        "corrupt/deserialize/execute); every reject falls back to a "
+        "normal compile — never a wrong-program serve",
+    AOT_STORES:
+        "executable serialization attempts by result (ok/unsupported/"
+        "error)",
+    AUTOSCALE_EVENTS:
+        "autoscaler scale decisions applied, by direction (up/down)",
 }
 
 GAUGE_HELP = {
@@ -80,6 +102,9 @@ GAUGE_HELP = {
     BREAKER_OPEN_FRACTION:
         "fraction of replica-seconds spent with the breaker OPEN",
     UPTIME_SECONDS: "seconds since the replica supervisor started",
+    AUTOSCALE_TARGET:
+        "replica count the autoscaler is currently steering toward "
+        "(within its [min, max] bounds)",
 }
 
 # batch fill is a fraction in (0, 1]; the default time buckets would dump

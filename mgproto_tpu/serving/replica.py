@@ -65,12 +65,14 @@ class Replica:
         clock: Callable[[], float],
         batcher_config: Optional[BatcherConfig] = None,
         pre_dispatch: Optional[Callable[[], None]] = None,
+        engine_prep: Optional[Callable[["object"], None]] = None,
     ):
         self.name = name
         self.factory = factory
         self.clock = clock
         self.batcher_config = batcher_config
         self.pre_dispatch = pre_dispatch
+        self.engine_prep = engine_prep
         self.engine = None
         self.batcher: Optional[MicroBatcher] = None
         self.probe: Optional[HealthProbe] = None
@@ -84,8 +86,14 @@ class Replica:
     # ----------------------------------------------------------------- lifecycle
     def start(self) -> int:
         """Build + warm the engine; returns warmup compile count. Raises on
-        factory/warmup failure (the supervisor converts that into backoff)."""
+        factory/warmup failure (the supervisor converts that into backoff).
+        `engine_prep` runs between build and warmup — the per-replica HBM
+        bucket-planning hook (serving/autoscale.py `hbm_bucket_prep`), so
+        heterogeneous hardware gets heterogeneous bucket ladders BEFORE any
+        bucket compiles."""
         self.engine = self.factory()
+        if self.engine_prep is not None:
+            self.engine_prep(self.engine)
         compiled = self.engine.warmup()
         self.batcher = MicroBatcher(
             self.engine,
@@ -151,6 +159,7 @@ class ReplicaSet:
         restart_max_delay_s: float = 5.0,
         batcher_config: Optional[BatcherConfig] = None,
         pre_dispatch: Optional[Callable[[], None]] = None,
+        engine_prep: Optional[Callable[["object"], None]] = None,
     ):
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
@@ -161,6 +170,8 @@ class ReplicaSet:
         self.restart_max_delay_s = float(restart_max_delay_s)
         self.batcher_config = batcher_config
         self.pre_dispatch = pre_dispatch
+        self.engine_prep = engine_prep
+        self._next_name = int(replicas)  # unique names across add/remove
         self.replicas: List[Replica] = [
             self._make_replica(f"r{i}") for i in range(int(replicas))
         ]
@@ -177,6 +188,7 @@ class ReplicaSet:
             self.clock,
             batcher_config=self.batcher_config,
             pre_dispatch=self.pre_dispatch,
+            engine_prep=self.engine_prep,
         )
 
     # ----------------------------------------------------------------- lifecycle
@@ -188,6 +200,92 @@ class ReplicaSet:
             compiled += rep.start()
         self._observe()
         return compiled
+
+    # --------------------------------------------------------------- elasticity
+    def add_replica(self) -> Replica:
+        """Grow the set by one (the autoscaler's scale-up arm). The new
+        replica enters as a due-now BACKOFF entry, so the NEXT supervisor
+        `poll()` builds and warms it through the existing restart path —
+        warmup (cheap through the AOT cache by construction) happens in
+        the pump, never on a request's critical path, and a failing
+        factory re-enters backoff like any other restart."""
+        name = f"r{self._next_name}"
+        self._next_name += 1
+        rep = self._make_replica(name)
+        rep.restart_at = self.clock()
+        self.replicas.append(rep)
+        _m.gauge(_m.REPLICAS_TOTAL).set(float(len(self.replicas)))
+        get_recorder().record("replica_added", replica=name)
+        _reqtrace.plane_event("replica_added", replica=name)
+        self._observe()
+        return rep
+
+    def remove_replica(
+        self, rep: Optional[Replica] = None
+    ) -> List[ServeResponse]:
+        """Shrink the set by one with ZERO dropped requests (the
+        autoscaler's scale-down arm). The victim (default: a dead/backoff
+        replica if one exists — free to remove — else the last ready one)
+        is marked draining, its queued requests transfer to survivors via
+        the same `drain_all`/`restore` path a heartbeat failure uses
+        (deadlines + enqueue times intact); whatever the survivors cannot
+        hold is answered THROUGH the victim's own device before it leaves
+        (it is healthy — this is a shrink, not a failure), and only an
+        unresponsive victim's leftovers shed typed. Returns every response
+        produced. Refuses to empty the set."""
+        if len(self.replicas) <= 1:
+            raise ValueError("refusing to remove the last replica")
+        if rep is None:
+            idle = [r for r in self.replicas if r.engine is None]
+            if idle:
+                rep = idle[-1]
+            else:
+                ready = self.ready_replicas()
+                rep = ready[-1] if ready else self.replicas[-1]
+        if rep not in self.replicas:
+            raise ValueError(f"{rep.name} is not in this set")
+        out: List[ServeResponse] = []
+        now = self.clock()
+        stranded: List = []
+        if rep.engine is not None:
+            rep.engine.draining = True  # readiness false: no new routing
+            stranded = rep.engine.queue.drain_all()
+            stranded.extend(rep.engine.queue.drain_shed())
+            survivors = [
+                s for s in self.replicas
+                if s is not rep and s.responsive()
+            ]
+            i = 0
+            for req in stranded:
+                placed = False
+                for _ in range(len(survivors)):
+                    target = survivors[i % len(survivors)]
+                    i += 1
+                    if target.engine.queue.restore(req):
+                        placed = True
+                        break
+                if placed:
+                    continue
+                # survivors full: the victim itself answers before leaving
+                if rep.responsive() and rep.engine.queue.restore(req):
+                    continue
+                out.append(
+                    shed_response(
+                        req.request_id, REASON_REPLICA_LOST,
+                        latency_s=now - req.enqueued_at,
+                    )
+                )
+            if rep.responsive() and len(rep.engine.queue):
+                out.extend(rep.batcher.flush())
+                self.steady_recompiles += rep.engine.monitor.check_recompiles()
+        self.replicas.remove(rep)
+        _m.gauge(_m.REPLICAS_TOTAL).set(float(len(self.replicas)))
+        get_recorder().record(
+            "replica_removed", replica=rep.name, drained=len(stranded),
+        )
+        _reqtrace.plane_event("replica_removed", replica=rep.name)
+        self._observe()
+        return out
 
     # ------------------------------------------------------------------ routing
     def ready_replicas(self) -> List[Replica]:

@@ -181,6 +181,17 @@ class StepMonitor:
             self._c_recompiles.inc(new, phase=self.phase)
         return new
 
+    def note_compiles(self, n: int = 1) -> int:
+        """Account compiles performed OUTSIDE any watched jit's dispatch
+        cache — AOT `lower().compile()` at serving warmup (serving/
+        engine.py consults the executable cache and compiles ahead-of-time
+        on a miss; the dispatch cache never sees those, so `_cache_size`
+        deltas cannot). Keeps `jit_recompiles_total` the one ledger of
+        every compile the process performed."""
+        if n > 0:
+            self._c_recompiles.inc(n, phase=self.phase)
+        return n
+
     @property
     def recompile_count(self) -> int:
         return int(self._c_recompiles.value(phase=self.phase))

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Lint: ServingEngine.warmup must consult the AOT cache BEFORE compiling.
+
+The AOT executable cache (mgproto_tpu/serving/aotcache.py) only delivers
+its mmap-and-go cold start if warmup actually asks it first: a refactor
+that reorders warmup to compile eagerly (or drops the consult entirely)
+would silently regress every replica start and blue/green swap back to
+compile-everything — with zero functional symptoms, because the fallback
+path serves identically. This lint pins the ordering statically.
+
+Rule, applied to `ServingEngine.warmup` in mgproto_tpu/serving/engine.py
+(AST-based, companion to check_no_blocking_sleep.py and friends):
+
+  * the function must contain a `.load(...)` call on an attribute chain
+    mentioning the aot cache (e.g. `self.aot_cache.load(...)`), and
+  * that consult must appear on an EARLIER line than the first compile
+    site — a `.compile(...)` call or a direct `self._jit(...)` dispatch.
+
+Run from anywhere:
+
+    python scripts/check_aot_warmup.py [repo_root]
+
+Exit 0 when clean, 1 with a diagnostic otherwise. Wired into tier-1 via
+tests/test_aotcache.py (with violation-detection coverage over synthetic
+sources, like the other lint scripts).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_ENGINE_REL = os.path.join("mgproto_tpu", "serving", "engine.py")
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name chain ('self.aot_cache.load')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _warmup_fn(tree: ast.AST) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ServingEngine":
+            for item in node.body:
+                if (
+                    isinstance(item, ast.FunctionDef)
+                    and item.name == "warmup"
+                ):
+                    return item
+    return None
+
+
+def check_source(source: str, path: str = "<engine>") -> List[str]:
+    """Problems found (empty = clean)."""
+    tree = ast.parse(source, filename=path)
+    fn = _warmup_fn(tree)
+    if fn is None:
+        return [f"{path}: no ServingEngine.warmup function found"]
+    consult_line: Optional[int] = None
+    compile_line: Optional[int] = None
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain.endswith(".load") and "aot" in chain.lower():
+            if consult_line is None or node.lineno < consult_line:
+                consult_line = node.lineno
+        # `x.lower(...).compile()` chains through a Call, so the resolved
+        # chain may be the bare method name
+        is_compile = (
+            chain == "compile"
+            or chain.endswith(".compile")
+            or chain.endswith("._jit")
+        )
+        if is_compile and (compile_line is None
+                           or node.lineno < compile_line):
+            compile_line = node.lineno
+    problems = []
+    if consult_line is None:
+        problems.append(
+            f"{path}: ServingEngine.warmup never consults the AOT cache "
+            "(no aot*.load(...) call) — silent cache bypass"
+        )
+    if compile_line is None:
+        problems.append(
+            f"{path}: ServingEngine.warmup has no compile fallback "
+            "(no .compile()/self._jit call) — a cache miss cannot warm"
+        )
+    if (
+        consult_line is not None
+        and compile_line is not None
+        and consult_line > compile_line
+    ):
+        problems.append(
+            f"{path}:{compile_line}: warmup compiles (line {compile_line}) "
+            f"BEFORE consulting the AOT cache (line {consult_line}) — the "
+            "cache must be asked first"
+        )
+    return problems
+
+
+def offenders(repo_root: str) -> List[Tuple[str, str]]:
+    path = os.path.join(repo_root, _ENGINE_REL)
+    try:
+        with open(path) as f:
+            source = f.read()
+    except OSError as e:
+        return [(path, f"cannot read: {e}")]
+    return [(path, msg) for msg in check_source(source, _ENGINE_REL)]
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = offenders(root)
+    for _path, msg in found:
+        print(msg)
+    if found:
+        return 1
+    print("check_aot_warmup: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,7 +13,11 @@ The online continual-learning plane (mgproto_tpu/online/, ISSUE 11) lives
 under the same contract: its consolidation/drift cadences are poll-driven
 `tick(now)` loops on injected clocks — a sleep there would either stall the
 pump that hosts the ticks or make the virtual-clock drift drill
-nondeterministic, so both packages are linted.
+nondeterministic, so both packages are linted. The autoscaler
+(serving/autoscale.py, ISSUE 13) is covered by the serving/ walk BY
+CONSTRUCTION — its control loop is a pump-hook `tick(now)` on the plane's
+clock, and tests/test_autoscale.py proves the walk reaches it with a
+violation-detection case.
 
 AST-based (companion to check_no_print.py / check_no_signal_handlers.py).
 Flags, in every module under mgproto_tpu/serving/ and mgproto_tpu/online/:

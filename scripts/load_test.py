@@ -519,6 +519,9 @@ def run_load_test(
     poison_rate: Optional[float] = None,
     class_bucket: int = 8,
     accuracy_window: int = 40,
+    autoscale: Optional[Tuple[int, int]] = None,
+    autoscale_interval_s: float = 0.1,
+    aot_cache_dir: Optional[str] = None,
 ) -> Dict:
     """Drive the storm; returns the result record (see module docstring).
     Importable — tests/test_load_plane.py runs the acceptance drill through
@@ -528,7 +531,18 @@ def run_load_test(
     per-request frontend/batcher/replica/engine stage spans, per-dispatch
     coalescing spans, and kill/wedge/restart/swap markers — every timestamp
     is VIRTUAL seconds, so the timeline is exactly the seeded schedule
-    (schema notes in evidence/README.md). Open in Perfetto/chrome://tracing."""
+    (schema notes in evidence/README.md). Open in Perfetto/chrome://tracing.
+
+    `autoscale=(min, max)` runs the elastic drill (ISSUE 13): the fleet
+    STARTS at `min` replicas, the device model switches to per-replica
+    busy windows (`BatcherConfig.device_busy_s = service_ms`, host
+    dispatch cost service_ms/20 — N replicas genuinely serve N dispatches
+    concurrently in virtual time, so a ramp can overrun a min-size fleet),
+    every engine warms through a shared AOT executable cache (scale-up is
+    a deserialize, not a compile storm), and the observatory-driven
+    Autoscaler ticks on the pump. The result gains an "autoscale" block
+    (events with signal snapshots, replica trajectory, AOT hit/miss
+    counts) gated by `mgproto-telemetry check --autoscale`."""
     import jax
 
     from mgproto_tpu.config import tiny_test_config
@@ -576,6 +590,21 @@ def run_load_test(
     try:
         clock = VirtualClock()
         service_s = service_ms / 1000.0
+        aot_cache = None
+        made_cache_dir = None
+        if autoscale is not None:
+            mn, mx = int(autoscale[0]), int(autoscale[1])
+            if mn < 1 or mx < mn:
+                raise ValueError(f"autoscale needs 1 <= min <= max, "
+                                 f"got {autoscale}")
+            replicas = mn  # the drill starts at the MIN fleet, by design
+            import tempfile
+
+            from mgproto_tpu.serving.aotcache import ExecutableCache
+
+            if aot_cache_dir is None:
+                made_cache_dir = tempfile.mkdtemp(prefix="mgproto_aot_")
+            aot_cache = ExecutableCache(aot_cache_dir or made_cache_dir)
         plane: Optional[OnlinePlane] = None
         if online_mode:
             import dataclasses as _dc
@@ -611,6 +640,7 @@ def run_load_test(
                     clock=clock,
                     queue_capacity=queue_capacity,
                     default_deadline_s=deadline_ms / 1000.0,
+                    aot_cache=aot_cache,
                 ),
             )
             prev_capture = capture_mod.install(plane.capture)
@@ -651,25 +681,61 @@ def run_load_test(
                     clock=clock,
                     queue_capacity=queue_capacity,
                     default_deadline_s=deadline_ms / 1000.0,
+                    aot_cache=aot_cache,
                 )
+
+        if autoscale is not None:
+            # elastic drill: the device model moves from "every dispatch
+            # serializes service_ms of shared time" to per-replica BUSY
+            # WINDOWS — each replica's batcher holds its next batch for
+            # service_ms after a dispatch, so N replicas genuinely serve
+            # N batches concurrently and a ramp can overrun a min fleet.
+            # The host dispatch cost (pre_dispatch) stays tiny (the pump
+            # is not the bottleneck being measured).
+            host_cost_s = service_s / 20.0
+            batcher_config = BatcherConfig(
+                cost_prior_s=host_cost_s,
+                max_linger_s=linger_ms / 1000.0,
+                device_busy_s=service_s,
+            )
+            pre_dispatch = lambda: clock.advance(host_cost_s)  # noqa: E731
+        else:
+            batcher_config = BatcherConfig(
+                cost_prior_s=service_s,
+                max_linger_s=linger_ms / 1000.0,
+            )
+            # the synthetic device: every dispatch consumes service_ms of
+            # virtual time BEFORE responses are stamped, so latencies and
+            # the batcher's measured-cost EMA both see it
+            pre_dispatch = lambda: clock.advance(service_s)  # noqa: E731
 
         rs = ReplicaSet(
             factory,
             replicas=replicas,
             clock=clock,
             heartbeat_timeout_s=heartbeat_timeout_s,
-            batcher_config=BatcherConfig(
-                cost_prior_s=service_s,
-                max_linger_s=linger_ms / 1000.0,
-            ),
-            # the synthetic device: every dispatch consumes service_ms of
-            # virtual time BEFORE responses are stamped, so latencies and
-            # the batcher's measured-cost EMA both see it
-            pre_dispatch=lambda: clock.advance(service_s),
+            batcher_config=batcher_config,
+            pre_dispatch=pre_dispatch,
         )
         warmup_compiles = rs.start()
         if plane is not None:
             plane.bind_replica_set(rs)
+        scaler = None
+        if autoscale is not None:
+            from mgproto_tpu.serving.autoscale import (
+                Autoscaler,
+                AutoscalerConfig,
+            )
+
+            scaler = Autoscaler(
+                rs,
+                AutoscalerConfig(
+                    min_replicas=mn,
+                    max_replicas=mx,
+                    interval_s=autoscale_interval_s,
+                ),
+                registry=registry,
+            )
 
         responses = []
         swap_reports = []
@@ -678,6 +744,7 @@ def run_load_test(
         index_of: Dict[str, int] = {}
         payload_rng = np.random.RandomState(seed + 1)
         img = cfg.model.img_size
+        phase_replicas: List[int] = []
         poison_injected = 0
         chaos = chaos_mod.get_active()
         drift_injected_t: Optional[float] = None
@@ -712,6 +779,10 @@ def run_load_test(
                 before = len(responses)
                 responses.extend(rs.submit(payload, request_id=rid))
                 responses.extend(rs.poll())
+                if scaler is not None:
+                    decision = scaler.tick(clock())
+                    if decision is not None:
+                        responses.extend(decision.responses)
                 if plane is not None:
                     # the continual-learning side-plane runs BETWEEN pump
                     # polls and consumes zero virtual time: pump latency
@@ -720,6 +791,7 @@ def run_load_test(
                     plane.tick(clock())
                 clock.advance(spacing)
                 i += 1
+            phase_replicas.append(len(rs.replicas))
         # drain: keep pumping virtual time until every request is answered
         # (restarting replicas come back, stragglers hit their deadlines)
         answered = {r.request_id for r in responses}
@@ -729,6 +801,10 @@ def run_load_test(
                 break
             before = len(responses)
             responses.extend(rs.poll())
+            if scaler is not None:
+                decision = scaler.tick(clock())
+                if decision is not None:
+                    responses.extend(decision.responses)
             if plane is not None:
                 plane.observe_responses(responses[before:])
                 plane.tick(clock())
@@ -830,6 +906,33 @@ def run_load_test(
             "steady_state_recompiles": rs.steady_recompiles,
             "virtual_duration_s": round(clock(), 3),
         }
+        if scaler is not None:
+            events = [d.to_dict() for d in scaler.decisions]
+            traj = [int(replicas)] + [
+                e["replicas_after"] for e in events
+            ]
+            result["autoscale"] = {
+                "min": mn,
+                "max": mx,
+                "interval_s": autoscale_interval_s,
+                "start_replicas": int(replicas),
+                "events": events,
+                "events_by_direction": _label_counts(
+                    snapshot, sm.AUTOSCALE_EVENTS, "direction"
+                ),
+                "replicas_peak": max(traj),
+                "replicas_final": len(rs.replicas),
+                "phase_replicas": phase_replicas,
+                # the scale-up cost story: every warmup past the very first
+                # replica's cold compile+store should be a cache hit
+                "aot": {
+                    "hits": registry.counter(sm.AOT_HITS).value(),
+                    "misses": registry.counter(sm.AOT_MISSES).value(),
+                    "rejects": _label_counts(
+                        snapshot, sm.AOT_REJECTS, "reason"
+                    ),
+                },
+            }
         if plane is not None:
             # poisoned requests that actually got STAGED — must be zero:
             # the capture gate is the thing standing between mislabeled
@@ -910,6 +1013,10 @@ def run_load_test(
             }
         return result
     finally:
+        if made_cache_dir is not None:
+            import shutil
+
+            shutil.rmtree(made_cache_dir, ignore_errors=True)
         if trace_out:
             from mgproto_tpu.obs import reqtrace
 
@@ -971,6 +1078,15 @@ def main(argv: Optional[list] = None) -> int:
                    help="fraction of requests replaced with low-p(x) "
                         "mislabeled junk the capture gate must reject "
                         "(default: MGPROTO_CHAOS_ONLINE_POISON_RATE)")
+    p.add_argument("--autoscale", default="",
+                   help="MIN:MAX replica bounds — run the elastic drill: "
+                        "start at MIN, per-replica device-busy service "
+                        "model, AOT-cached warmups, observatory-driven "
+                        "scale-out/in (serving/autoscale.py); the result "
+                        "gains an 'autoscale' block (baseline: "
+                        "evidence/autoscale_baseline.json)")
+    p.add_argument("--autoscale-interval-s", type=float, default=0.1,
+                   help="autoscaler decision cadence (virtual seconds)")
     p.add_argument("--out", default="",
                    help="write the JSON line here (e.g. "
                         "evidence/load_test_baseline.json)")
@@ -979,6 +1095,20 @@ def main(argv: Optional[list] = None) -> int:
                         "trace here (per-request stage spans, dispatch "
                         "coalescing, kill/swap markers; open in Perfetto)")
     args = p.parse_args(argv)
+
+    autoscale = None
+    if args.autoscale:
+        mn, _, mx = args.autoscale.partition(":")
+        try:
+            autoscale = (int(mn), int(mx))
+        except ValueError:
+            raise SystemExit(
+                f"--autoscale must be MIN:MAX, got {args.autoscale!r}"
+            )
+        if autoscale[0] < 1 or autoscale[1] < autoscale[0]:
+            raise SystemExit(
+                f"--autoscale needs 1 <= MIN <= MAX, got {args.autoscale!r}"
+            )
 
     result = run_load_test(
         seed=args.seed,
@@ -1006,6 +1136,8 @@ def main(argv: Optional[list] = None) -> int:
         class_bucket=args.class_bucket,
         accuracy_window=args.accuracy_window,
         poison_rate=args.poison_rate,
+        autoscale=autoscale,
+        autoscale_interval_s=args.autoscale_interval_s,
     )
     line = json.dumps(result, sort_keys=True)
     print(line)

@@ -1,0 +1,393 @@
+"""AOT executable cache tests (ISSUE 13): mmap-and-go cold start.
+
+The fail-closed hygiene contract, asserted end to end:
+
+  * a cache HIT warms a bucket with ZERO XLA compiles (StepMonitor-backed
+    warmup accounting) and the deserialized executable serves bit-useful
+    predictions;
+  * any key-component change (program fingerprint, compute dtype,
+    jax/jaxlib version, device identity) is a MISS + normal compile —
+    never a wrong-program serve;
+  * a corrupt or tampered entry is a counted REJECT + fallback compile;
+  * TrustGate parity: a cache hit still passes the PR-3/PR-12 fingerprint
+    and precision checks (the cache bypasses COMPILATION, never trust);
+  * export-time prebuild (`engine/export.export_aot_cache`) gives
+    `from_artifact` a zero-compile warmup;
+  * `scripts/check_aot_warmup.py` lints that warmup consults the cache
+    before compiling (violation detection included);
+  * `bench.py --measure coldstart` contract + the committed
+    evidence/coldstart_bench.json schema guard.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from mgproto_tpu.config import tiny_test_config
+from mgproto_tpu.engine.train import Trainer
+from mgproto_tpu.serving import metrics as sm
+from mgproto_tpu.serving.aotcache import (
+    ExecutableCache,
+    cache_key,
+    default_cache_dir,
+    environment_fingerprint,
+    file_fingerprint,
+    key_digest,
+)
+from mgproto_tpu.serving.calibration import calibrate, gmm_fingerprint
+from mgproto_tpu.serving.engine import ServingEngine
+from mgproto_tpu.telemetry.registry import (
+    MetricRegistry,
+    default_registry,
+    set_current_registry,
+)
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from check_aot_warmup import check_source  # noqa: E402
+
+BUCKETS = (1, 2)
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = set_current_registry(MetricRegistry())
+    sm.register_serving_metrics(default_registry())
+    yield
+    set_current_registry(prev)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_test_config()
+    trainer = Trainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    return cfg, trainer, state
+
+
+def _counter(name, **labels):
+    return default_registry().counter(name).value(**labels)
+
+
+def _engine(trainer, state, cache, **kw):
+    return ServingEngine.from_live(
+        trainer, state, buckets=BUCKETS, aot_cache=cache, **kw
+    )
+
+
+def _payload(cfg, seed=7):
+    rng = np.random.RandomState(seed)
+    return rng.rand(cfg.model.img_size, cfg.model.img_size, 3).astype(
+        np.float32
+    )
+
+
+class TestCacheRoundTrip:
+    def test_hit_warms_with_zero_compiles(self, setup, tmp_path):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        cold = _engine(trainer, state, cache)
+        assert cold.warmup() == len(BUCKETS)
+        assert [r["source"] for r in cold.warmup_report] == (
+            ["compile"] * len(BUCKETS)
+        )
+        assert _counter(sm.AOT_MISSES) == len(BUCKETS)
+        assert _counter(sm.AOT_STORES, result="ok") == len(BUCKETS)
+
+        warm = _engine(trainer, state, cache)
+        assert warm.warmup() == 0  # THE acceptance number: zero compiles
+        assert [r["source"] for r in warm.warmup_report] == (
+            ["cache"] * len(BUCKETS)
+        )
+        assert _counter(sm.AOT_HITS) == len(BUCKETS)
+        # the deserialized program serves, and steady state stays compile
+        # free through the StepMonitor detector
+        resp = warm.serve_all([_payload(cfg)])[0]
+        assert resp.outcome in ("predict", "abstain")
+        assert warm.monitor.check_recompiles() == 0
+
+    def test_hit_matches_cold_numerics(self, setup, tmp_path):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        cold = _engine(trainer, state, cache)
+        cold.warmup()
+        warm = _engine(trainer, state, cache)
+        warm.warmup()
+        p = _payload(cfg, seed=11)
+        r_cold = cold.serve_all([p])[0]
+        r_warm = warm.serve_all([p])[0]
+        assert r_cold.prediction == r_warm.prediction
+        assert r_cold.log_px == pytest.approx(r_warm.log_px, rel=1e-6)
+
+    def test_unwarmed_bucket_falls_back_to_jit(self, setup):
+        cfg, trainer, state = setup
+        eng = ServingEngine.from_live(trainer, state, buckets=BUCKETS)
+        # no warmup: dispatch compiles through the jit path, and the
+        # monitor SEES it (the no-silent-bypass detector)
+        resp = eng.serve_all([_payload(cfg)])[0]
+        assert resp.outcome in ("predict", "abstain")
+        assert eng.monitor.recompile_count >= 1
+
+
+class TestStaleKeyRejection:
+    def test_fingerprint_change_is_a_miss(self, setup, tmp_path):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        _engine(trainer, state, cache, aot_fingerprint="model-v1").warmup()
+        other = _engine(trainer, state, cache, aot_fingerprint="model-v2")
+        assert other.warmup() == len(BUCKETS)  # recompiled, no stale serve
+        assert [r["source"] for r in other.warmup_report] == (
+            ["compile"] * len(BUCKETS)
+        )
+        assert _counter(sm.AOT_REJECTS) == 0  # absent key = miss, not reject
+
+    def test_jax_version_change_is_a_miss(self, setup, tmp_path):
+        cfg, trainer, state = setup
+        d = str(tmp_path / "aot")  # same dir, two environments
+        env_now = environment_fingerprint()
+        env_old = dict(env_now, jax_version="0.0.1")
+        cold = _engine(trainer, state, ExecutableCache(d, env=env_old))
+        cold.warmup()
+        warm = _engine(trainer, state, ExecutableCache(d, env=env_now))
+        assert warm.warmup() == len(BUCKETS)  # other env's entries invisible
+        assert _counter(sm.AOT_HITS) == 0
+
+    def test_dtype_change_is_a_miss(self):
+        base = cache_key("fp", (2, 8, 8, 3), "float32")
+        bf16 = cache_key("fp", (2, 8, 8, 3), "bfloat16")
+        assert key_digest(base) != key_digest(bf16)
+        # ... and every documented component moves the digest
+        for field, value in (
+            ("program_fingerprint", "other"),
+            ("bucket_shape", [4, 8, 8, 3]),
+            ("device_kind", "TPU v5e (unobtainium)"),
+            ("device_count", (base.get("device_count") or 0) + 1),
+            ("jax_version", "9.9.9"),
+            ("jaxlib_version", "9.9.9"),
+        ):
+            moved = dict(base, **{field: value})
+            assert key_digest(moved) != key_digest(base), field
+
+    def test_corrupt_payload_rejected_and_recompiled(self, setup, tmp_path):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        _engine(trainer, state, cache).warmup()
+        # flip bytes in the middle of every entry's payload
+        for name in os.listdir(cache.cache_dir):
+            path = os.path.join(cache.cache_dir, name)
+            raw = bytearray(open(path, "rb").read())
+            raw[-50:-40] = b"\x00" * 10
+            open(path, "wb").write(bytes(raw))
+        eng = _engine(trainer, state, cache)
+        assert eng.warmup() == len(BUCKETS)  # fallback compile, not a crash
+        assert _counter(sm.AOT_REJECTS, reason="corrupt") == len(BUCKETS)
+        resp = eng.serve_all([_payload(cfg)])[0]
+        assert resp.outcome in ("predict", "abstain")
+
+    def test_header_key_mismatch_rejected(self, setup, tmp_path):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        eng = _engine(trainer, state, cache)
+        eng.warmup()
+        # graft one entry onto another digest's path: embedded key now
+        # disagrees with the requested one (collision/tampering model)
+        names = sorted(os.listdir(cache.cache_dir))
+        a, b = (os.path.join(cache.cache_dir, n) for n in names[:2])
+        open(a, "wb").write(open(b, "rb").read())
+        eng2 = _engine(trainer, state, cache)
+        eng2.warmup()
+        assert _counter(sm.AOT_REJECTS, reason="key_mismatch") >= 1
+
+    def test_truncated_entry_rejected(self, setup, tmp_path):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        _engine(trainer, state, cache).warmup()
+        for name in os.listdir(cache.cache_dir):
+            path = os.path.join(cache.cache_dir, name)
+            raw = open(path, "rb").read()
+            open(path, "wb").write(raw[: len(raw) // 2])
+        eng = _engine(trainer, state, cache)
+        assert eng.warmup() == len(BUCKETS)
+        assert _counter(sm.AOT_REJECTS, reason="corrupt") == len(BUCKETS)
+
+
+class TestTrustGateParity:
+    def test_cache_hit_still_fails_closed_on_fingerprint(
+        self, setup, tmp_path
+    ):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        _engine(trainer, state, cache).warmup()
+
+        rng = np.random.RandomState(0)
+        batches = [(
+            rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3)
+            .astype(np.float32),
+            rng.randint(0, cfg.model.num_classes, (4,)).astype(np.int32),
+        )]
+        calib = calibrate(trainer, state, batches)
+        import dataclasses
+
+        stale = dataclasses.replace(
+            calib, gmm_fingerprint="someone-elses-mixture"
+        )
+        eng = _engine(trainer, state, cache, calibration=stale)
+        assert eng.warmup() == 0  # cache hit...
+        assert eng.gate.fingerprint_mismatch  # ...but trust still refuses
+        assert eng.gate.degraded
+        resp = eng.serve_all([_payload(cfg)])[0]
+        assert resp.degraded
+
+    def test_cache_hit_with_valid_calibration_gates_normally(
+        self, setup, tmp_path
+    ):
+        cfg, trainer, state = setup
+        cache = ExecutableCache(str(tmp_path / "aot"))
+        _engine(trainer, state, cache).warmup()
+        rng = np.random.RandomState(0)
+        batches = [(
+            rng.rand(4, cfg.model.img_size, cfg.model.img_size, 3)
+            .astype(np.float32),
+            rng.randint(0, cfg.model.num_classes, (4,)).astype(np.int32),
+        )]
+        calib = calibrate(trainer, state, batches)
+        eng = _engine(trainer, state, cache, calibration=calib)
+        assert eng.warmup() == 0
+        assert not eng.gate.degraded
+        resp = eng.serve_all([_payload(cfg)])[0]
+        assert not resp.degraded
+        assert resp.trust in ("in_dist", "abstain")
+
+
+class TestExportPrebuild:
+    @pytest.fixture(scope="class")
+    def artifact(self, setup, tmp_path_factory):
+        from mgproto_tpu.engine.export import (
+            artifact_meta,
+            export_eval,
+            save_artifact,
+        )
+
+        cfg, trainer, state = setup
+        path = str(tmp_path_factory.mktemp("artifact") / "tiny.mgproto")
+        exported = export_eval(trainer, state, dynamic_batch=True)
+        meta = artifact_meta(
+            cfg, None, True, gmm_fingerprint=gmm_fingerprint(state.gmm)
+        )
+        save_artifact(path, exported, meta)
+        return path
+
+    def test_export_aot_cache_gives_zero_compile_artifact_start(
+        self, artifact
+    ):
+        from mgproto_tpu.engine.export import export_aot_cache
+
+        summary = export_aot_cache(artifact, buckets=BUCKETS)
+        assert summary["cache_dir"] == default_cache_dir(artifact)
+        assert all(summary["stored"].values())
+        assert summary["environment"]["jax_version"] == jax.__version__
+
+        cache = ExecutableCache(default_cache_dir(artifact))
+        eng = ServingEngine.from_artifact(
+            artifact, allow_uncalibrated=True,
+            buckets=BUCKETS, aot_cache=cache,
+        )
+        assert eng.warmup() == 0  # replica start = deserialize only
+        assert _counter(sm.AOT_HITS) == len(BUCKETS)
+
+    def test_reexport_invalidates_via_file_fingerprint(self, artifact):
+        # the artifact face's program identity is the file hash: touching
+        # the artifact bytes changes the key, so stale executables miss
+        fp1 = file_fingerprint(artifact)
+        from mgproto_tpu.engine.export import embed_calibration
+
+        embed_calibration(artifact, {"note": "recalibrated"})
+        assert file_fingerprint(artifact) != fp1
+
+
+class TestWarmupLint:
+    def test_real_engine_source_clean(self):
+        with open(
+            os.path.join(REPO, "mgproto_tpu", "serving", "engine.py")
+        ) as f:
+            assert check_source(f.read()) == []
+
+    def test_missing_consult_flagged(self):
+        src = (
+            "class ServingEngine:\n"
+            "    def warmup(self):\n"
+            "        exe = self._jit.lower(z).compile()\n"
+        )
+        problems = check_source(src)
+        assert any("never consults" in p for p in problems)
+
+    def test_compile_before_consult_flagged(self):
+        src = (
+            "class ServingEngine:\n"
+            "    def warmup(self):\n"
+            "        exe = self._jit.lower(z).compile()\n"
+            "        hit = self.aot_cache.load(key)\n"
+        )
+        problems = check_source(src)
+        assert any("BEFORE consulting" in p for p in problems)
+
+    def test_cli_clean_on_repo(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "check_aot_warmup.py"), REPO],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+
+
+class TestColdstartBench:
+    def test_measure_contract(self, monkeypatch):
+        monkeypatch.setenv("BENCH_COLDSTART_BUCKETS", "1,2")
+        sys.path.insert(0, REPO)
+        import bench
+
+        rec = bench.measure_coldstart()
+        assert rec["metric"] == "coldstart"
+        assert rec["buckets"] == [1, 2]
+        assert rec["cold"]["compiles"] == 2
+        assert rec["warm"]["compiles"] == 0
+        assert all(
+            r["source"] == "cache" for r in rec["warm"]["per_bucket"]
+        )
+        assert rec["speedup_cold_over_warm"] is not None
+        assert rec["aot"]["hits"] == 2 and rec["aot"]["misses"] == 2
+
+    def test_committed_evidence_schema(self):
+        path = os.path.join(REPO, "evidence", "coldstart_bench.json")
+        with open(path) as f:
+            rec = json.loads(f.read().strip().splitlines()[-1])
+        assert rec["metric"] == "coldstart"
+        assert rec["warm"]["compiles"] == 0
+        assert rec["cold"]["compiles"] == len(rec["buckets"])
+        # the committed claim: cache-hit start is measurably faster
+        assert rec["speedup_cold_over_warm"] >= 2.0
+        per = {r["bucket"]: r for r in rec["warm"]["per_bucket"]}
+        assert sorted(per) == rec["buckets"]
+        assert all(r["source"] == "cache" for r in per.values())
+
+    def test_cached_fallback_on_injected_failure(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--measure", "coldstart"],
+            capture_output=True, text=True,
+            env={**os.environ, "BENCH_FAIL_INJECT": "1",
+                 "JAX_PLATFORMS": "cpu"},
+            cwd=REPO,
+        )
+        last = json.loads(out.stdout.strip().splitlines()[-1])
+        assert last["cached"] is True
+        assert "BENCH_FAIL_INJECT" in last["probe_failure"]["error"]
+        assert last["metric"] == "coldstart"
