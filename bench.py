@@ -403,12 +403,25 @@ def run_config(
         # the measurement
         "stray_jit_recompiles": mon.recompile_count,
     }
+    # the HBM planner's predicted peak for THE program just timed, via
+    # THE planner's own peak model on the same compiled module (ISSUE 14
+    # satellite: the batch-512 resolution line records prediction NEXT TO
+    # measurement, so a planner drift is a diff in the committed BENCH
+    # artifact, not a belief)
+    predicted_peak = None
+    try:
+        from mgproto_tpu.perf.planner import _program_peak
+
+        predicted_peak, _ = _program_peak(compiled)
+    except Exception:
+        pass  # best-effort: some PJRT plugins expose no memory analysis
     return {
         "mode": "train",
         "imgs_per_sec": BATCH * ITERS / dt,
         "step_time_s": dt / ITERS,
         "compile_s": round(compile_s, 2),
         "flops_per_step": flops,
+        "planner_predicted_peak_bytes": predicted_peak,
         "device_kind": jax.devices()[0].device_kind,
         "batch": BATCH,
         "compute_dtype": compute_dtype,
@@ -1131,6 +1144,284 @@ def measure_coldstart() -> dict:
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def collective_bytes_from_hlo(hlo_text: str) -> dict:
+    """Per-chip collective traffic of a compiled SPMD module, by op kind.
+
+    Post-partitioning optimized HLO carries PER-DEVICE shapes, so summing
+    each collective op's RESULT bytes gives bytes landing on one chip per
+    step — the hermetic stand-in for the fleet observatory's
+    `allgather_bytes_total / host_local_device_count` measure, derivable
+    without running anything. Start/done async pairs are counted once: the
+    `-start` op carries the payload (its `-done` is a token), and because
+    an async start's TUPLE result also lists the ALIASED INPUT buffer
+    element, a `-start` op counts only its LARGEST tuple element (the
+    gathered output) — summing the tuple would bill input+output for one
+    transfer. Sync multi-operand collectives (a tuple reduce-scatter of
+    two tensors really does produce two results) keep the sum.
+
+    Besides per-kind totals, the result splits the two scaling families
+    the weak-scaling gate must treat differently: `gather_family` bytes
+    (all-gather / reduce-scatter / all-to-all — per-chip bytes scale with
+    the (N-1)/N gather fraction of a fixed payload) and
+    `allreduce_family` bytes (all-reduce / collective-permute — per-chip
+    result bytes are ~constant in N)."""
+    import re
+
+    dtype_bytes = {
+        "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+        "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+        "pred": 1,
+    }
+    kinds = ("all-gather", "all-reduce", "reduce-scatter",
+             "collective-permute", "all-to-all")
+    out = {k: 0 for k in kinds}
+    out["max_op"] = 0  # largest single collective result (bank-gather tell)
+    # one instruction per line: `%name = <shape(s)> <op>(`; tuple-shaped
+    # results list every element shape before the op name
+    line_re = re.compile(
+        r"=\s+(?P<shapes>[^=]*?)\s+(?P<op>" + "|".join(kinds)
+        + r")(?P<start>-start)?\("
+    )
+    shape_re = re.compile(r"(?P<dt>[a-z]+\d*|pred)\[(?P<dims>[\d,]*)\]")
+    for line in hlo_text.splitlines():
+        m = line_re.search(line)
+        if not m or f"{m.group('op')}-done" in line:
+            continue
+        elems = []
+        for sm in shape_re.finditer(m.group("shapes")):
+            dt = dtype_bytes.get(sm.group("dt"))
+            if dt is None:
+                continue
+            n = 1
+            for d in sm.group("dims").split(","):
+                if d:
+                    n *= int(d)
+            elems.append(n * dt)
+        if not elems:
+            continue
+        nbytes = max(elems) if m.group("start") else sum(elems)
+        out[m.group("op")] += nbytes
+        out["max_op"] = max(out["max_op"], nbytes)
+    out["total"] = sum(out[k] for k in kinds)
+    out["gather_family"] = (
+        out["all-gather"] + out["reduce-scatter"] + out["all-to-all"]
+    )
+    out["allreduce_family"] = out["all-reduce"] + out["collective-permute"]
+    return out
+
+
+def _weakscale_config(chips: int, per_chip_batch: int):
+    """The weak-scaling probe config: class axis sharded over ALL `chips`
+    (mesh data=1, model=chips — the axis ISSUE 14 makes first-class), the
+    global batch grown ~chips so per-chip rows stay constant (weak scaling),
+    compact EM narrower than the per-shard class slab so the shard-local
+    dirty-class gather is the compiled path."""
+    import dataclasses
+
+    from mgproto_tpu.config import MeshConfig, tiny_test_config
+
+    cfg = tiny_test_config(
+        num_classes=_env_int("BENCH_WEAKSCALE_CLASSES", 32),
+        prototypes_per_class=2,
+        proto_dim=32,
+        img_size=32,
+        # the bank must DOMINATE every other gatherable buffer (activation
+        # row-gathers at the data->model boundary top out well below it at
+        # these shapes), so the max-collective-op gate detects a leaked
+        # bank gather instead of tripping on ordinary scoring traffic
+        mem_capacity=_env_int("BENCH_WEAKSCALE_MEMCAP", 256),
+        mine_T=4,
+    )
+    return cfg.replace(
+        data=dataclasses.replace(
+            cfg.data,
+            train_batch_size=per_chip_batch * chips,
+            device_augment=False,
+        ),
+        em=dataclasses.replace(
+            cfg.em,
+            async_bank=False,  # ONE program: attribution stays simple
+            max_active_classes=_env_int("BENCH_WEAKSCALE_EM_WIDTH", 4),
+        ),
+        mesh=MeshConfig(data=1, model=chips),
+    )
+
+
+def measure_weakscale_probe(chips: int) -> dict:
+    """One weak-scaling point, run in a CHILD whose XLA_FLAGS forced
+    `chips` host-platform devices (the parent `measure_weakscale` sets the
+    env — device count is fixed at backend init, so every point needs its
+    own process). Hermetic compile-only measurement of the production
+    ShardedTrainer step at mesh (data=1, model=chips):
+
+      * per-chip BANK / OPTIMIZER / PARAM bytes — read from the LIVE
+        sharded state's own shard shapes (ground truth), with the
+        planner's shape-math prediction (perf/planner.state_bytes_per_chip
+        — the same numbers the telemetry gauges carry) beside it;
+      * per-chip collective traffic — summed from the compiled module's
+        post-partitioning HLO (collective_bytes_from_hlo), so "EM never
+        gathers another shard's bank" is a measured byte count, not a
+        docstring;
+      * per-chip flops / bytes-accessed from XLA cost analysis, folded
+        through the v5e roofline (PEAK_BF16 + DEFAULT_HBM_BYTES_PER_S)
+        into a modeled img/s/chip — the flat-within-tolerance curve
+        `mgproto-telemetry check --weakscale` gates. Modeled, not timed:
+        N virtual chips share one physical CPU, so wall time ~N would
+        measure the sandbox, not the sharding.
+    """
+    import jax
+    import numpy as np
+
+    from mgproto_tpu.obs.stall import DEFAULT_HBM_BYTES_PER_S
+    from mgproto_tpu.parallel import ShardedTrainer, make_mesh
+    from mgproto_tpu.perf.planner import state_bytes_per_chip
+
+    if jax.device_count() != chips:
+        raise RuntimeError(
+            f"probe expected {chips} devices, backend has "
+            f"{jax.device_count()} — XLA_FLAGS not honored?"
+        )
+    per_chip_batch = _env_int("BENCH_WEAKSCALE_BATCH", 4)
+    cfg = _weakscale_config(chips, per_chip_batch)
+    trainer = ShardedTrainer(
+        cfg, steps_per_epoch=10, mesh=make_mesh(data=1, model=chips)
+    )
+    state = trainer.prepare(trainer.init_state(jax.random.PRNGKey(0)))
+
+    def shard_bytes(tree) -> int:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not hasattr(leaf, "sharding"):
+                continue
+            shape = leaf.sharding.shard_shape(leaf.shape)
+            total += int(np.prod(shape)) * leaf.dtype.itemsize
+        return int(total)
+
+    b = cfg.data.train_batch_size
+    images = jax.ShapeDtypeStruct(
+        (b, cfg.model.img_size, cfg.model.img_size, 3), np.float32
+    )
+    labels = jax.ShapeDtypeStruct((b,), np.int32)
+    compiled = trainer.lower_train_step(state, images, labels).compile()
+    flops = flops_from_cost_analysis(compiled) or 0.0
+    bytes_accessed = 0.0
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        bytes_accessed = float((ca or {}).get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    collectives = collective_bytes_from_hlo(compiled.as_text())
+    peak = PEAK_BF16["v5e"]
+    modeled_step_s = max(
+        flops / peak, bytes_accessed / DEFAULT_HBM_BYTES_PER_S
+    ) or None
+    return {
+        "chips": chips,
+        "global_batch": b,
+        "per_chip_batch": per_chip_batch,
+        "num_classes": cfg.model.num_classes,
+        "classes_per_chip": cfg.model.num_classes // chips,
+        # live shard-shape ground truth
+        "bank_bytes_per_chip": shard_bytes(state.memory),
+        "opt_bytes_per_chip": (
+            shard_bytes(state.opt_state)
+            + shard_bytes(state.warm_opt_state)
+            + shard_bytes(state.proto_opt_state)
+        ),
+        "param_bytes_per_chip": shard_bytes(state.params),
+        # the planner's shape-math prediction (telemetry gauge provenance)
+        "planner": state_bytes_per_chip(cfg, chips, state=state),
+        # compiled-module measures (per-device under SPMD partitioning).
+        # The two scaling families are split because the flatness gate
+        # must normalize them differently: gather-family per-chip bytes
+        # follow S*(N-1)/N for a fixed payload S, all-reduce-family
+        # per-chip result bytes are ~constant in N.
+        "collective_bytes_per_chip_per_step": collectives,
+        "gather_bytes_per_chip_per_step": collectives["gather_family"],
+        "allreduce_bytes_per_chip_per_step": collectives[
+            "allreduce_family"
+        ],
+        "flops_per_chip_per_step": flops,
+        "bytes_accessed_per_chip_per_step": bytes_accessed,
+        "modeled_step_s": modeled_step_s,
+        "modeled_img_per_sec_per_chip": (
+            per_chip_batch / modeled_step_s if modeled_step_s else None
+        ),
+    }
+
+
+def measure_weakscale() -> dict:
+    """Hermetic weak-scaling harness (`python bench.py --measure
+    weakscale`, CPU-friendly — the ISSUE 14 deliverable): one probe child
+    per chip count (XLA host-platform virtual devices, 1 -> 2 -> 4 -> 8 by
+    default), one JSON record with the whole curve. Committed as
+    evidence/weakscale_bench.json and gated by `mgproto-telemetry check
+    --weakscale`, which RE-DERIVES every verdict from the raw entries:
+    bank/optimizer bytes per chip must shrink ~1/model_axis (>=1.8x at
+    model=2), collective bytes/chip and modeled img/s/chip must stay flat
+    within tolerance. Env knobs: BENCH_WEAKSCALE_CHIPS (default
+    "1,2,4,8"), BENCH_WEAKSCALE_BATCH / _CLASSES / _EM_WIDTH."""
+    if os.environ.get("BENCH_FAIL_INJECT"):
+        # deterministic failure for the cached-fallback contract tests
+        raise RuntimeError("BENCH_FAIL_INJECT: simulated weakscale failure")
+    import subprocess
+
+    chips_list = [
+        int(c)
+        for c in os.environ.get("BENCH_WEAKSCALE_CHIPS", "1,2,4,8")
+        .split(",") if c.strip()
+    ]
+    entries = []
+    for chips in chips_list:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={chips}"
+        )
+        # the axon sitecustomize must not redirect the child to a TPU relay
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--measure", "weakscale_probe", str(chips)],
+            capture_output=True, text=True, env=env, cwd=_BENCH_DIR,
+            timeout=_env_int("BENCH_WEAKSCALE_TIMEOUT_S", 420),
+        )
+        lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"weakscale probe chips={chips} rc={proc.returncode}: "
+                f"{(proc.stderr or proc.stdout)[-400:]}"
+            )
+        entries.append(json.loads(lines[-1]))
+    by_chips = {e["chips"]: e for e in entries}
+    summary = {}
+    if 1 in by_chips and 2 in by_chips:
+        summary["bank_reduction_at_2"] = round(
+            by_chips[1]["bank_bytes_per_chip"]
+            / max(by_chips[2]["bank_bytes_per_chip"], 1), 3
+        )
+        summary["opt_reduction_at_2"] = round(
+            by_chips[1]["opt_bytes_per_chip"]
+            / max(by_chips[2]["opt_bytes_per_chip"], 1), 3
+        )
+    return {
+        "metric": "weakscale",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "backend": "cpu (xla_force_host_platform_device_count)",
+        "mesh": "data=1, model=<chips> — the class-sharding axis",
+        "config": {
+            "per_chip_batch": _env_int("BENCH_WEAKSCALE_BATCH", 4),
+            "num_classes": _env_int("BENCH_WEAKSCALE_CLASSES", 32),
+            "em_width": _env_int("BENCH_WEAKSCALE_EM_WIDTH", 4),
+        },
+        "chips": chips_list,
+        "entries": entries,
+        "summary": summary,
+    }
+
+
 def _fail(error_obj: dict) -> None:
     """Terminal failure path: emit the live diagnostics, then — if a watcher
     window ever captured a real number — the cached result as the final line
@@ -1312,6 +1603,17 @@ if __name__ == "__main__":
             _measure_with_cached_fallback(
                 measure_coldstart, "coldstart_bench.json"
             )
+        if measure == "weakscale":
+            # hermetic 1->2->4->8 weak-scaling curve (ISSUE 14), same
+            # cached-fallback/staleness degrade machinery
+            _measure_with_cached_fallback(
+                measure_weakscale, "weakscale_bench.json"
+            )
+        if measure == "weakscale_probe":
+            # child mode of measure_weakscale: ONE chip count, whose
+            # device pool the parent fixed via XLA_FLAGS before spawn
+            print(json.dumps(measure_weakscale_probe(int(sys.argv[3]))))
+            raise SystemExit(0)
         if len(sys.argv) == 4:
             BATCH = int(sys.argv[3])
         if BATCH <= 0:

@@ -35,6 +35,7 @@ from mgproto_tpu.telemetry.registry import percentile_from_buckets
 from mgproto_tpu.telemetry.session import (
     ALLGATHER_BYTES_COUNTER,
     AUTOTUNE_REJECTED_COUNTER,
+    BANK_BYTES_GAUGE,
     BANK_OVERLAP_GAUGE,
     BARRIER_WAIT_HIST,
     COLLECTIVE_WAIT_HIST,
@@ -47,6 +48,7 @@ from mgproto_tpu.telemetry.session import (
     HOST_DEVICES_GAUGE,
     META_FILE,
     METRICS_FILE,
+    OPT_BYTES_GAUGE,
     PROM_FILE,
     SKEW_GAUGE,
     STRAGGLER_COUNTER,
@@ -578,12 +580,18 @@ def _fmt_autotune(v: Dict[str, Any]) -> str:
     """One line for the meta table: the chosen plan, its predicted peak vs
     the budget, and the rejection count (full record stays in --json)."""
     plan = v.get("plan") or {}
+    per_chip = ""
+    if plan.get("bank_bytes_per_chip") is not None:
+        per_chip = (
+            f" bank/chip={_fmt_gb(plan.get('bank_bytes_per_chip'))}"
+            f" opt/chip={_fmt_gb(plan.get('opt_bytes_per_chip'))}"
+        )
     return (
         f"plan={plan.get('name', 'none')} "
         f"peak={_fmt_gb(plan.get('peak_bytes'))} "
         f"budget={_fmt_gb(v.get('budget_bytes'))} "
         f"margin={v.get('margin')} "
-        f"rejected={v.get('rejected')}"
+        f"rejected={v.get('rejected')}" + per_chip
     )
 
 
@@ -816,6 +824,10 @@ def _host_row(last: Dict) -> Dict[str, Any]:
         last, ALLGATHER_BYTES_COUNTER, "collective"
     )
     row["allgather_bytes_per_chip"] = ag_bytes / max(devices, 1.0)
+    # weak-scaling per-chip memory (ISSUE 14): the planner-measured bank /
+    # optimizer bytes one chip holds, next to the per-chip traffic above
+    row["bank_bytes_per_chip"] = _series_value(last, BANK_BYTES_GAUGE)
+    row["opt_bytes_per_chip"] = _series_value(last, OPT_BYTES_GAUGE)
     return row
 
 
@@ -882,6 +894,8 @@ _FLEET_COLUMNS = (
     ("restarts", "restarts"),
     ("straggler", "straggler_suspected"),
     ("ag_B/chip", "allgather_bytes_per_chip"),
+    ("bank_B/chip", "bank_bytes_per_chip"),
+    ("opt_B/chip", "opt_bytes_per_chip"),
 )
 
 
@@ -1148,6 +1162,200 @@ def autoscale_gates(record: Dict[str, Any]) -> Dict[str, Any]:
             "failed": sum(not r["ok"] for r in rows), "rows": rows}
 
 
+def weakscale_gates(
+    record: Dict[str, Any],
+    shrink_min_at_2: float = 1.8,
+    shrink_rel_tol: float = 0.10,
+    flat_rel_tol: float = 0.25,
+    planner_rel_tol: float = 0.05,
+) -> Dict[str, Any]:
+    """Gate a committed weak-scaling record (`bench.py --measure
+    weakscale` -> evidence/weakscale_bench.json). Every verdict is
+    RE-DERIVED from the raw per-chip entries — never from stored summary
+    ratios, which would gate nothing:
+
+      * bank/optimizer bytes per chip shrink ~1/model_axis: >=
+        `shrink_min_at_2` at model=2 vs model=1, and within
+        `shrink_rel_tol` of the ideal 1/chips at every point;
+      * the planner's shape-math prediction (the telemetry gauges'
+        provenance) matches the LIVE shard-shape measurement;
+      * per-chip collective traffic is bounded per scaling family. No
+        single collective op may be bank-sized (max_op < bank_bytes_per_
+        chip x chips — THE leaked-bank-gather detector; the probe config
+        keeps the bank dominant over activation row-gathers). GATHER-
+        family bytes (all-gather/reduce-scatter/all-to-all) per chip per
+        GLOBAL BATCH ROW must not grow with chips — the scoring path
+        legitimately gathers each row to the class shards, so per-chip
+        gather bytes scale with the global batch; what must NOT happen is
+        growth beyond it (a state-sized gather sneaking in). ALL-REDUCE-
+        family bytes (all-reduce/collective-permute, per-chip result
+        bytes ~constant in N) are gated flat RAW. A single chip must
+        show ZERO collective bytes;
+      * modeled img/s/chip never DEGRADES: no point drops more than
+        `flat_rel_tol` below the 1-chip point or below any earlier point
+        on the curve (improvement is expected — per-chip state shrinks,
+        so the bytes-bound roofline rises — and never gated against);
+      * per-chip flops stay flat within `flat_rel_tol` of the 1-chip
+        point (the weak-scaling premise: per-chip work constant).
+    """
+    rows: List[Dict[str, Any]] = []
+
+    def gate(key, ok, why="", baseline_v=None, value=None):
+        rows.append({"key": key, "ok": bool(ok), "why": "" if ok else why,
+                     "baseline": baseline_v, "value": value,
+                     "direction": "weakscale"})
+
+    entries = {
+        e.get("chips"): e for e in (record.get("entries") or [])
+        if isinstance(e.get("chips"), int)
+    }
+    gate("weakscale.schema",
+         record.get("metric") == "weakscale" and len(entries) >= 3
+         and 1 in entries and 2 in entries,
+         f"need metric=weakscale with >=3 entries incl. chips 1 and 2; "
+         f"got {sorted(entries)}")
+    if not (1 in entries and 2 in entries):
+        return {"ok": False, "checked": len(rows),
+                "failed": sum(not r["ok"] for r in rows), "rows": rows}
+    base = entries[1]
+    multi = [entries[c] for c in sorted(entries) if c > 1]
+
+    for field, label in (("bank_bytes_per_chip", "bank"),
+                         ("opt_bytes_per_chip", "opt")):
+        b1, b2 = base.get(field), entries[2].get(field)
+        # a missing/null field is a FAILED gate row, never a crash: the
+        # ratio (and everything derived from b1) is only computed once
+        # both ends verified numeric
+        numeric = (
+            isinstance(b1, (int, float)) and not isinstance(b1, bool)
+            and isinstance(b2, (int, float)) and b2 > 0
+        )
+        ratio = b1 / b2 if numeric else None
+        gate(f"weakscale.{label}_reduction_at_2",
+             numeric and ratio >= shrink_min_at_2,
+             f"{field}: {b1} -> {b2} is "
+             + (f"{ratio:.2f}x" if ratio is not None else "not derivable")
+             + f" < {shrink_min_at_2}x",
+             baseline_v=b1, value=b2)
+        ideal_ok = numeric and all(
+            isinstance(e.get(field), (int, float))
+            and e[field] <= (b1 / e["chips"]) * (1.0 + shrink_rel_tol)
+            for e in multi
+        )
+        gate(f"weakscale.{label}_scales_inverse_chips", ideal_ok,
+             f"{field} missing or exceeding ideal bytes/chips by > "
+             f"{shrink_rel_tol:.0%} somewhere on the curve")
+
+    planner_ok, planner_why = True, ""
+    for e in entries.values():
+        for field in ("bank_bytes_per_chip", "opt_bytes_per_chip"):
+            live = e.get(field)
+            pred = (e.get("planner") or {}).get(field)
+            if not (isinstance(live, (int, float))
+                    and isinstance(pred, (int, float))) or live <= 0:
+                planner_ok, planner_why = False, f"{field} missing"
+                break
+            if abs(pred - live) > planner_rel_tol * live:
+                planner_ok = False
+                planner_why = (
+                    f"chips={e['chips']} {field}: planner {pred} vs "
+                    f"live shard shapes {live}"
+                )
+                break
+    gate("weakscale.planner_matches_live_shards", planner_ok, planner_why)
+
+    single_total = (
+        (base.get("collective_bytes_per_chip_per_step") or {}).get("total")
+    )
+    gate("weakscale.single_chip_zero_collectives", single_total == 0,
+         f"1 chip moved {single_total} collective B")
+    # THE leaked-bank-gather detector: the largest single collective
+    # result must stay below the FULL bank (bank_bytes_per_chip x chips,
+    # both raw numbers from the same entry — a gathered bank's result IS
+    # full-bank-sized). The probe config keeps the bank dominant, so
+    # ordinary scoring row-gathers sit well under this bound.
+    op_ok, op_why = True, ""
+    for e in multi:
+        cmax = (e.get("collective_bytes_per_chip_per_step") or {}).get(
+            "max_op"
+        )
+        bank_pc = e.get("bank_bytes_per_chip")
+        bank_full = (
+            bank_pc * e["chips"]
+            if isinstance(bank_pc, (int, float)) else 0
+        )
+        if not isinstance(cmax, (int, float)) or bank_full <= 0:
+            op_ok, op_why = False, f"chips={e.get('chips')}: max_op missing"
+            break
+        if cmax >= bank_full:
+            op_ok = False
+            op_why = (
+                f"chips={e['chips']}: a collective op moves {cmax} B >= "
+                f"the {bank_full} B bank — a shard gathers another's bank"
+            )
+            break
+    gate("weakscale.max_collective_op_below_bank", op_ok, op_why)
+    per_row = [
+        e["gather_bytes_per_chip_per_step"] / e["global_batch"]
+        for e in multi
+        if isinstance(e.get("gather_bytes_per_chip_per_step"), (int, float))
+        and e.get("global_batch")
+    ]
+    row_ok = len(per_row) == len(multi) and all(
+        r <= per_row[0] * (1.0 + flat_rel_tol) for r in per_row
+    )
+    gate("weakscale.gather_bytes_per_row_bounded", row_ok,
+         f"gather B/chip per global row {['%.0f' % r for r in per_row]} "
+         f"grows > {flat_rel_tol:.0%} past the first multi-chip point — "
+         "per-chip gather traffic is outpacing the global problem (a "
+         "state-sized gather crept in)",
+         value=[round(r) for r in per_row])
+    ar = [
+        e.get("allreduce_bytes_per_chip_per_step") for e in multi
+        if isinstance(e.get("allreduce_bytes_per_chip_per_step"),
+                      (int, float))
+    ]
+    ar_ok = len(ar) == len(multi) and (
+        max(ar) == 0
+        or max(ar) - min(ar) <= flat_rel_tol * max(ar)
+    )
+    gate("weakscale.allreduce_bytes_per_chip_flat", ar_ok,
+         f"all-reduce-family bytes/chip {ar} drift > {flat_rel_tol:.0%} "
+         "(per-chip reduction results should be ~constant in chips)",
+         value=ar)
+
+    v1 = base.get("modeled_img_per_sec_per_chip")
+    vm = [
+        e.get("modeled_img_per_sec_per_chip") for e in multi
+        if isinstance(e.get("modeled_img_per_sec_per_chip"), (int, float))
+    ]
+    # degradation is the failure mode; improvement (per-chip state
+    # shrinks -> the bytes-bound roofline rises) is the point of the PR
+    running_max = v1 if isinstance(v1, (int, float)) else 0.0
+    img_ok = isinstance(v1, (int, float)) and len(vm) == len(multi)
+    for v in vm:
+        if v < running_max * (1.0 - flat_rel_tol):
+            img_ok = False
+            break
+        running_max = max(running_max, v)
+    gate("weakscale.img_per_sec_per_chip_no_degradation", img_ok,
+         f"1-chip {v1} then {vm}: throughput/chip drops more than "
+         f"{flat_rel_tol:.0%} below an earlier point on the curve",
+         baseline_v=v1, value=vm)
+
+    f1 = base.get("flops_per_chip_per_step")
+    flops_ok = isinstance(f1, (int, float)) and f1 > 0 and all(
+        isinstance(e.get("flops_per_chip_per_step"), (int, float))
+        and abs(e["flops_per_chip_per_step"] - f1) <= flat_rel_tol * f1
+        for e in multi
+    )
+    gate("weakscale.flops_per_chip_flat", flops_ok,
+         "per-chip flops drift with chip count — per-chip work is not "
+         "constant, so the curve is not weak scaling", baseline_v=f1)
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
 def stall_report_gates(
     record: Dict[str, Any],
     baseline: Optional[Dict[str, Any]] = None,
@@ -1344,6 +1552,15 @@ def check_main(argv: Optional[list] = None) -> int:
                         "scale-out under the ramp, AOT-cached scale-up "
                         "warmups, p99 flat band, bounded shed, zero-drop "
                         "scale-down — exit 1 on any failure")
+    p.add_argument("--weakscale", default=None, metavar="FILE",
+                   help="gate a committed weak-scaling record (bench.py "
+                        "--measure weakscale -> evidence/weakscale_bench"
+                        ".json): bank/optimizer bytes per chip shrink "
+                        "~1/model_axis (>=1.8x at model=2), collective "
+                        "bytes/chip and img/s/chip flat within tolerance, "
+                        "planner prediction == live shard shapes — every "
+                        "verdict re-derived from raw numbers; exit 1 on "
+                        "any failure")
     p.add_argument("--stall-report", default=None, metavar="FILE",
                    help="gate a stall-budget report (scripts/"
                         "trace_report.py output): schema sanity, and with "
@@ -1417,13 +1634,19 @@ def check_main(argv: Optional[list] = None) -> int:
         result = autoscale_gates(record)
         _emit_suite("autoscale", result)
         suites_ok = suites_ok and result["ok"]
+    if args.weakscale:
+        any_suite = True
+        record = _read_json(args.weakscale, "weakscale record")
+        result = weakscale_gates(record)
+        _emit_suite("weakscale", result)
+        suites_ok = suites_ok and result["ok"]
     if args.dir is None and any_suite:
         _flush_json()
         return 0 if suites_ok else 1
     if args.dir is None or args.baseline is None:
         raise SystemExit(
             "check needs a telemetry dir AND --baseline (or --drift-drill "
-            "/ --stall-report / --autoscale FILE alone)"
+            "/ --stall-report / --autoscale / --weakscale FILE alone)"
         )
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")

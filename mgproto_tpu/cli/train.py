@@ -339,9 +339,21 @@ def run_training(
     if telem:
         # run-config context next to the metric artifacts (summarize "meta")
         from mgproto_tpu.ops.fused_epilogue import resolve_fused_epilogue
+        from mgproto_tpu.perf.planner import state_bytes_per_chip
         from mgproto_tpu.perf.precision import policy_meta
 
+        # weak-scaling per-chip state accounting (ISSUE 14): what ONE chip
+        # holds of the class-sharded bank and the per-param-sharded
+        # optimizer moments under this run's mesh — shape math over the
+        # LIVE state already in scope (no re-trace of the model init),
+        # set on the gauges so the fleet table shows per-chip memory next
+        # to the per-chip allgather bytes
+        per_chip_state = state_bytes_per_chip(
+            cfg, trainer.mesh.shape["model"], state=state
+        )
+        telem.observe_state_bytes(per_chip_state)
         telem.write_meta({
+            **per_chip_state,
             **run_meta,
             # the full mixed-precision policy (perf/precision.py): what ran
             # in which dtype, next to the throughput it bought

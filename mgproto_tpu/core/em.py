@@ -150,7 +150,9 @@ class EMAux(NamedTuple):
     log_likelihood: jax.Array  # mean E-step log-likelihood over active classes
     # 1 when compaction was enabled but more classes were dirty than the
     # compact width, so this call took the dense lax.cond branch (telemetry:
-    # em_compact_fallback_total); 0 otherwise.
+    # em_compact_fallback_total); 0 otherwise. Under the class-sharded
+    # shard_map path this is the psum'd COUNT of shards whose local slab
+    # overflowed its local width this call (each shard contributes 0/1).
     compact_fallback: jax.Array
 
 
@@ -613,6 +615,94 @@ def _compact_em_update(
     )
 
 
+def _sharded_em_update(
+    gmm: GMMState,
+    memory: Memory,
+    opt_state: optax.OptState,
+    mean_tx: optax.GradientTransformation,
+    cfg: EMConfig,
+    eps: float,
+    mesh,
+    model_size: int,
+) -> Tuple[GMMState, Memory, optax.OptState, EMAux]:
+    """Class-sharded compact EM with psum'd statistics (ISSUE 14 tentpole).
+
+    shard_map over the mesh's 'model' axis: every shard runs the FULL
+    single-device EM dispatch (`em_update` with mesh=None) on its OWN class
+    slab — its local dirty-class top_k, its local compact/dense lax.cond,
+    its local slice of the mean-Adam moments — so the dirty-class gather
+    respects shard locality (a shard only ever compacts its own classes)
+    and no shard materializes another shard's [C/S, cap, d] bank: the only
+    cross-shard traffic of the whole bank phase is the psum of the four
+    EMAux SCALARS below. The per-class sufficient statistics (Σr, Σr·x,
+    Σr·x²) stay entirely shard-local by construction — each class's bank
+    lives whole on its shard — which is what keeps per-chip bank traffic
+    flat as the model axis grows (the weak-scaling contract
+    `bench.py --measure weakscale` measures and
+    `mgproto-telemetry check --weakscale` gates).
+
+    Parity: per-class E/M math is the dense path's bit-for-bit (same
+    `_em_rounds`, same per-class gradients; Adam moments are elementwise so
+    a class-sliced step walks the identical trajectory); the psum'd scalars
+    reassociate float sums across shards, hence the usual 2e-5-grade
+    tolerance in the parity tests. `compact_fallback` becomes the COUNT of
+    shards that overflowed their local width this call (0/1 per shard,
+    psum'd — the telemetry counter semantics documented on EMAux).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from mgproto_tpu.parallel.mesh import MODEL_AXIS, shard_map_compat
+
+    c = memory.feats.shape[0]
+    c_local = c // model_size
+    # each shard compacts within its local class slab: width clips to the
+    # slab (a width >= C/S degenerates to the local dense path, which is
+    # the same bank traffic — compaction cannot help there)
+    local_cfg = dataclasses.replace(
+        cfg, max_active_classes=min(max(cfg.max_active_classes, 0), c_local)
+    )
+
+    def class_spec(tree):
+        return jax.tree.map(
+            lambda x: (
+                P(MODEL_AXIS)
+                if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == c
+                else P()
+            ),
+            tree,
+        )
+
+    in_specs = (class_spec(gmm), class_spec(memory), class_spec(opt_state))
+    aux_specs = EMAux(
+        loss=P(), num_active=P(), log_likelihood=P(), compact_fallback=P()
+    )
+    out_specs = in_specs + (aux_specs,)
+
+    def local_em(g, m, o):
+        g2, m2, o2, aux = em_update(g, m, o, mean_tx, local_cfg, eps)
+        # psum'd aggregate statistics: exactly the dense path's globals.
+        # log_likelihood is a weighted mean — un-normalize with the local
+        # active count (0 active -> numerator 0 by construction), psum
+        # numerator and denominator, renormalize.
+        n_local = aux.num_active.astype(jnp.float32)
+        ll_num = aux.log_likelihood * jnp.maximum(n_local, 1.0)
+        n = jax.lax.psum(n_local, MODEL_AXIS)
+        return g2, m2, o2, EMAux(
+            loss=jax.lax.psum(aux.loss, MODEL_AXIS),
+            num_active=n.astype(jnp.int32),
+            log_likelihood=(
+                jax.lax.psum(ll_num, MODEL_AXIS) / jnp.maximum(n, 1.0)
+            ),
+            compact_fallback=jax.lax.psum(
+                aux.compact_fallback, MODEL_AXIS
+            ),
+        )
+
+    return shard_map_compat(
+        local_em, mesh, in_specs=in_specs, out_specs=out_specs
+    )(gmm, memory, opt_state)
+
+
 def em_update(
     gmm: GMMState,
     memory: Memory,
@@ -627,17 +717,19 @@ def em_update(
 
     Dispatch (all static python branches except the one lax.cond):
       * cfg.reference_stepping: the reference-exact sequential scan.
+      * `mesh` given (a Mesh with a 'model' axis > 1, from ShardedTrainer's
+        score mesh) with the class axis sharding evenly: the class-sharded
+        shard_map path (`_sharded_em_update`) — every shard compacts its
+        OWN dirty classes and only the aggregate scalars psum across
+        shards, so no shard ever touches another's bank.
       * compaction disabled (`max_active_classes` <= 0, unresolved auto, or
-        >= C where it cannot help) or `mesh` given: the dense path.
+        >= C where it cannot help) or a non-divisible meshed class axis:
+        the dense path (GSPMD-partitioned under a mesh; the fused E-step
+        kernel then runs shard_mapped per class shard).
       * otherwise: lax.cond on the dirty count — compact slab when it fits
         the width, dense fallback (flagged in EMAux.compact_fallback) when
         it does not. Both branches compile once; steady state never
         retraces.
-
-    `mesh` (a Mesh with a 'model' axis, from ShardedTrainer's score mesh)
-    marks the class axis as sharded: compaction is disabled there (a global
-    top_k over the sharded dirty mask would defeat the per-shard locality)
-    and the fused E-step kernel runs shard_mapped per class shard instead.
     """
     if cfg.reference_stepping:
         return _reference_em_update(gmm, memory, opt_state, mean_tx, cfg, eps)
@@ -645,6 +737,13 @@ def em_update(
     c, cap, _ = memory.feats.shape
     width = cfg.max_active_classes
     if mesh is not None:
+        from mgproto_tpu.parallel.mesh import MODEL_AXIS
+
+        model_size = int(mesh.shape[MODEL_AXIS])
+        if model_size > 1 and c % model_size == 0:
+            return _sharded_em_update(
+                gmm, memory, opt_state, mean_tx, cfg, eps, mesh, model_size
+            )
         width = 0
     if width <= 0 or width >= c:
         return _dense_em_update(

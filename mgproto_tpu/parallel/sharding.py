@@ -1,25 +1,45 @@
 """Sharding specs for the MGProto train state and data batches.
 
-Layout (SURVEY.md §2.3 "TPU-native equivalent"):
+Layout (SURVEY.md §2.3 "TPU-native equivalent", grown to the weak-scaling
+layout of ISSUE 14):
 
-  * batch arrays         -> P('data')   — sharded on the leading batch axis.
-  * net params/opt state -> replicated  — the whole model is ~20M params; DP
-    replication is the right call (prototype tensors are tiny: 200x10x64).
-  * gmm / memory / EM optimizer state -> P('model') on the CLASS axis when the
-    mesh has a model axis — per-class density, enqueue and EM are all
-    class-independent, so the (B*H*W) x (C*K) density matrix and the
-    [C, cap, d] memory bank partition cleanly (SURVEY.md §5.7's
-    ImageNet-1000 stretch layout).
+  * batch arrays -> P(('data', 'model')) — sharded on the leading batch axis
+    over EVERY chip. The model-axis devices used to hold full batch replicas
+    and redundantly recompute the whole trunk; spreading the rows over both
+    axes makes the trunk weak-scale with the total chip count while the
+    class-sharded head keeps its layout (GSPMD inserts the row/class
+    reshards where the [B, C] density stack needs them).
+  * net params + Adam moments -> per-param sharded over 'model'
+    (SNIPPETS.md [2]'s per-param sharding-map pattern): each array leaf is
+    split on its LARGEST axis divisible by the model-axis size, so master
+    f32 params and both optimizer-moment trees scale ~1/model_axis per chip
+    instead of replicating — at ImageNet-1000 scale the replicated Adam
+    moments, not the model, are the first per-chip HBM funnel. Leaves with
+    no divisible axis (odd shapes, scalars) stay replicated; model axis of
+    1 reproduces the historical fully-replicated layout bit-for-bit.
+  * gmm / memory / EM optimizer state -> P('model') on the CLASS axis —
+    per-class density, enqueue and EM are all class-independent, so the
+    (B*H*W) x (C*K) density matrix and the [C, cap, d] memory bank
+    partition cleanly (SURVEY.md §5.7's ImageNet-1000 layout). The EM over
+    these shards runs shard-local with psum'd statistics (core/em.py
+    `_sharded_em_update`) — no shard ever materializes another's bank.
+
+Every TrainState field MUST have an entry in `SHARDING_RULES`: a new state
+field that nobody thought about would otherwise silently replicate — at
+bank scale that is the per-chip HBM funnel this module exists to prevent —
+so `state_partition_specs` raises on unknown fields and
+`scripts/check_sharding_coverage.py` lints the contract in tier-1.
 
 Under SPMD jit the three replica hazards of the reference become collectives
 XLA inserts for us: memory enqueue sees the global batch (all_gather over
-'data'), gradients and BatchNorm batch stats psum over 'data', and the EM
-sufficient statistics stay local to each class shard (no collective at all).
+the batch axes), gradients and BatchNorm batch stats psum over them, and
+the EM sufficient statistics stay local to each class shard.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import math
+from typing import Any, Dict
 
 import jax
 import numpy as np
@@ -27,14 +47,41 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mgproto_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
+# field -> rule for every TrainState field (core/state.py). Rules:
+#   replicate — small or step-coupled state every chip needs whole
+#   param     — per-param map: largest model_size-divisible axis -> 'model'
+#   class     — leading class axis -> 'model' (bank/EM locality contract)
+# `state_partition_specs` REFUSES fields absent from this table (see the
+# module docstring; scripts/check_sharding_coverage.py is the tier-1 gate).
+SHARDING_RULES: Dict[str, str] = {
+    "step": "replicate",
+    "params": "param",
+    "batch_stats": "replicate",  # BN running stats: tiny, read every step
+    "gmm": "class",
+    "memory": "class",
+    "opt_state": "param",  # joint Adam moments shard with their params
+    "warm_opt_state": "param",
+    "proto_opt_state": "class",  # EM mean-Adam moments: class-leading
+}
+
+
+class ShardingCoverageError(ValueError):
+    """A TrainState field has no entry in SHARDING_RULES — it would silently
+    replicate (the bank-scale per-chip HBM funnel). Add an explicit rule."""
+
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def batch_spec() -> P:
+    """Leading-axis batch partitioning over BOTH mesh axes (docstring)."""
+    return P((DATA_AXIS, MODEL_AXIS))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-axis sharding over the data axis (any rank)."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Leading-axis sharding of a batch array over every chip."""
+    return NamedSharding(mesh, batch_spec())
 
 
 def class_sharding(mesh: Mesh) -> NamedSharding:
@@ -42,12 +89,9 @@ def class_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(MODEL_AXIS))
 
 
-def _class_shard_tree(tree: Any, mesh: Mesh, num_classes: int) -> Any:
-    """Shard every leaf whose leading axis is the class axis; replicate the
-    rest (e.g. optax scalar step counters)."""
-    repl = replicated(mesh)
-    cls = class_sharding(mesh)
-    model_size = mesh.shape[MODEL_AXIS]
+def _class_spec_tree(tree: Any, num_classes: int, model_size: int) -> Any:
+    """PartitionSpec per leaf: P('model') on the leading class axis when it
+    shards evenly; P() for the rest (e.g. optax scalar step counters)."""
 
     def per_leaf(x):
         if (
@@ -56,29 +100,120 @@ def _class_shard_tree(tree: Any, mesh: Mesh, num_classes: int) -> Any:
             and x.shape[0] == num_classes
             and num_classes % model_size == 0
         ):
-            return cls
-        return repl
+            return P(MODEL_AXIS)
+        return P()
 
     return jax.tree.map(per_leaf, tree)
 
 
-def state_shardings(state: Any, mesh: Mesh, num_classes: int) -> Any:
-    """A TrainState-shaped pytree of NamedShardings for `state`."""
-    repl = replicated(mesh)
-    sh = jax.tree.map(lambda _: repl, state)
-    if mesh.shape[MODEL_AXIS] > 1:
-        sh = sh.replace(
-            gmm=_class_shard_tree(state.gmm, mesh, num_classes),
-            memory=_class_shard_tree(state.memory, mesh, num_classes),
-            proto_opt_state=_class_shard_tree(
-                state.proto_opt_state, mesh, num_classes
-            ),
+def param_partition_spec(shape, model_size: int) -> P:
+    """The per-param rule (SNIPPETS.md [2] pattern, shapes instead of a
+    name map — this state has no repeated layer stacks to wildcard): shard
+    the LARGEST axis divisible by `model_size`; ties break toward the last
+    axis (output channels for HWIO conv kernels, the conventionally-largest
+    dim). No divisible axis (or model_size 1) -> replicated."""
+    if model_size <= 1 or not shape:
+        return P()
+    best = None  # (size, axis)
+    for axis, dim in enumerate(shape):
+        if dim % model_size == 0 and dim >= model_size:
+            if best is None or dim >= best[0]:
+                best = (dim, axis)
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best[1]] = MODEL_AXIS
+    return P(*spec)
+
+
+def _param_spec_tree(tree: Any, model_size: int) -> Any:
+    return jax.tree.map(
+        lambda x: param_partition_spec(getattr(x, "shape", ()), model_size),
+        tree,
+    )
+
+
+def state_partition_specs(state: Any, num_classes: int, model_size: int) -> Any:
+    """A TrainState-shaped pytree of PartitionSpecs for `state`, from the
+    SHARDING_RULES table. Pure shape math (no mesh, no devices) so the
+    HBM planner and the coverage lint can audit it off-device; raises
+    `ShardingCoverageError` on a field the table does not name."""
+    fields = (
+        state._fields if hasattr(state, "_fields")
+        else tuple(f.name for f in state.__dataclass_fields__.values())
+    )
+    missing = [f for f in fields if f not in SHARDING_RULES]
+    if missing:
+        raise ShardingCoverageError(
+            f"TrainState field(s) {missing} have no SHARDING_RULES entry — "
+            "an unruled field silently replicates on every chip (the "
+            "bank-scale HBM funnel). Add an explicit rule in "
+            "parallel/sharding.py and re-run "
+            "scripts/check_sharding_coverage.py."
         )
-    return sh
+    out = {}
+    for f in fields:
+        sub = getattr(state, f)
+        rule = SHARDING_RULES[f]
+        if model_size <= 1 or rule == "replicate":
+            out[f] = jax.tree.map(lambda _: P(), sub)
+        elif rule == "class":
+            out[f] = _class_spec_tree(sub, num_classes, model_size)
+        elif rule == "param":
+            out[f] = _param_spec_tree(sub, model_size)
+        else:  # pragma: no cover — the table is module-local
+            raise ValueError(f"unknown sharding rule {rule!r} for {f!r}")
+    if hasattr(state, "_fields"):
+        return type(state)(**out)
+    return state.replace(**out)
+
+
+def spec_shard_factor(spec: P, model_size: int) -> int:
+    """How many ways `spec` splits an array over the model axis (the
+    divisor `bytes -> bytes-per-chip` accounting uses). The data axis is
+    not counted: state leaves never shard over it."""
+    factor = 1
+    for entry in spec:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if MODEL_AXIS in names:
+            factor *= model_size
+    return factor
+
+
+def tree_bytes_per_chip(tree: Any, spec_tree: Any, model_size: int) -> int:
+    """Per-chip bytes of `tree` under `spec_tree` (shape math only; works
+    on ShapeDtypeStructs). The weak-scaling per-chip measure: replicated
+    leaves charge full size, sharded leaves 1/factor."""
+    total = 0
+    for leaf, spec in zip(
+        jax.tree_util.tree_leaves(tree),
+        jax.tree_util.tree_leaves(
+            spec_tree, is_leaf=lambda x: isinstance(x, P)
+        ),
+    ):
+        if not hasattr(leaf, "shape"):
+            continue
+        nbytes = int(math.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        total += nbytes // spec_shard_factor(spec, model_size)
+    return int(total)
+
+
+def state_shardings(state: Any, mesh: Mesh, num_classes: int) -> Any:
+    """A TrainState-shaped pytree of NamedShardings for `state` — the spec
+    tree from `state_partition_specs` bound to `mesh`."""
+    specs = state_partition_specs(
+        state, num_classes, mesh.shape[MODEL_AXIS]
+    )
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def put_batch(batch: Any, mesh: Mesh) -> Any:
-    """Place a host batch onto the mesh, sharded on the data axis.
+    """Place a host batch onto the mesh, sharded on the leading batch axis
+    over every chip.
 
     Single-process: a plain sharded device_put of the global batch.
     Multi-host: each process passes its LOCAL shard of the global batch and

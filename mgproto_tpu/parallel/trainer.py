@@ -67,13 +67,18 @@ class ShardedTrainer(Trainer):
             cfg.mesh.data, cfg.mesh.model
         )
         n_data = self.mesh.shape["data"]
+        n_model = self.mesh.shape["model"]
         nproc = jax.process_count()
         if n_data % nproc != 0:
             raise ValueError(
                 f"mesh data axis ({n_data}) must be divisible by the process "
                 f"count ({nproc}) so every host owns whole data shards"
             )
-        local_chips = n_data // nproc
+        # batches shard over BOTH mesh axes (parallel/sharding.py
+        # batch_spec): the model-axis devices carry batch rows too instead
+        # of redundantly recomputing the whole trunk per class shard, so
+        # the divisibility unit is this process's share of ALL chips
+        local_chips = (n_data * n_model) // nproc
         for name, b in (
             ("train_batch_size", cfg.data.train_batch_size),
             ("test_batch_size", cfg.data.test_batch_size),
@@ -84,8 +89,9 @@ class ShardedTrainer(Trainer):
             if b % local_chips != 0:
                 raise ValueError(
                     f"data.{name}={b} (per process) must be divisible by this "
-                    f"process's data-axis share ({local_chips} of {n_data} "
-                    "devices); adjust --batch_size or --mesh_data"
+                    f"process's share of the mesh ({local_chips} of "
+                    f"{n_data}x{n_model} devices); adjust --batch_size or "
+                    "the mesh axes"
                 )
         self._repl = replicated(self.mesh)
         self._batch_sh = batch_sharding(self.mesh)
@@ -211,6 +217,7 @@ class ShardedTrainer(Trainer):
         )
         # telemetry recompile detection must watch the REAL jit objects, not
         # the dispatching lambda above (which has no _cache_size)
+        self._step_jits = jits  # warm -> jit (lower_train_step reads this)
         self._jit_handles = (
             list(jits.values()) + list(trunk_jits.values())
             + [self._bank_jit, self._eval_step]
@@ -222,6 +229,26 @@ class ShardedTrainer(Trainer):
         if self._state_sh is None:
             self._build_jits(sh)
         return jax.device_put(state, sh)
+
+    def lower_train_step(self, state, images, labels, seeds=None,
+                         warm: bool = False):
+        """Lower (NOT compile) the monolithic SPMD train step for one
+        operand set — the weak-scaling harness's measurement hook
+        (`bench.py --measure weakscale` reads the compiled module's
+        cost/memory analysis and collective byte counts from it; the same
+        program `scripts/launch_pod.sh` runs on real hardware). Operands
+        may be jax.Arrays or ShapeDtypeStructs; `prepare` must have built
+        the sharded jits first."""
+        import jax.numpy as jnp
+
+        if self._state_sh is None:
+            raise RuntimeError("call prepare(state) before lower_train_step")
+        if seeds is None:
+            seeds = jax.ShapeDtypeStruct((images.shape[0],), jnp.uint32)
+        return self._step_jits[bool(warm)].lower(
+            state, images, labels, seeds,
+            jnp.asarray(1.0, jnp.float32), jnp.asarray(True, bool),
+        )
 
     def init_state(self, rng: jax.Array, for_restore: bool = False) -> TrainState:
         return self.prepare(super().init_state(rng, for_restore=for_restore))

@@ -114,6 +114,17 @@ class PlanReport:
             "compute_dtype": self.candidate.compute_dtype,
             "peak_bytes": int(self.peak_bytes),
             "fits": bool(self.fits),
+            # the weak-scaling per-chip sharded-state measure (ISSUE 14):
+            # ride into the meta.json "autotune" block so the telemetry
+            # gauges of the same names have a recorded provenance
+            **{
+                k: int(self.detail[k])
+                for k in (
+                    "bank_bytes_per_chip", "opt_bytes_per_chip",
+                    "param_bytes_per_chip",
+                )
+                if k in self.detail
+            },
             **({"error": self.error} if self.error else {}),
         }
 
@@ -219,15 +230,73 @@ apply_plan = plan_config  # the public name run_training uses
 
 
 def data_axis_size(cfg) -> int:
-    """Devices on the mesh's data axis for this config — the divisor that
-    turns a GLOBAL candidate batch into the per-chip batch one device
-    actually materializes."""
+    """Devices on the mesh's data axis for this config."""
     import jax
 
     n_model = max(int(cfg.mesh.model), 1)
     if cfg.mesh.data == -1:
         return max(jax.device_count() // n_model, 1)
     return max(int(cfg.mesh.data), 1)
+
+
+def batch_shard_size(cfg) -> int:
+    """Devices one GLOBAL batch splits over — the divisor that turns a
+    candidate batch into the per-chip batch one device materializes. Since
+    the weak-scaling layout (parallel/sharding.py batch_spec) batch rows
+    shard over BOTH mesh axes, so the divisor is the whole mesh."""
+    return data_axis_size(cfg) * max(int(cfg.mesh.model), 1)
+
+
+def state_bytes_per_chip(
+    cfg, model_size: Optional[int] = None, state=None
+) -> Dict[str, int]:
+    """Per-chip bytes of the sharded TrainState groups under the
+    weak-scaling layout (parallel/sharding.py state_partition_specs) —
+    pure shape math over an eval_shape state, no device work:
+
+      bank_bytes_per_chip  — the [C, cap, d] memory bank + bookkeeping
+      opt_bytes_per_chip   — Adam moments: joint + warm + EM-mean trees
+      param_bytes_per_chip — master f32 params (per-param map)
+
+    These are the telemetry gauges of the same names (ISSUE 14), the
+    planner candidate detail, and the raw numbers `bench.py --measure
+    weakscale` cross-checks against live shard shapes.
+
+    `state` (a TrainState-shaped pytree of arrays or ShapeDtypeStructs)
+    skips the eval_shape — callers that already traced one
+    (measure_candidate per candidate) pass it instead of re-tracing."""
+    import jax
+
+    from mgproto_tpu.parallel.sharding import (
+        state_partition_specs,
+        tree_bytes_per_chip,
+    )
+
+    m = max(int(cfg.mesh.model), 1) if model_size is None else int(model_size)
+    if state is None:
+        from mgproto_tpu.core.state import create_train_state
+
+        state = jax.eval_shape(
+            lambda rng: create_train_state(
+                cfg, 100, rng, for_restore=True
+            )[0],
+            jax.random.PRNGKey(0),
+        )
+    specs = state_partition_specs(state, cfg.model.num_classes, m)
+
+    def group(*fields):
+        return sum(
+            tree_bytes_per_chip(getattr(state, f), getattr(specs, f), m)
+            for f in fields
+        )
+
+    return {
+        "bank_bytes_per_chip": group("memory"),
+        "opt_bytes_per_chip": group(
+            "opt_state", "warm_opt_state", "proto_opt_state"
+        ),
+        "param_bytes_per_chip": group("params"),
+    }
 
 
 def lower_split_programs(trainer, state, images, labels, seeds, use_mine,
@@ -284,6 +353,7 @@ def measure_candidate(base_cfg, cand: PlanCandidate) -> Tuple[int, Dict]:
 
     cfg = plan_config(base_cfg, cand)
     trainer = Trainer(cfg, steps_per_epoch=100, donate=True)
+    n_model = max(int(cfg.mesh.model), 1)
     # shapes only: lowering accepts ShapeDtypeStructs, so no candidate ever
     # allocates a real state (or loads pretrained weights — for_restore
     # skips that too, and eval_shape never runs the init anyway)
@@ -292,7 +362,7 @@ def measure_candidate(base_cfg, cand: PlanCandidate) -> Tuple[int, Dict]:
         jax.random.PRNGKey(0),
     )
     m = cfg.model
-    per_chip = max(cand.batch // data_axis_size(cfg), 1)
+    per_chip = max(cand.batch // batch_shard_size(cfg), 1)
     img_dtype = jnp.uint8 if trainer._device_augment else jnp.float32
     images = jax.ShapeDtypeStruct(
         (per_chip, m.img_size, m.img_size, 3), img_dtype
@@ -336,6 +406,15 @@ def measure_candidate(base_cfg, cand: PlanCandidate) -> Tuple[int, Dict]:
     detail["bank_bytes_analytic"] = memory_nbytes(
         m.num_classes, m.mem_capacity, m.proto_dim
     )
+    # per-chip sharded-state accounting (ISSUE 14): what one chip actually
+    # holds of the bank / optimizer moments / master params under the
+    # weak-scaling layout — the bank_bytes_per_chip / opt_bytes_per_chip
+    # telemetry gauges and the `check --weakscale` raw numbers. The
+    # compiled-module peak above still charges class-sharded state
+    # unsharded (a deliberate conservative over-count); these fields are
+    # the sharded truth beside it. Reuses the shape state already traced
+    # above — no second eval_shape per candidate.
+    detail.update(state_bytes_per_chip(cfg, n_model, state=state))
     return int(program_peak + prefetch), detail
 
 

@@ -78,6 +78,15 @@ STRAGGLER_COUNTER = "straggler_suspected_total"
 ALLGATHER_BYTES_COUNTER = "allgather_bytes_total"
 HOST_DEVICES_GAUGE = "host_local_device_count"
 
+# weak-scaling per-chip state (ISSUE 14): what ONE chip holds of the
+# class-sharded memory bank and the per-param-sharded optimizer moments
+# (planner-measured shape math, perf/planner.py state_bytes_per_chip).
+# Pre-registered at zero; set by cli/train at startup and by
+# observe_autotune when a plan is chosen, so the fleet table can show
+# per-chip memory next to per-chip allgather bytes.
+BANK_BYTES_GAUGE = "bank_bytes_per_chip"
+OPT_BYTES_GAUGE = "opt_bytes_per_chip"
+
 
 def _is_primary_host() -> bool:
     from mgproto_tpu.parallel.multihost import is_primary_host
@@ -236,6 +245,28 @@ class TelemetrySession:
             g_dev.set(float(jax.local_device_count()))
         except Exception:
             g_dev.set(1.0)
+        # weak-scaling per-chip state gauges (ISSUE 14): explicit zeros
+        # until cli/train (or an autotune outcome) measures them
+        self._g_bank_bytes = self.registry.gauge(
+            BANK_BYTES_GAUGE,
+            "bytes of the class-sharded memory bank ONE chip holds "
+            "(planner shape math; ~1/model_axis as chips grow)",
+        )
+        self._g_bank_bytes.set(0.0)
+        self._g_opt_bytes = self.registry.gauge(
+            OPT_BYTES_GAUGE,
+            "bytes of optimizer state (joint+warm+EM-mean Adam moments) "
+            "ONE chip holds under the per-param sharding map",
+        )
+        self._g_opt_bytes.set(0.0)
+
+    def observe_state_bytes(self, per_chip: Dict[str, Any]) -> None:
+        """Record the planner's per-chip sharded-state measure
+        (perf/planner.py state_bytes_per_chip dict) into the gauges."""
+        if per_chip.get("bank_bytes_per_chip") is not None:
+            self._g_bank_bytes.set(float(per_chip["bank_bytes_per_chip"]))
+        if per_chip.get("opt_bytes_per_chip") is not None:
+            self._g_opt_bytes.set(float(per_chip["opt_bytes_per_chip"]))
 
     def observe_em(self, active_classes: float, compact_fallbacks: float = 0.0):
         """Record one epoch's EM fast-path outcome (host floats — callers
@@ -247,9 +278,12 @@ class TelemetrySession:
     def observe_autotune(self, outcome) -> None:
         """Record an HBM auto-tuner run (perf/planner.py PlanOutcome): the
         chosen plan + every candidate's predicted peak land in meta.json
-        ("autotune"), rejected candidates increment the counter."""
+        ("autotune"), rejected candidates increment the counter, and the
+        chosen plan's per-chip bank/optimizer bytes land on the gauges."""
         if outcome.rejected:
             self._c_autotune_rejected.inc(float(outcome.rejected))
+        if outcome.chosen is not None:
+            self.observe_state_bytes(outcome.chosen.to_meta())
         self.write_meta({"autotune": outcome.to_meta()})
 
     def write_meta(self, meta: Dict[str, Any]) -> None:

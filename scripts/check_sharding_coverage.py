@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Lint: every TrainState leaf must get an EXPLICIT PartitionSpec.
+
+The weak-scaling layout (ISSUE 14) lives or dies on coverage: a new
+TrainState field nobody added a sharding rule for would silently replicate
+onto every chip — at bank scale (C=1000, P=10 000) a replicated bank or
+optimizer-moment tree IS the per-chip HBM funnel, and it fails as an OOM
+mid-run, not as a review comment. `parallel/sharding.py` therefore keys its
+layout off an explicit `SHARDING_RULES` table and
+`state_partition_specs` raises on any field the table does not name. This
+lint drives that contract in tier-1:
+
+  1. builds a shape-only TrainState (jax.eval_shape — no arrays, no
+     pretrained load) for a tiny config and asks `state_partition_specs`
+     for the full spec tree at a model axis of 2: an unruled field raises
+     `ShardingCoverageError` here, failing the lint;
+  2. audits the spec tree: every leaf must resolve to a PartitionSpec
+     (never None / a missing entry), and the large state groups that exist
+     to be sharded (memory bank, gmm, EM moments, params, both optimizer
+     states) must each contain at least one 'model'-sharded leaf — a rules
+     edit that silently turns a sharded group fully replicated fails;
+  3. cross-checks the table against the LIVE TrainState dataclass, so a
+     field added to core/state.py without a rule fails even if callers
+     never reached state_partition_specs yet.
+
+Run from anywhere:  python scripts/check_sharding_coverage.py [repo_root]
+Exit 0 when clean, 1 with one finding per line otherwise. Wired into
+tier-1 via tests/test_weakscale.py (including a violation-detection test
+that feeds a state with an unruled extra field).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+
+def audit_state(state, num_classes: int, model_size: int = 2) -> List[str]:
+    """Findings for one TrainState-shaped pytree (the testable core: the
+    violation-detection test feeds a doctored state here)."""
+    from jax.sharding import PartitionSpec as P
+
+    import jax
+    from mgproto_tpu.parallel.sharding import (
+        MODEL_AXIS,
+        ShardingCoverageError,
+        state_partition_specs,
+    )
+
+    try:
+        specs = state_partition_specs(state, num_classes, model_size)
+    except ShardingCoverageError as e:
+        return [f"sharding coverage: {e}"]
+    found: List[str] = []
+    fields = (
+        state._fields if hasattr(state, "_fields")
+        else tuple(state.__dataclass_fields__)
+    )
+
+    def leaf_specs(field):
+        return jax.tree_util.tree_leaves(
+            getattr(specs, field), is_leaf=lambda x: isinstance(x, P)
+        )
+
+    for field in fields:
+        n_state = len(jax.tree_util.tree_leaves(getattr(state, field)))
+        sp = leaf_specs(field)
+        if len(sp) != n_state or any(not isinstance(s, P) for s in sp):
+            found.append(
+                f"sharding coverage: field {field!r} resolved "
+                f"{len(sp)} specs for {n_state} leaves — every leaf must "
+                "get an explicit PartitionSpec"
+            )
+    # the groups whose whole purpose is to shard must actually shard
+    def model_sharded(field):
+        return any(
+            any(
+                MODEL_AXIS in (e if isinstance(e, tuple) else (e,))
+                for e in (s or ())
+            )
+            for s in leaf_specs(field)
+        )
+
+    for field in ("memory", "gmm", "proto_opt_state", "params",
+                  "opt_state", "warm_opt_state"):
+        if field in fields and not model_sharded(field):
+            found.append(
+                f"sharding coverage: no leaf of {field!r} shards over "
+                f"'{MODEL_AXIS}' at model={model_size} — the group that "
+                "exists to scale ~1/model_axis is fully replicated"
+            )
+    return found
+
+
+def findings(repo_root: str) -> List[str]:
+    sys.path.insert(0, repo_root)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.core.state import TrainState, create_train_state
+    from mgproto_tpu.parallel.sharding import SHARDING_RULES
+
+    found: List[str] = []
+    # (3) table <-> dataclass cross-check (catches a new field before any
+    # caller builds a spec tree for it)
+    state_fields = set(TrainState.__dataclass_fields__)
+    unruled = sorted(state_fields - set(SHARDING_RULES))
+    if unruled:
+        found.append(
+            f"sharding coverage: TrainState field(s) {unruled} missing "
+            "from SHARDING_RULES (parallel/sharding.py)"
+        )
+    stale = sorted(set(SHARDING_RULES) - state_fields)
+    if stale:
+        found.append(
+            f"sharding coverage: SHARDING_RULES names vanished field(s) "
+            f"{stale} — prune the table"
+        )
+    # (1)+(2) shape-only audit at a class count the model axis divides
+    cfg = tiny_test_config(num_classes=4)
+    state = jax.eval_shape(
+        lambda rng: create_train_state(cfg, 10, rng, for_restore=True)[0],
+        jax.random.PRNGKey(0),
+    )
+    found.extend(audit_state(state, cfg.model.num_classes, model_size=2))
+    return found
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    found = findings(root)
+    for f in found:
+        print(f)
+    if found:
+        return 1
+    print("check_sharding_coverage: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

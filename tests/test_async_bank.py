@@ -38,12 +38,12 @@ def _cfg(async_bank=None):
     return cfg.replace(em=dataclasses.replace(cfg.em, async_bank=async_bank))
 
 
-def _batches(n, seed=0, img=32, classes=4):
+def _batches(n, seed=0, img=32, classes=4, b=BATCH):
     rng = np.random.RandomState(seed)
     return [
         (
-            jnp.asarray(rng.rand(BATCH, img, img, 3), jnp.float32),
-            jnp.asarray(rng.randint(0, classes, size=(BATCH,)), jnp.int32),
+            jnp.asarray(rng.rand(b, img, img, 3), jnp.float32),
+            jnp.asarray(rng.randint(0, classes, size=(b,)), jnp.int32),
         )
         for _ in range(n)
     ]
@@ -230,9 +230,12 @@ def test_async_converges_on_short_synthetic_run():
 
 def test_async_sharded_dryrun_multichip():
     """ShardedTrainer splits the same way: the pipelined sharded run on the
-    virtual 8-device mesh (class axis sharded over 'model') matches the
+    virtual 8-device mesh (class axis sharded over 'model', batch rows over
+    BOTH axes, EM shard-local with psum'd statistics) matches the
     single-device pipelined run — enqueue sees the global batch and the
-    psum'd EM statistics stay correct under one-step staleness."""
+    psum'd EM statistics stay correct under one-step staleness. Batch 8:
+    rows shard over every chip of the 4x2 mesh (parallel/sharding.py
+    batch_spec), so direct callers feed a row count all 8 can split."""
     from mgproto_tpu.parallel import ShardedTrainer, make_mesh
 
     cfg = _cfg(async_bank=True)
@@ -242,7 +245,7 @@ def test_async_sharded_dryrun_multichip():
     state_sh = sh.prepare(state0)
 
     s1, s2 = state0, state_sh
-    for imgs, lbls in _batches(3, seed=5, classes=4):
+    for imgs, lbls in _batches(3, seed=5, classes=4, b=8):
         s1, m1 = ref.train_step(s1, imgs, lbls, use_mine=True,
                                 update_gmm=True)
         s2, m2 = sh.train_step(s2, np.asarray(imgs), np.asarray(lbls),

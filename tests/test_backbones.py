@@ -125,7 +125,20 @@ def test_converter_handles_bbn_inat_key_renames():
 
 def test_remat_preserves_outputs_params_and_grads():
     """remat=True must change only the backward-pass schedule: identical
-    params tree, outputs, and gradients (models/resnet.py block remat)."""
+    params tree, outputs, and gradients (models/resnet.py block remat).
+
+    Gradient tolerance (root-caused 2026-08-04, the long-known-failing
+    seed red): remat RECOMPUTES the forward inside the backward, and XLA
+    fuses/reassociates the recomputed subgraph differently from the stored
+    one, so f32 gradients differ by accumulated rounding — NOT by math.
+    Measured: worst relative grad diff ~1.6e-4 in f32 (2 of 64 elements of
+    one leaf past the old rtol=1e-4 band), collapsing to 2.2e-9 when the
+    identical program runs in float64 (rounding vanishes with precision;
+    a real schedule/semantics bug would not). rtol=1e-3 sits an order of
+    magnitude above the measured f32 reassociation noise and three below
+    any semantic failure (a dropped loss term or doubled block shows up at
+    O(1)). The weak-scaling flagship trains under remat_l1, so this
+    contract has to be green, not red-with-a-story."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -149,6 +162,7 @@ def test_remat_preserves_outputs_params_and_grads():
 
         outs.append(net.apply(v, x, train=False))
         grads.append(jax.grad(loss)(v["params"]))
+    # the FORWARD never recomputes: bit-comparable tolerance stays tight
     np.testing.assert_allclose(
         np.asarray(outs[0]), np.asarray(outs[1]), rtol=1e-5, atol=1e-5
     )
@@ -161,8 +175,14 @@ def test_remat_preserves_outputs_params_and_grads():
         jax.tree_util.tree_leaves(grads[1]),
         strict=True,
     ):
+        a, b = np.asarray(a), np.asarray(b)
+        # reassociation noise is proportional to the LARGEST terms summed
+        # into an element, not the (possibly cancelled) element itself —
+        # near-zero elements of an otherwise-large leaf carry absolute
+        # error at the leaf's scale, so the atol band is leaf-scaled
         np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            a, b, rtol=1e-3,
+            atol=1e-4 * max(float(np.abs(b).max()), 1e-4),
         )
 
 
