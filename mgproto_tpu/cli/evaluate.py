@@ -39,10 +39,13 @@ def main(argv: Optional[list] = None) -> None:
         help="checkpoint path ('auto' = latest in --model_dir)",
     )
     p.add_argument(
-        "--ood_score",
+        "--ood_score", "--score_rule",
+        dest="ood_score",
         default="sum",
         choices=["sum", "max", "paper"],
-        help="OoD operating-point rule: 'sum' = the reference's inherited "
+        help="OoD operating-point rule (alias: --score_rule, matching the "
+             "engine's evaluate_with_ood parameter name): 'sum' = the "
+             "reference's inherited "
              "sum_c p(x|c) threshold (with its C-fold asymmetry, kept for "
              "parity); 'max' = max_c p(x|c), which rescues broad-response "
              "near-OoD (evidence/README.md); 'paper' = log p(x) on BOTH "

@@ -58,6 +58,16 @@ def main(argv: Optional[list] = None) -> None:
                    help="ID percentile for the default abstention "
                         "operating point (matches evaluate_with_ood's "
                         "threshold convention)")
+    p.add_argument("--explain", action="store_true",
+                   help="stage the EXPLAIN program beside the plain one "
+                        "(explain.stablehlo + explain.json: top activated "
+                        "prototypes per request, mixture priors, and "
+                        "nearest-training-patch provenance from the run's "
+                        "push_provenance.json when present) — "
+                        "mgproto-serve --explain then serves explanations "
+                        "from the artifact with no training run")
+    p.add_argument("--explain_top", type=int, default=5,
+                   help="prototypes per explanation (most activated first)")
     p.add_argument("--aot-cache", "--aot_cache", dest="aot_cache",
                    action="store_true",
                    help="prebuild the AOT executable cache beside the "
@@ -105,11 +115,40 @@ def main(argv: Optional[list] = None) -> None:
         calib = calibrate_from_config(
             cfg, trainer, state, percentile=args.calib_percentile
         )
-    save_artifact(args.out, exported, meta, calibration=calib)
+    explain = None
+    if args.explain:
+        from mgproto_tpu.engine.export import (
+            explain_table,
+            export_explain,
+        )
+
+        from mgproto_tpu.engine.push import load_push_provenance
+
+        provenance = load_push_provenance(cfg.model_dir)
+        if provenance is not None:
+            print(f"explain provenance: {cfg.model_dir}/push_provenance.json")
+        else:
+            print(
+                "explain provenance: none (no push_provenance.json in "
+                f"{cfg.model_dir}; explanations will carry prototype "
+                "identity + prior + density but no source patches)"
+            )
+        explain = (
+            export_explain(
+                trainer, state, top_e=args.explain_top,
+                dynamic_batch=dynamic,
+                static_batch=max(args.static_batch, 1),
+            ),
+            explain_table(state, provenance=provenance),
+        )
+    save_artifact(
+        args.out, exported, meta, calibration=calib, explain=explain
+    )
     line = {
         "artifact": args.out,
         "bytes": os.path.getsize(args.out),
         "calibrated": calib is not None,
+        "explain": explain is not None,
         **{k: meta[k] for k in ("arch", "num_classes", "img_size",
                                 "dynamic_batch", "checkpoint",
                                 "gmm_fingerprint")},

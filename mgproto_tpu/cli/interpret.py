@@ -33,6 +33,26 @@ from mgproto_tpu.utils import latest_checkpoint, restore_checkpoint
 from mgproto_tpu.utils.checkpoint import adopt_checkpoint_train_config
 
 
+def build_eval_loader(cfg, cub_root: str) -> DataLoader:
+    """Squash-resize eval loader over the CUB test split — the reference
+    eval scripts' transform (interpretability.py:29-33 Resize((img,img)),
+    NOT the center-crop test pipeline), so part coordinates scaled by
+    width/height line up with the activation grid. Sharded by process;
+    shared with `mgproto-trust interp` (the sharded evaluators)."""
+    dataset = Cub2011Eval(
+        cub_root, train=False, transform=ood_transform(cfg.model.img_size)
+    )
+    return DataLoader(
+        dataset,
+        cfg.data.test_batch_size,
+        num_workers=cfg.data.num_workers,
+        # resize-only pipeline: not GIL-bound, thread workers suffice;
+        # per-process shard: collect_gt_activations allgathers rows
+        shard_index=jax.process_index(),
+        shard_count=jax.process_count(),
+    )
+
+
 def main(argv: Optional[list] = None) -> None:
     p = argparse.ArgumentParser(
         description="Prototype interpretability metrics (reference eval_*.py)"
@@ -61,22 +81,7 @@ def main(argv: Optional[list] = None) -> None:
     cfg = config_from_args(args)
 
     parts = CubParts(args.cub_root)
-    # squash-resize + normalize: the reference eval scripts' transform
-    # (interpretability.py:29-33 Resize((img,img)) — NOT the center-crop test
-    # pipeline), so part coordinates scaled by width/height line up with the
-    # activation grid
-    dataset = Cub2011Eval(
-        args.cub_root, train=False, transform=ood_transform(cfg.model.img_size)
-    )
-    loader = DataLoader(
-        dataset,
-        cfg.data.test_batch_size,
-        num_workers=cfg.data.num_workers,
-        # resize-only pipeline: not GIL-bound, thread workers suffice
-        # per-process shard: collect_gt_activations allgathers rows globally
-        shard_index=jax.process_index(),
-        shard_count=jax.process_count(),
-    )
+    loader = build_eval_loader(cfg, args.cub_root)
 
     path = (
         latest_checkpoint(cfg.model_dir)

@@ -156,7 +156,8 @@ def make_engine_factory(
         def factory():
             return ServingEngine.from_artifact(
                 path, allow_uncalibrated=allow, monitor=_monitor(),
-                aot_cache=cache, aot_fingerprint=aot_fp, **_kw()
+                aot_cache=cache, aot_fingerprint=aot_fp,
+                explain=args.explain, **_kw()
             )
 
         return factory
@@ -207,10 +208,20 @@ def make_engine_factory(
 
         aot_fp = pytree_digest(state)
 
+    provenance = None
+    if args.explain:
+        # nearest-training-patch table the run's push stage left behind
+        # (cli/train.py); absent = explanations without source patches
+        from mgproto_tpu.engine.push import load_push_provenance
+
+        provenance = load_push_provenance(cfg.model_dir)
+
     def factory():
         return ServingEngine.from_live(
             trainer, state, calibration=calib, monitor=_monitor(),
-            aot_cache=cache, aot_fingerprint=aot_fp, **_kw()
+            aot_cache=cache, aot_fingerprint=aot_fp,
+            explain=args.explain, explain_top=args.explain_top,
+            provenance=provenance, **_kw()
         )
 
     # the online plane (--online) needs the heavy live context the factory
@@ -385,6 +396,19 @@ def main(argv: Optional[list] = None) -> None:
                    help="autoscaler decision cadence (pump-hook polling "
                         "on the plane's clock; never sleeps)")
     # performance observatory (ISSUE 8)
+    p.add_argument("--explain", action="store_true",
+                   help="serve prototype explanations: predict outcomes "
+                        "gain an `explain` block (top activated "
+                        "prototypes with class, mixture prior, peak "
+                        "log-density, nearest-training-patch provenance). "
+                        "Artifact face needs an --explain export; live "
+                        "face reads push_provenance.json when present. "
+                        "Off = the plain program, zero per-request cost.")
+    p.add_argument("--explain_top", type=int, default=5,
+                   help="live face: prototypes per explanation (most "
+                        "activated first). The artifact face's depth is "
+                        "baked into the explain program at export time "
+                        "(mgproto-export --explain_top).")
     p.add_argument("--trace_requests", action="store_true",
                    help="end-to-end request tracing: frontend->batcher->"
                         "replica->engine stage spans in the telemetry "
@@ -533,9 +557,13 @@ def _swap_factory(args, path: str) -> Callable:
         aot_fp = artifact_aot_fingerprint(path)  # hashed once, not per engine
 
     def factory():
+        # a swap target must match the blue fleet's response contract: an
+        # --explain fleet only accepts green artifacts that carry the
+        # explain program (from_artifact refuses loudly otherwise)
         return ServingEngine.from_artifact(
             path, allow_uncalibrated=args.allow_uncalibrated,
-            aot_cache=cache, aot_fingerprint=aot_fp, **kw
+            aot_cache=cache, aot_fingerprint=aot_fp,
+            explain=getattr(args, "explain", False), **kw
         )
 
     return factory

@@ -364,6 +364,60 @@ def _drift_section(last: Dict) -> Optional[Dict[str, Any]]:
     return section
 
 
+def _trust_section(last: Dict, d: str) -> Optional[Dict[str, Any]]:
+    """Trust-verification story (ISSUE 15): matrix cells evaluated,
+    per-pair AUROC, per-cell abstention/answered-accuracy extremes,
+    calibration drift on the served sketch, sharded interpretability
+    metric values, and the newest trust_report*.json's verdict tally.
+    Present whenever the trust_* family is in the snapshot (pre-registered
+    — explicit zeros mean "nothing verified this run", which an operator
+    should see); None only for pre-trust telemetry dirs."""
+    from mgproto_tpu.trust import metrics as tm  # jax-free
+
+    if not any(
+        name in last for name in tm.ALL_COUNTERS + tm.ALL_GAUGES
+    ):
+        return None
+    aurocs = _series_by_label(last, tm.PAIR_AUROC, "pair")
+    abst = _series_by_label(last, tm.ABSTENTION_RATE, "cell")
+    acc = _series_by_label(last, tm.ANSWERED_ACCURACY, "cell")
+    section: Dict[str, Any] = {
+        "cells_by_kind": _series_by_label(last, tm.MATRIX_CELLS, "kind"),
+        "pair_auroc": aurocs,
+        "min_pair_auroc": min(aurocs.values()) if aurocs else None,
+        "max_abstention_rate": max(abst.values()) if abst else None,
+        "min_answered_accuracy": min(acc.values()) if acc else None,
+        "px_divergence": _series_value(last, tm.PX_DIVERGENCE),
+        "verdicts": _series_by_label(last, tm.VERDICTS, "result"),
+        "interp_consistency": _series_value(last, tm.INTERP_CONSISTENCY),
+        "interp_stability": _series_value(last, tm.INTERP_STABILITY),
+        "interp_purity": _series_value(last, tm.INTERP_PURITY),
+    }
+    # the newest trust report living beside the metrics, reduced to its
+    # verdict line (full rows stay in the report file / check --trust)
+    import glob as _glob
+
+    reports = sorted(
+        _glob.glob(os.path.join(d, "trust_report*.json")),
+        key=os.path.getmtime,
+    )
+    if reports:
+        try:
+            with open(reports[-1]) as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            rep = None
+        if rep and rep.get("trust_report"):
+            gates = rep.get("gates") or {}
+            section["report"] = os.path.basename(reports[-1])
+            section["report_gates"] = {
+                "checked": gates.get("checked"),
+                "failed": gates.get("failed"),
+                "ok": gates.get("ok"),
+            }
+    return section
+
+
 def summarize(telemetry_dir: str) -> Dict[str, Any]:
     """The whole summary as one JSON-able dict."""
     d = resolve_dir(telemetry_dir)
@@ -482,6 +536,10 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     drift = _drift_section(last)
     if drift is not None:
         summary["drift"] = drift
+
+    trust = _trust_section(last, d)
+    if trust is not None:
+        summary["trust"] = trust
 
     if health:
         traj = {}
@@ -666,6 +724,14 @@ def render_table(summary: Dict[str, Any]) -> str:
     if "drift" in summary:
         section("drift (online learning)")
         for k, v in summary["drift"].items():
+            if isinstance(v, dict):
+                v = " ".join(
+                    f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())
+                ) or "-"
+            rows.append((k, v))
+    if "trust" in summary:
+        section("trust (robustness matrix + sharded interpretability)")
+        for k, v in summary["trust"].items():
             if isinstance(v, dict):
                 v = " ".join(
                     f"{kk}={_fmt(vv)}" for kk, vv in sorted(v.items())
@@ -1356,6 +1422,154 @@ def weakscale_gates(
             "failed": sum(not r["ok"] for r in rows), "rows": rows}
 
 
+def trust_gates(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Gate a committed trust-matrix report (trust/matrix.py ->
+    evidence/trust_baseline.json) — the graceful-degradation acceptance
+    criteria (ISSUE 15), RE-DERIVED from the record's RAW numbers (outcome
+    counts, correct-on-answered counts, per-sample served scores), never
+    from stored rate/AUROC fields, which would gate nothing:
+
+      * every ID x OoD pair's AUROC re-derived from the raw served
+        log p(x) scores must match the recorded value (tamper bound) AND
+        clear the report's own committed floor;
+      * OoD traffic abstains at least as often as clean ID, per pair;
+      * along every corruption family's severity ladder, abstention rises
+        monotonically (within the report's monotone_tol) and ends above
+        the clean-ID rate — coverage degrades GRACEFULLY, not chaotically;
+      * accuracy over answered (predict) outcomes holds above the
+        committed floor at EVERY severity (vacuously at full abstention);
+      * the clean-ID served-score sketch sits on the calibration's own
+        quantile sketch (px divergence under the limit) — the serving
+        path and the calibration describe the same distribution;
+      * zero dropped requests (submitted == returned in every cell), zero
+        steady-state recompiles, gate not degraded."""
+    rows: List[Dict[str, Any]] = []
+
+    def gate(key, ok, why="", baseline_v=None, value=None):
+        rows.append({"key": key, "ok": bool(ok), "why": "" if ok else why,
+                     "baseline": baseline_v, "value": value,
+                     "direction": "trust"})
+
+    def abstain_rate(cell) -> Optional[float]:
+        """Re-derive abstention over the GATED outcomes from raw counts."""
+        oc = cell.get("outcomes") or {}
+        gated = (oc.get("predict") or 0) + (oc.get("abstain") or 0)
+        return (oc.get("abstain") or 0) / gated if gated else None
+
+    cfg = record.get("config") or {}
+    gate("trust.schema",
+         bool(record.get("trust_report")) and bool(record.get("id"))
+         and bool(record.get("pairs")) and bool(record.get("ladder")),
+         "not a trust report (missing trust_report/id/pairs/ladder)")
+    gate("trust.zero_steady_recompiles",
+         record.get("steady_state_recompiles") == 0,
+         f"serving recompiled in steady state: "
+         f"{record.get('steady_state_recompiles')}")
+    gate("trust.not_degraded", record.get("degraded") is False,
+         "engine served in degraded mode — the matrix measured an ungated "
+         "path")
+
+    # zero dropped: every cell answered exactly what was submitted
+    dropped = []
+    id_cell = record.get("id") or {}
+    all_cells = [("id", id_cell)]
+    all_cells += [(f"ood:{p.get('pair')}", p)
+                  for p in record.get("pairs") or []]
+    for kind, rows_k in (record.get("ladder") or {}).items():
+        all_cells += [(f"{kind}:{c.get('severity')}", c) for c in rows_k]
+    for name, cell in all_cells:
+        if not (cell.get("submitted") == cell.get("returned")
+                == cell.get("n")) or not cell.get("n"):
+            dropped.append(name)
+    gate("trust.zero_dropped", not dropped,
+         f"cells with submitted != returned (or empty): {dropped}")
+
+    div = id_cell.get("px_divergence")
+    limit = cfg.get("px_divergence_limit")
+    gate("trust.calibration_matches_serving",
+         isinstance(div, (int, float)) and isinstance(limit, (int, float))
+         and div <= limit,
+         f"clean-ID served-score divergence {div} vs limit {limit} — the "
+         "serving path is not the distribution the calibration measured",
+         baseline_v=limit, value=div)
+
+    # per-pair AUROC: re-derive from raw scores (jax-free midrank AUROC)
+    from mgproto_tpu.trust.auroc import binary_auroc as _auroc
+
+    id_scores = id_cell.get("scores") or []
+    rtol = cfg.get("auroc_rederive_tol", 1e-9)
+    floor = cfg.get("auroc_floor")
+    id_rate = abstain_rate(id_cell)
+    for p in record.get("pairs") or []:
+        name = p.get("pair")
+        scores = p.get("scores") or []
+        recorded = p.get("auroc")
+        derived = (
+            _auroc(id_scores, scores) if id_scores and scores else None
+        )
+        gate(f"trust.auroc_rederives[{name}]",
+             isinstance(recorded, (int, float)) and derived is not None
+             and abs(derived - recorded) <= rtol,
+             f"recorded AUROC {recorded} vs re-derived {derived} — the "
+             "stored value does not follow from the raw scores",
+             baseline_v=recorded, value=derived)
+        gate(f"trust.auroc_floor[{name}]",
+             derived is not None and isinstance(floor, (int, float))
+             and derived >= floor,
+             f"re-derived AUROC {derived} < committed floor {floor}",
+             baseline_v=floor, value=derived)
+        ood_rate = abstain_rate(p)
+        gate(f"trust.ood_abstains_more[{name}]",
+             id_rate is not None and ood_rate is not None
+             and ood_rate >= id_rate,
+             f"OoD abstention {ood_rate} < ID abstention {id_rate}",
+             baseline_v=id_rate, value=ood_rate)
+
+    # corruption ladder: monotone abstention + answered-accuracy floor,
+    # all from raw counts
+    tol = cfg.get("monotone_tol", 0.0)
+    acc_floor = cfg.get("answered_accuracy_floor")
+    for kind, rows_k in sorted((record.get("ladder") or {}).items()):
+        rates = [abstain_rate(c) for c in rows_k]
+        mono = (
+            bool(rates) and all(r is not None for r in rates)
+            and id_rate is not None
+            and all(b >= a - tol for a, b in zip(rates, rates[1:]))
+            # the tol absorbs between-rung sampling noise only: the
+            # heaviest rung must STRICTLY never abstain less than clean
+            # traffic (the documented contract)
+            and rates[-1] >= id_rate
+        )
+        gate(f"trust.abstention_monotone[{kind}]", mono,
+             f"abstention along severities {rates} (clean ID {id_rate}) "
+             f"is not monotone within tol {tol}, or the heaviest rung "
+             "abstains LESS than clean traffic — degradation is not "
+             "graceful",
+             baseline_v=id_rate, value=rates)
+        accs, acc_ok = [], bool(rows_k)
+        for c in rows_k:
+            answered = c.get("answered") or 0
+            correct = c.get("correct_answered")
+            if answered == 0:
+                accs.append(None)  # full abstention: risk is vacuous
+                continue
+            if not isinstance(correct, (int, float)):
+                acc_ok = False
+                accs.append(None)
+                continue
+            acc = correct / answered
+            accs.append(round(acc, 4))
+            if not (isinstance(acc_floor, (int, float))
+                    and acc >= acc_floor):
+                acc_ok = False
+        gate(f"trust.answered_accuracy_floor[{kind}]", acc_ok,
+             f"accuracy-on-answered {accs} drops below the committed "
+             f"floor {acc_floor} somewhere on the ladder",
+             baseline_v=acc_floor, value=accs)
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
 def stall_report_gates(
     record: Dict[str, Any],
     baseline: Optional[Dict[str, Any]] = None,
@@ -1561,6 +1775,15 @@ def check_main(argv: Optional[list] = None) -> int:
                         "planner prediction == live shard shapes — every "
                         "verdict re-derived from raw numbers; exit 1 on "
                         "any failure")
+    p.add_argument("--trust", default=None, metavar="FILE",
+                   help="gate a committed trust-matrix report (trust/"
+                        "matrix.py -> evidence/trust_baseline.json): "
+                        "per-pair OoD AUROC re-derived from raw scores "
+                        ">= the committed floor, abstention monotone in "
+                        "corruption severity, answered-accuracy >= floor "
+                        "at every severity, calibration-vs-serving sketch "
+                        "agreement, zero dropped requests, zero steady-"
+                        "state recompiles — exit 1 on any failure")
     p.add_argument("--stall-report", default=None, metavar="FILE",
                    help="gate a stall-budget report (scripts/"
                         "trace_report.py output): schema sanity, and with "
@@ -1628,6 +1851,12 @@ def check_main(argv: Optional[list] = None) -> int:
         result = drift_drill_gates(record)
         _emit_suite("drift_drill", result)
         suites_ok = suites_ok and result["ok"]
+    if args.trust:
+        any_suite = True
+        record = _read_json(args.trust, "trust report")
+        result = trust_gates(record)
+        _emit_suite("trust", result)
+        suites_ok = suites_ok and result["ok"]
     if args.autoscale:
         any_suite = True
         record = _read_json(args.autoscale, "autoscale record")
@@ -1646,7 +1875,8 @@ def check_main(argv: Optional[list] = None) -> int:
     if args.dir is None or args.baseline is None:
         raise SystemExit(
             "check needs a telemetry dir AND --baseline (or --drift-drill "
-            "/ --stall-report / --autoscale / --weakscale FILE alone)"
+            "/ --stall-report / --autoscale / --weakscale / --trust FILE "
+            "alone)"
         )
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")

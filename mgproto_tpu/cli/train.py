@@ -711,7 +711,7 @@ def _run_epoch(
 
         if epoch >= cfg.schedule.push_start and epoch in cfg.schedule.push_epochs():
             with timed_span(log, "push"):
-                state, _ = push_prototypes(
+                state, push_result = push_prototypes(
                     trainer,
                     state,
                     iter(push_loader),
@@ -719,6 +719,23 @@ def _run_epoch(
                     epoch=epoch,
                     load_image=lambda i: push_ds.load(i)[0],
                 )
+            from mgproto_tpu.parallel.multihost import is_primary_host
+
+            if is_primary_host():
+                # nearest-training-patch table for the explanation path
+                # (mgproto-export --explain reads it; engine/push.py) —
+                # run-wide artifact, so host 0's to write (side-effects
+                # audit, PR 9)
+                import json as _json
+
+                from mgproto_tpu.engine.push import provenance_dict
+
+                with open(
+                    os.path.join(cfg.model_dir, "push_provenance.json"), "w"
+                ) as f:
+                    _json.dump(
+                        {"epoch": epoch, **provenance_dict(push_result)}, f
+                    )
             accu, test_results = _test(
                 trainer, state, test_loader, ood_loaders, log
             )

@@ -43,6 +43,13 @@ from mgproto_tpu.engine.train import Trainer
 _BLOB_NAME = "model.stablehlo"
 _META_NAME = "meta.json"
 _CALIB_NAME = "calibration.json"
+# opt-in explanation sidecars (ISSUE 15): a second staged program with
+# superset outputs (top activated prototypes per request) + the static
+# prototype table (priors, push provenance) the serving engine attaches
+# to predict outcomes. The PLAIN program stays untouched, so serving
+# without --explain pays nothing for an artifact that carries these.
+_EXPLAIN_BLOB = "explain.stablehlo"
+_EXPLAIN_TABLE = "explain.json"
 
 
 def export_eval(trainer, state, dynamic_batch: bool = True,
@@ -83,15 +90,24 @@ def export_eval(trainer, state, dynamic_batch: bool = True,
 
 
 def save_artifact(path: str, exported, meta: Dict[str, Any],
-                  calibration=None) -> None:
+                  calibration=None, explain=None) -> None:
     """One-file artifact: the serialized program + meta.json (+ the
     serving calibration when given — a `serving.calibration.Calibration`
-    or an already-serialized dict)."""
+    or an already-serialized dict; + the explain sidecars when given — an
+    (exported_explain_program, table_dict) pair from `export_explain` /
+    `explain_table`)."""
     with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_DEFLATED) as z:
         z.writestr(_BLOB_NAME, bytes(exported.serialize()))
         z.writestr(_META_NAME, json.dumps(meta, indent=2, sort_keys=True))
         if calibration is not None:
             z.writestr(_CALIB_NAME, _calib_json(calibration))
+        if explain is not None:
+            explain_exported, table = explain
+            z.writestr(_EXPLAIN_BLOB, bytes(explain_exported.serialize()))
+            z.writestr(
+                _EXPLAIN_TABLE,
+                json.dumps(table, indent=2, sort_keys=True),
+            )
 
 
 def _calib_json(calibration) -> str:
@@ -127,6 +143,126 @@ def load_calibration(path: str):
         if _CALIB_NAME not in z.namelist():
             return None
         return Calibration.from_json(z.read(_CALIB_NAME).decode())
+
+
+def make_explain_fn(trainer, state, top_e: int = 5):
+    """The explain inference function: images -> {"logits", "log_px",
+    "proto_idx" [B, E] flat C*K prototype indices, "proto_logd" [B, E]
+    peak patch log-densities}, most activated first. logits/log_px take
+    the portable XLA head path — numerically identical to the fused
+    kernel (tests/test_fused_scoring.py), and an explain program must
+    export/serve everywhere the plain one does.
+
+    Pruned prototypes (prior exactly 0, `core/mgproto.py::prune_top_m`)
+    are masked to -inf before the top-k: a dead mixture component must
+    never headline an explanation."""
+    import numpy as np
+
+    from mgproto_tpu.core.mgproto import (
+        head_forward,
+        log_px as _log_px,
+        patch_log_densities,
+    )
+
+    cfg = trainer.cfg
+    c, k = state.gmm.priors.shape
+    top_e = int(min(top_e, c * k))
+
+    def infer(images):
+        (proto_map, _), _ = trainer._apply(
+            state.params, state.batch_stats, images, train=False
+        )
+        logits, _, _ = head_forward(
+            proto_map, state.gmm, None, cfg.model.mine_T, fused=False
+        )
+        lvl0 = logits[..., 0]
+        lp, _ = patch_log_densities(proto_map, state.gmm)  # [B,C,K,H,W]
+        b = lp.shape[0]
+        peak = jnp.max(lp, axis=(3, 4)).reshape(b, c * k)
+        live = (state.gmm.priors > 0).reshape(c * k)
+        masked = jnp.where(live[None, :], peak, -jnp.inf)
+        logd, idx = jax.lax.top_k(masked, top_e)
+        return {
+            "logits": lvl0,
+            "log_px": _log_px(lvl0),
+            "proto_idx": idx.astype(np.int32),
+            "proto_logd": logd,
+        }
+
+    return infer
+
+
+def explain_table(state, provenance: Optional[Dict[str, Any]] = None,
+                  ) -> Dict[str, Any]:
+    """The static prototype table the serving engine resolves explanation
+    rows against: flat-indexed priors + optional push provenance
+    (engine/push.py::provenance_dict — nearest training patch per
+    prototype). JSON-able; persisted as explain.json inside the artifact
+    so an exported model explains itself with no training run around."""
+    import numpy as np
+
+    c, k = state.gmm.priors.shape
+    table: Dict[str, Any] = {
+        "format": "mgproto-explain-v1",
+        "num_classes": int(c),
+        "k_per_class": int(k),
+        "priors": [
+            round(float(v), 8)
+            for v in np.asarray(state.gmm.priors).reshape(-1)
+        ],
+        "provenance": None,
+    }
+    if provenance is not None:
+        for key in ("image_id", "spatial_idx", "log_prob"):
+            if key not in provenance:
+                raise ValueError(
+                    f"provenance dict missing {key!r} (expected the "
+                    "engine/push.py::provenance_dict shape)"
+                )
+        table["provenance"] = {
+            "image_id": [int(v) for v in
+                         np.asarray(provenance["image_id"]).reshape(-1)],
+            "spatial_idx": [int(v) for v in
+                            np.asarray(provenance["spatial_idx"]).reshape(-1)],
+            "log_prob": [round(float(v), 6) for v in
+                         np.asarray(provenance["log_prob"]).reshape(-1)],
+        }
+    return table
+
+
+def export_explain(trainer, state, top_e: int = 5,
+                   dynamic_batch: bool = True, static_batch: int = 8,
+                   platforms: Tuple[str, ...] = ("cpu", "tpu", "cuda")):
+    """Stage the explain program out as a jax.export.Exported (the
+    `export_eval` of the explanation path; same batch-dimension and
+    multi-platform rules)."""
+    cfg = trainer.cfg
+    if trainer._fused:
+        portable = cfg.replace(
+            model=dataclasses.replace(cfg.model, fused_scoring=False)
+        )
+        trainer = Trainer(portable, steps_per_epoch=1)
+    infer = make_explain_fn(trainer, state, top_e=top_e)
+    if dynamic_batch:
+        (b,) = jax_export.symbolic_shape("b")
+    else:
+        b = static_batch
+    spec = jax.ShapeDtypeStruct(
+        (b, cfg.model.img_size, cfg.model.img_size, 3), jnp.float32
+    )
+    return jax_export.export(jax.jit(infer), platforms=list(platforms))(spec)
+
+
+def load_explain(path: str) -> Tuple[Any, Optional[Dict[str, Any]]]:
+    """(explain Exported | None, table | None) from an artifact. Both are
+    None for artifacts exported without --explain."""
+    with zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        if _EXPLAIN_BLOB not in names or _EXPLAIN_TABLE not in names:
+            return None, None
+        exported = jax_export.deserialize(z.read(_EXPLAIN_BLOB))
+        table = json.loads(z.read(_EXPLAIN_TABLE))
+    return exported, table
 
 
 def load_exported(path: str) -> Tuple[Any, Dict[str, Any]]:

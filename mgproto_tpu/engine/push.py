@@ -52,6 +52,33 @@ class PushResult(NamedTuple):
     log_prob: np.ndarray
 
 
+def provenance_dict(result: PushResult) -> Dict[str, list]:
+    """A PushResult as the JSON-able nearest-training-patch table the
+    explanation path consumes (engine/export.py::explain_table, served as
+    ServeResponse.explain `source_patch` blocks): flat [C*K] image id /
+    latent spatial index / patch log-density per prototype, -1 ids for
+    prototypes the push set never covered."""
+    return {
+        "image_id": [int(v) for v in result.image_id.reshape(-1)],
+        "spatial_idx": [int(v) for v in result.spatial_idx.reshape(-1)],
+        "log_prob": [float(v) for v in result.log_prob.reshape(-1)],
+    }
+
+
+def load_push_provenance(model_dir: str) -> Optional[Dict]:
+    """The run's `push_provenance.json` (written by cli/train's push
+    stage) as a dict, or None when the run never pushed. The ONE loader
+    both explanation faces use (`mgproto-export --explain` and the live
+    `mgproto-serve --explain`), so the schema cannot drift between them."""
+    import json
+
+    path = os.path.join(model_dir, "push_provenance.json")
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def make_scan_fn(model) -> Callable:
     """Jitted pass-1 kernel: (params, batch_stats, gmm, images, labels) ->
     (val [B,K], idx [B,K], fvec [B,K,d]) — each image's best patch per

@@ -1,0 +1,169 @@
+"""Device-side corruption ladder: seeded, jitted distribution-shift probes.
+
+The trust verification plane (mgproto_tpu/trust/) proves GRACEFUL
+DEGRADATION: as inputs drift off-manifold, the calibrated serving path must
+abstain more and stay accurate on what it still answers. That claim needs a
+controllable shift axis, so this module implements the common-corruption
+families (the ImageNet-C recipe: noise / blur / contrast / pixelate) as
+pure jitted device functions at five severities, beside `ops/augment.py`
+whose per-sample threefry seeding discipline it reuses. Device-side for the
+same reason the augmentation tail is: the corruption runs where the serving
+batch already lives, one fused program per (kind, severity), and the host
+never materializes a second float copy of the ladder.
+
+Domain: the corruptions operate on the NORMALIZED float32 images the
+serving path accepts (`serving/validate.py` — mean/std normalized, roughly
+unit-scale). Severity tables are therefore stated in normalized units, not
+u8 steps; `SEVERITIES` spans "barely perceptible" (1) to "heavily degraded
+but class-bearing" (5). Every corruption is deterministic given (kind,
+severity, per-sample seeds): noise draws from raw-threefry keys exactly
+like `augment_tail`, the other families are parameter-deterministic.
+
+Shapes are static per (kind, severity): `make_corrupt_fn` returns one
+jitted callable per cell, so a 4-kind x 5-severity matrix compiles exactly
+20 tiny programs once and the SERVING program underneath recompiles zero
+times (asserted by the trust matrix via the engine's StepMonitor).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CORRUPTION_KINDS: Tuple[str, ...] = ("noise", "blur", "contrast", "pixelate")
+SEVERITIES: Tuple[int, ...] = (1, 2, 3, 4, 5)
+
+# severity -> parameter, index 0 unused so tables read naturally at [s]
+_NOISE_STD = (None, 0.12, 0.25, 0.45, 0.70, 1.00)  # additive gaussian std
+_BLUR_SIGMA = (None, 0.6, 1.0, 1.6, 2.4, 3.5)  # gaussian blur std (px)
+_CONTRAST_F = (None, 0.70, 0.55, 0.40, 0.25, 0.12)  # contrast retain factor
+_PIXELATE_F = (None, 2, 3, 4, 6, 8)  # pixelation block factor
+
+# distinguishes corruption key data from augment's ("mg_c" vs "mg_a")
+_KEY_TAG = np.uint32(0x6D675F63)
+
+
+def _per_sample_keys(seeds: jax.Array) -> jax.Array:
+    """[B] uint32 loader-style seeds -> [B, 2] raw threefry key data (the
+    `ops/augment.py` convention: seeds are already splitmix64-mixed, the
+    tag only separates this consumer's stream)."""
+    return jnp.stack(
+        [jnp.full_like(seeds, _KEY_TAG), seeds], axis=-1
+    ).astype(jnp.uint32)
+
+
+def _noise(x: jax.Array, seeds: jax.Array, severity: int) -> jax.Array:
+    std = _NOISE_STD[severity]
+
+    def one(img, key):
+        # raw [2]-uint32 key data consumed directly, the augment_tail way
+        return img + std * jax.random.normal(key, img.shape, img.dtype)
+
+    return jax.vmap(one)(x, _per_sample_keys(seeds))
+
+
+def _gauss_kernel(sigma: float) -> np.ndarray:
+    """Odd-width 1D gaussian, radius 3*sigma (host-side constant: the
+    kernel is static per severity, baked into the program)."""
+    radius = max(1, int(np.ceil(3.0 * sigma)))
+    xs = np.arange(-radius, radius + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (xs / sigma) ** 2)
+    return (k / k.sum()).astype(np.float32)
+
+
+def _blur(x: jax.Array, seeds: jax.Array, severity: int) -> jax.Array:
+    """Separable gaussian blur with edge-replicate padding (a zero pad
+    would darken borders in the normalized domain and read as a contrast
+    shift, contaminating the ladder's axes)."""
+    del seeds  # deterministic family
+    k = jnp.asarray(_gauss_kernel(_BLUR_SIGMA[severity]))
+    r = (k.shape[0] - 1) // 2
+
+    def conv_axis(img, axis):
+        pad = [(0, 0)] * img.ndim
+        pad[axis] = (r, r)
+        padded = jnp.pad(img, pad, mode="edge")
+        # [B, H, W, C] conv along `axis` via moveaxis + dot with the kernel
+        windows = jnp.stack(
+            [
+                jax.lax.slice_in_dim(padded, i, i + img.shape[axis], axis=axis)
+                for i in range(2 * r + 1)
+            ],
+            axis=0,
+        )
+        return jnp.tensordot(k, windows, axes=(0, 0))
+
+    return conv_axis(conv_axis(x, 1), 2)
+
+
+def _contrast(x: jax.Array, seeds: jax.Array, severity: int) -> jax.Array:
+    del seeds  # deterministic family
+    f = _CONTRAST_F[severity]
+    mean = jnp.mean(x, axis=(1, 2, 3), keepdims=True)
+    return mean + f * (x - mean)
+
+
+def _pixelate(x: jax.Array, seeds: jax.Array, severity: int) -> jax.Array:
+    """Downsample by the block factor (area average) then nearest-upsample
+    back — jax.image keeps it shape-polymorphic over non-divisible sizes."""
+    del seeds  # deterministic family
+    f = _PIXELATE_F[severity]
+    b, h, w, c = x.shape
+    small = (b, max(1, h // f), max(1, w // f), c)
+    down = jax.image.resize(x, small, method="linear")
+    return jax.image.resize(down, (b, h, w, c), method="nearest")
+
+
+_FAMILIES: Dict[str, Callable] = {
+    "noise": _noise,
+    "blur": _blur,
+    "contrast": _contrast,
+    "pixelate": _pixelate,
+}
+
+
+def make_corrupt_fn(kind: str, severity: int) -> Callable:
+    """One jitted `(images [B,H,W,3] f32, seeds [B] uint32) -> images`
+    program for a ladder cell. kind/severity are static (baked into the
+    program); batch shape follows the caller's bucketing."""
+    if kind not in _FAMILIES:
+        raise ValueError(
+            f"unknown corruption kind {kind!r}; options: {CORRUPTION_KINDS}"
+        )
+    if severity not in SEVERITIES:
+        raise ValueError(
+            f"severity must be in {SEVERITIES}, got {severity}"
+        )
+    family = _FAMILIES[kind]
+
+    def fn(images: jax.Array, seeds: jax.Array) -> jax.Array:
+        return family(images.astype(jnp.float32), seeds, severity)
+
+    return jax.jit(fn)
+
+
+def per_sample_seeds(seed: int, count: int, offset: int = 0) -> np.ndarray:
+    """The ONE per-sample uint32 seed recipe of the corruption ladder:
+    Knuth-hash the run seed, offset by global row index. Shared by
+    `corrupt_numpy` and the trust matrix's chunked driver
+    (trust/matrix.py) — the committed drill's byte-identical
+    reproducibility depends on there being exactly one copy of this."""
+    mixed = (int(seed) * 2654435761) & 0xFFFFFFFF  # knuth hash, mod 2^32
+    return np.uint32(mixed) + np.arange(
+        offset, offset + count, dtype=np.uint32
+    )
+
+
+def corrupt_numpy(
+    images: np.ndarray, kind: str, severity: int, seed: int = 0
+) -> np.ndarray:
+    """Convenience host wrapper: derives per-sample uint32 seeds from
+    (seed, row index) and returns a host array. The trust matrix uses the
+    jitted `make_corrupt_fn` directly (one program per cell, reused across
+    batches); this wrapper exists for scripts and tests."""
+    seeds = per_sample_seeds(seed, images.shape[0])
+    fn = make_corrupt_fn(kind, severity)
+    return np.asarray(fn(jnp.asarray(images, jnp.float32), jnp.asarray(seeds)))

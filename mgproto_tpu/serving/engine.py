@@ -78,6 +78,54 @@ class UncalibratedArtifactError(RuntimeError):
     """Artifact has no embedded calibration and --allow-uncalibrated is off."""
 
 
+class _ExplainContext:
+    """Static prototype table behind the opt-in `explain` response field
+    (ISSUE 15): per flat prototype index its class / within-class k /
+    mixture prior, plus nearest-training-patch provenance when the
+    push/export metadata carries it. Host-side numpy only; built once at
+    engine construction, O(top_e) dict work per PREDICT response when
+    enabled, and `engine._explain is None` is the ONE check the disabled
+    path pays (the reqtrace discipline)."""
+
+    def __init__(self, table: Dict[str, Any]):
+        self.k_per_class = int(table["k_per_class"])
+        self.priors = np.asarray(table["priors"], np.float64).ravel()
+        prov = table.get("provenance") or None
+        self._prov = None
+        if prov is not None:
+            self._prov = {
+                "image_id": np.asarray(prov["image_id"], np.int64).ravel(),
+                "spatial_idx": np.asarray(
+                    prov["spatial_idx"], np.int64
+                ).ravel(),
+                "log_prob": np.asarray(prov["log_prob"], np.float64).ravel(),
+            }
+
+    def rows(
+        self, proto_idx: np.ndarray, proto_logd: np.ndarray
+    ) -> List[Dict[str, Any]]:
+        """One response's explanation: the top activated prototypes, most
+        activated first (the program already sorted them)."""
+        out: List[Dict[str, Any]] = []
+        for p, logd in zip(proto_idx, proto_logd):
+            p = int(p)
+            row: Dict[str, Any] = {
+                "prototype": p,
+                "class": p // self.k_per_class,
+                "k": p % self.k_per_class,
+                "prior": float(self.priors[p]),
+                "log_density": float(logd),
+            }
+            if self._prov is not None and self._prov["image_id"][p] >= 0:
+                row["source_patch"] = {
+                    "image_id": int(self._prov["image_id"][p]),
+                    "spatial_idx": int(self._prov["spatial_idx"][p]),
+                    "log_prob": float(self._prov["log_prob"][p]),
+                }
+            out.append(row)
+        return out
+
+
 class ServingEngine:
     def __init__(
         self,
@@ -96,6 +144,7 @@ class ServingEngine:
         monitor: Optional[StepMonitor] = None,
         aot_cache: Optional[Any] = None,
         aot_fingerprint: Optional[str] = None,
+        explain_table: Optional[Dict[str, Any]] = None,
     ):
         """`infer_fn` maps float32 images [b, H, W, 3] to
         {"logits": [b, C], "log_px": [b]} and is jit-wrapped here so the
@@ -146,6 +195,19 @@ class ServingEngine:
             if aot_fingerprint is not None
             else (expected_fingerprint or "")
         )
+        # opt-in explanations (ISSUE 15): when a prototype table rides
+        # along, `infer_fn` is the EXPLAIN program (superset outputs:
+        # proto_idx/proto_logd beside logits/log_px) and predict outcomes
+        # carry an `explain` block. Disabled engines serve the plain
+        # program untouched — the None-check below is the only cost.
+        self._explain = (
+            _ExplainContext(explain_table)
+            if explain_table is not None else None
+        )
+        if self._explain is not None:
+            # an explain program's executables must never collide with the
+            # plain program's in the AOT cache (different output contract)
+            self.aot_fingerprint += ":explain"
         self.compute_dtype = str(expected_compute_dtype or "")
         # per-bucket compiled executables: populated by warmup (cache hit
         # or AOT compile); dispatch uses these, so the jit dispatch cache
@@ -165,17 +227,36 @@ class ServingEngine:
     # ------------------------------------------------------------ constructors
     @classmethod
     def from_live(
-        cls, trainer, state, calibration: Optional[Calibration] = None, **kw
+        cls, trainer, state, calibration: Optional[Calibration] = None,
+        explain: bool = False, explain_top: int = 5,
+        provenance: Optional[Dict[str, Any]] = None, **kw
     ) -> "ServingEngine":
         """Serve a live TrainState through the trainer's eval math. The
         expected fingerprint comes from the state's ACTUAL mixture, so a
         calibration measured before a prune/EM/push is refused (fail-closed
-        into degraded mode) rather than silently misgating."""
+        into degraded mode) rather than silently misgating.
+
+        `explain=True` serves the explain program instead (same logits/
+        log_px math plus the top-`explain_top` activated prototypes per
+        request); `provenance` is an optional push-metadata dict
+        (engine/push.py::provenance_dict) for nearest-training-patch
+        attribution."""
         from mgproto_tpu.serving.calibration import gmm_fingerprint
 
-        def infer(images):
-            out = trainer._eval(state, images, None)
-            return {"logits": out.logits, "log_px": out.log_px}
+        if explain:
+            from mgproto_tpu.engine.export import (
+                explain_table as _explain_table,
+                make_explain_fn,
+            )
+
+            kw["explain_table"] = _explain_table(
+                state, provenance=provenance
+            )
+            infer = make_explain_fn(trainer, state, top_e=explain_top)
+        else:
+            def infer(images):
+                out = trainer._eval(state, images, None)
+                return {"logits": out.logits, "log_px": out.log_px}
 
         return cls(
             infer,
@@ -189,17 +270,38 @@ class ServingEngine:
 
     @classmethod
     def from_artifact(
-        cls, path: str, allow_uncalibrated: bool = False, **kw
+        cls, path: str, allow_uncalibrated: bool = False,
+        explain: bool = False, **kw
     ) -> "ServingEngine":
         """Serve an exported `.mgproto` artifact (StableHLO + calibration).
 
         A static-batch artifact constrains the buckets to its pinned batch
         size; a dynamic-batch artifact serves every configured bucket (each
-        bucket still compiles exactly once, at warmup)."""
-        from mgproto_tpu.engine.export import load_calibration, load_exported
+        bucket still compiles exactly once, at warmup).
+
+        `explain=True` serves the artifact's embedded EXPLAIN program
+        (`mgproto-export --explain` stages it beside the plain one) — the
+        artifact then serves prototype explanations with push provenance
+        and NO training run anywhere in sight. Refused loudly when the
+        artifact predates --explain."""
+        from mgproto_tpu.engine.export import (
+            load_calibration,
+            load_explain,
+            load_exported,
+        )
 
         exported, meta = load_exported(path)
         calibration = load_calibration(path)
+        if explain:
+            explain_exported, table = load_explain(path)
+            if explain_exported is None:
+                raise ValueError(
+                    f"{path} carries no explain program; re-export with "
+                    "mgproto-export --explain to serve explanations from "
+                    "this artifact"
+                )
+            exported = explain_exported
+            kw["explain_table"] = table
         if calibration is None and not allow_uncalibrated:
             raise UncalibratedArtifactError(
                 f"{path} carries no calibration.json; re-export with "
@@ -447,7 +549,7 @@ class ServingEngine:
                 )
             return responses
         try:
-            logits, log_px = self._dispatch(
+            logits, log_px, extras = self._dispatch(
                 np.stack([r.payload for r in batch])
             )
         except Exception:
@@ -475,7 +577,9 @@ class ServingEngine:
                 fill=len(batch) / bucket,
                 fallback_t0=t_pop,
             )
-        responses.extend(self._gated_responses(batch, logits, log_px))
+        responses.extend(
+            self._gated_responses(batch, logits, log_px, extras)
+        )
         return responses
 
     def drain(self, reason: str = REASON_SHUTDOWN) -> List[ServeResponse]:
@@ -546,9 +650,11 @@ class ServingEngine:
     # -------------------------------------------------------------- internals
     def _dispatch(
         self, images: np.ndarray
-    ) -> Tuple[np.ndarray, np.ndarray]:
+    ) -> Tuple[np.ndarray, np.ndarray, Optional[Tuple]]:
         """Pad to bucket, run the compiled program, slice the padding off.
-        Raises on (real or chaos-injected) device failure."""
+        Raises on (real or chaos-injected) device failure. The third
+        element is None unless explanations are enabled (then the explain
+        program's (proto_idx, proto_logd) rows ride along)."""
         from mgproto_tpu.telemetry.tracing import trace_span
 
         n = images.shape[0]
@@ -578,9 +684,15 @@ class ServingEngine:
             out = exe(padded) if exe is not None else self._jit(padded)
             logits = np.asarray(out["logits"], np.float64)[:n]
             log_px = np.asarray(out["log_px"], np.float64)[:n]
+            extras = None
+            if self._explain is not None:
+                extras = (
+                    np.asarray(out["proto_idx"], np.int64)[:n],
+                    np.asarray(out["proto_logd"], np.float64)[:n],
+                )
         self.monitor.observe_step(n, time.perf_counter() - t0,
                                   transfer_bytes=int(padded.nbytes))
-        return logits, log_px
+        return logits, log_px, extras
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -589,7 +701,8 @@ class ServingEngine:
         return self.buckets[-1]
 
     def _gated_responses(
-        self, batch: List[ServeRequest], logits: np.ndarray, log_px: np.ndarray
+        self, batch: List[ServeRequest], logits: np.ndarray,
+        log_px: np.ndarray, extras: Optional[Tuple] = None,
     ) -> List[ServeResponse]:
         preds = np.argmax(logits, axis=-1)
         try:
@@ -604,12 +717,20 @@ class ServingEngine:
         # module-global None-check per batch — the reqtrace discipline
         tap = _capture.get_active()
         out = []
-        for req, pred, row, score, label in zip(
+        for i, (req, pred, row, score, label) in enumerate(zip(
             batch, preds, logits, log_px, labels
-        ):
+        )):
             outcome = (
                 OUTCOME_ABSTAIN if label == TRUST_ABSTAIN else OUTCOME_PREDICT
             )
+            explain_rows = None
+            if self._explain is not None and outcome == OUTCOME_PREDICT:
+                # populated ONLY on predict outcomes: an abstained request
+                # has no served decision to explain
+                explain_rows = self._explain.rows(
+                    extras[0][i], extras[1][i]
+                )
+                _m.counter(_m.EXPLANATIONS).inc()
             resp = ServeResponse(
                 request_id=req.request_id,
                 outcome=outcome,
@@ -620,6 +741,7 @@ class ServingEngine:
                 confidence=self.gate.confidence(row),
                 degraded=degraded or label == TRUST_UNGATED,
                 latency_s=self.clock() - req.enqueued_at,
+                explain=explain_rows,
             )
             resp = self._respond(resp)
             if tap is not None:

@@ -52,6 +52,8 @@ AOT_REJECTS = "serving_aot_reject_total"
 AOT_STORES = "serving_aot_store_total"
 AUTOSCALE_TARGET = "autoscale_replicas_target"
 AUTOSCALE_EVENTS = "autoscale_events_total"
+# --- trust plane (ISSUE 15): explanations as a served product ---
+EXPLANATIONS = "serving_explanations_total"
 
 COUNTER_HELP = {
     REQUESTS: "requests by outcome (predict/abstain/reject/shed)",
@@ -90,6 +92,9 @@ COUNTER_HELP = {
         "error)",
     AUTOSCALE_EVENTS:
         "autoscaler scale decisions applied, by direction (up/down)",
+    EXPLANATIONS:
+        "predict outcomes answered WITH a prototype explanation block "
+        "(ServingEngine explain=True; abstain/reject/shed never explain)",
 }
 
 GAUGE_HELP = {

@@ -53,11 +53,19 @@ class ServeResponse:
     # replica. None — and absent from to_dict() — everywhere else, so the
     # wire format only grows for operators who asked for it.
     timings: Optional[Dict[str, Any]] = None
+    # opt-in prototype explanation (ISSUE 15, ServingEngine explain=True):
+    # the top activated prototypes behind a PREDICT outcome — per entry
+    # class / k / mixture prior / peak log-density, plus nearest-training-
+    # patch provenance when the artifact carries push metadata. None — and
+    # absent from to_dict() — everywhere else (the timings discipline);
+    # never populated on abstain/reject/shed.
+    explain: Optional[Any] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
-        if d.get("timings") is None:
-            d.pop("timings", None)
+        for opt in ("timings", "explain"):
+            if d.get(opt) is None:
+                d.pop(opt, None)
         return d
 
 

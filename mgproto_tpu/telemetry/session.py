@@ -166,6 +166,12 @@ class TelemetrySession:
         from mgproto_tpu.online.metrics import register_online_metrics
 
         register_online_metrics(self.registry)
+        # trust-verification family (ISSUE 15): matrix cells, per-pair
+        # AUROC, abstention/accuracy extremes, sharded interp metrics —
+        # same explicit-zeros contract as the families above
+        from mgproto_tpu.trust.metrics import register_trust_metrics
+
+        register_trust_metrics(self.registry)
         self._g_epoch_ips = self.registry.gauge(
             "epoch_images_per_sec_global",
             "whole-epoch throughput summed across hosts",

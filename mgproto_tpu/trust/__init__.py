@@ -1,0 +1,26 @@
+"""Trust verification plane (ISSUE 15, ROADMAP item 5).
+
+Turns the paper's trust claims — prototype consistency/stability/purity and
+generative-p(x) OoD detection — into committed, re-derivable regression
+gates that run against the PRODUCTION serving path:
+
+  matrix.py         — serving-path robustness matrix: ID x OoD dataset
+                      pairs AND a seeded device-side corruption ladder
+                      (ops/corrupt.py) driven through a warmed, calibrated
+                      ServingEngine; emits one trust_report.json with
+                      per-cell AUROC, per-severity risk-coverage curves and
+                      calibration-drift readings, gated by
+                      `mgproto-telemetry check --trust`.
+  interp_sharded.py — consistency/stability/purity device halves lifted
+                      into batched/jitted evaluators sharded over the
+                      (data, model) mesh, parity-pinned against the
+                      single-device implementations.
+  auroc.py          — the midrank AUROC statistic, numpy-only so the
+                      jax-free check CLI can RE-DERIVE every per-pair
+                      verdict from the report's raw scores.
+  metrics.py        — the trust_* telemetry family (pre-registered by
+                      every TelemetrySession).
+
+Submodules import lazily — `mgproto_tpu.trust.auroc` and `.metrics` stay
+importable on a jax-free host (the check/summarize CLI contract).
+"""

@@ -1,0 +1,41 @@
+"""Midrank AUROC — numpy + stdlib only.
+
+The ONE implementation of the threshold-free OoD separability statistic:
+AUROC = P(pos > neg) + 0.5 P(pos == neg) via the Mann-Whitney U statistic
+on midranks (exact tie handling, no sklearn dependency). It lives here —
+not in engine/evaluate.py where it historically sat — because the trust
+gate suite (`mgproto-telemetry check --trust`, cli/telemetry.py) must
+RE-DERIVE every per-pair AUROC from a committed report's raw scores on a
+jax-free host; engine/evaluate.py re-exports it unchanged, so every
+existing caller (the bespoke eval loop, the trust matrix, tests) keeps the
+same symbol and the two paths cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def binary_auroc(pos_scores: np.ndarray, neg_scores: np.ndarray) -> float:
+    """AUROC = P(pos > neg) + 0.5 P(pos == neg), via the Mann-Whitney U
+    statistic on midranks (exact tie handling, no sklearn dependency)."""
+    pos = np.asarray(pos_scores, np.float64).ravel()
+    neg = np.asarray(neg_scores, np.float64).ravel()
+    if not pos.size or not neg.size:
+        return float("nan")
+    both = np.concatenate([pos, neg])
+    order = np.argsort(both, kind="mergesort")
+    ranks = np.empty_like(both)
+    ranks[order] = np.arange(1, both.size + 1, dtype=np.float64)
+    # midranks for ties
+    sorted_vals = both[order]
+    i = 0
+    while i < sorted_vals.size:
+        j = i
+        while j + 1 < sorted_vals.size and sorted_vals[j + 1] == sorted_vals[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    u = ranks[: pos.size].sum() - pos.size * (pos.size + 1) / 2.0
+    return float(u / (pos.size * neg.size))

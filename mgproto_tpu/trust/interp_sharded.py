@@ -1,0 +1,244 @@
+"""Interpretability metrics at scale: the device half sharded over the mesh.
+
+`engine/interpretability.py` evaluates consistency/stability/purity with a
+single-device jitted forward (`make_gt_act_fn`) and a host-side geometric
+post-pass. At ImageNet-1000 scale (C=1000, P=10 000) the device half — a
+full forward plus the [B, C, K, H, W] density tensor and its gt-class
+gather — is the bottleneck and does not fit one chip's HBM. This module
+lifts exactly that half onto the existing `(data, model)` mesh:
+
+  * the batch shards over 'data' (each chip forwards its rows);
+  * the gt-class density gather shard_maps over 'model' EXACTLY like the
+    scoring path (`core/mgproto.py::_fused_pool`): each model shard scores
+    every patch against its LOCAL [C/nm, K, d] prototype slab only — the
+    full density tensor never materializes — selects the rows whose
+    ground-truth class it owns, and one psum over 'model' assembles the
+    [B, K, h, w] gt map (every other shard contributed exact zeros);
+  * the host post-pass is UNCHANGED — the sharded collector returns the
+    same (acts, targets, img_ids) triple `evaluate_{consistency,stability,
+    purity}` already accept via their `activations=` parameter, so the
+    geometry/scoring semantics cannot drift between the two paths.
+
+Parity is pinned in tier-1 (tests/test_trust.py) against the single-device
+implementation on the committed `evidence/interp` fixtures: same weights,
+same batches, same noise — identical metrics.
+
+Non-divisible shapes (ragged final batch, C % model_axis != 0) fall back
+to the single-device activation function for that call, mirroring
+`head_forward`'s shard_map divisibility rule.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mgproto_tpu.core.mgproto import GMMState, l2_normalize
+from mgproto_tpu.engine.interpretability import (
+    collect_gt_activations,
+    evaluate_consistency,
+    evaluate_purity,
+    evaluate_stability,
+    make_gt_act_fn,
+)
+from mgproto_tpu.ops.gaussian import diag_gaussian_log_prob
+
+
+def make_gt_act_fn_sharded(model, mesh):
+    """Sharded counterpart of `make_gt_act_fn`: (params, batch_stats, gmm,
+    images, labels) -> [B, K, h, w] exp-density maps of each image's
+    gt-class prototypes, with the density + gather shard_mapped over the
+    mesh. Shapes must divide the mesh axes (B % data == 0, C % model == 0);
+    `sharded_act_fn` wraps this with the fallback rule."""
+    from jax.sharding import PartitionSpec as P
+
+    from mgproto_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+        shard_map_compat,
+    )
+
+    def gather_local(feat, labels, means, sigmas):
+        """Per-shard body: feat [B/nd, HW, d] local rows, means/sigmas
+        [C/nm, K, d] local class slab. Scores ONLY the local slab, selects
+        the rows whose gt class this shard owns, psums the exact-zero
+        remainder away."""
+        bl, hw, d = feat.shape
+        cl, k, _ = means.shape
+        lp = diag_gaussian_log_prob(feat.reshape(-1, d), means, sigmas)
+        lp = lp.reshape(bl, hw, cl, k)
+        base = jax.lax.axis_index(MODEL_AXIS) * cl
+        rel = labels - base
+        in_shard = (rel >= 0) & (rel < cl)
+        sel = jnp.clip(rel, 0, cl - 1)
+        picked = jnp.take_along_axis(
+            lp, sel[:, None, None, None], axis=2
+        )[:, :, 0]  # [B/nd, HW, K]
+        picked = jnp.where(in_shard[:, None, None], picked, 0.0)
+        return jax.lax.psum(picked, MODEL_AXIS)
+
+    sharded = shard_map_compat(
+        gather_local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(MODEL_AXIS), P(MODEL_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+
+    def fn(params, batch_stats, gmm: GMMState, images, labels):
+        variables = {"params": params["net"], "batch_stats": batch_stats}
+        proto_map, _ = model.apply(variables, images, train=False)
+        b, h, w, d = proto_map.shape
+        feat = l2_normalize(proto_map, axis=-1).reshape(b, h * w, d)
+        lp_gt = sharded(feat, labels, gmm.means, gmm.sigmas)  # [B, HW, K]
+        k = gmm.k_per_class
+        return jnp.exp(
+            jnp.transpose(lp_gt, (0, 2, 1)).reshape(b, k, h, w)
+        )
+
+    return jax.jit(fn)
+
+
+def sharded_act_fn(trainer):
+    """The activation function `collect_gt_activations` should use for
+    this trainer: the shard_mapped one on a real mesh with a divisible
+    class axis, the single-device one otherwise (plain Trainer, or a
+    ragged class count). Batch raggedness is handled per call: the
+    returned callable re-routes a non-divisible batch to the single-device
+    path for THAT shape only (jit retraces per shape anyway)."""
+    mesh = getattr(trainer, "mesh", None)
+    single = make_gt_act_fn(trainer.model)
+    if mesh is None:
+        return single
+    from mgproto_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    n_data = mesh.shape[DATA_AXIS]
+    n_model = mesh.shape[MODEL_AXIS]
+    if trainer.cfg.model.num_classes % n_model != 0:
+        return single
+    shard_fn = make_gt_act_fn_sharded(trainer.model, mesh)
+
+    def fn(params, batch_stats, gmm, images, labels):
+        if images.shape[0] % (n_data * n_model or 1) == 0 and (
+            images.shape[0] % n_data == 0
+        ):
+            return shard_fn(params, batch_stats, gmm, images, labels)
+        return single(params, batch_stats, gmm, images, labels)
+
+    return fn
+
+
+def collect_gt_activations_sharded(
+    trainer,
+    state,
+    batches,
+    use_noise: bool = False,
+    noise_seed: int = 0,
+):
+    """Sharded drop-in for `collect_gt_activations`: same triple, device
+    half sharded. The host-side accumulation/validity logic is the
+    single-device implementation itself (shared, not copied)."""
+    return collect_gt_activations(
+        trainer, state, batches,
+        use_noise=use_noise, noise_seed=noise_seed,
+        act_fn=sharded_act_fn(trainer),
+    )
+
+
+def evaluate_consistency_sharded(
+    trainer, state, batches, parts, num_classes: int,
+    half_size: int = 36, part_thresh: float = 0.8,
+    activations: Optional[Tuple] = None,
+) -> float:
+    acts = (
+        activations
+        if activations is not None
+        else collect_gt_activations_sharded(trainer, state, batches)
+    )
+    return evaluate_consistency(
+        trainer, state, None, parts, num_classes,
+        half_size=half_size, part_thresh=part_thresh, activations=acts,
+    )
+
+
+def evaluate_stability_sharded(
+    trainer, state, batches_factory, parts, num_classes: int,
+    half_size: int = 36, noise_seed: int = 0,
+    activations: Optional[Tuple] = None,
+) -> float:
+    act_fn = sharded_act_fn(trainer)
+    acts = (
+        activations
+        if activations is not None
+        else collect_gt_activations(
+            trainer, state, batches_factory(), act_fn=act_fn
+        )
+    )
+    return evaluate_stability(
+        trainer, state, batches_factory, parts, num_classes,
+        half_size=half_size, noise_seed=noise_seed,
+        activations=acts, act_fn=act_fn,
+    )
+
+
+def evaluate_purity_sharded(
+    trainer, state, batches, parts, num_classes: int,
+    half_size: int = 16, top_k: int = 10,
+    activations: Optional[Tuple] = None,
+) -> Tuple[float, float]:
+    acts = (
+        activations
+        if activations is not None
+        else collect_gt_activations_sharded(trainer, state, batches)
+    )
+    return evaluate_purity(
+        trainer, state, None, parts, num_classes,
+        half_size=half_size, top_k=top_k, activations=acts,
+    )
+
+
+def interp_metrics_sharded(
+    trainer,
+    state,
+    batches_factory,
+    parts,
+    num_classes: int,
+    consistency_half_size: int = 36,
+    purity_half_size: int = 16,
+    part_thresh: float = 0.8,
+    top_k: int = 10,
+    noise_seed: int = 0,
+) -> Dict[str, float]:
+    """All three metrics from ONE sharded activation pass over the test
+    set (plus the one extra noisy pass stability needs) — the
+    `mgproto-trust interp` payload, shaped for `run_matrix(interp=...)`.
+    `batches_factory()` returns a fresh (images, labels, img_ids)
+    iterator."""
+    act_fn = sharded_act_fn(trainer)
+    acts = collect_gt_activations(
+        trainer, state, batches_factory(), act_fn=act_fn
+    )
+    consistency = evaluate_consistency(
+        trainer, state, None, parts, num_classes,
+        half_size=consistency_half_size, part_thresh=part_thresh,
+        activations=acts,
+    )
+    stability = evaluate_stability(
+        trainer, state, batches_factory, parts, num_classes,
+        half_size=consistency_half_size, noise_seed=noise_seed,
+        activations=acts, act_fn=act_fn,
+    )
+    purity, purity_std = evaluate_purity(
+        trainer, state, None, parts, num_classes,
+        half_size=purity_half_size, top_k=top_k, activations=acts,
+    )
+    return {
+        "consistency": float(consistency),
+        "stability": float(stability),
+        "purity": float(purity),
+        "purity_std": float(purity_std),
+        "num_images": int(np.asarray(acts[1]).shape[0]),
+        "sharded": getattr(trainer, "mesh", None) is not None,
+    }

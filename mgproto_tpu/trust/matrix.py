@@ -1,0 +1,302 @@
+"""Serving-path robustness matrix: OoD pairs x corruption ladder, gated.
+
+The paper's trust claims are properties of the DEPLOYED decision function —
+calibrated thresholds, typed predict/abstain outcomes, pad-to-bucket static
+shapes — yet until ISSUE 15 the only OoD evaluation ran through a bespoke
+loop (`engine/evaluate.py::evaluate_with_ood`) that never touched the
+serving stack. This module drives every matrix cell through the PRODUCTION
+`ServingEngine`: warmed buckets, calibration, TrustGate, typed responses,
+zero steady-state recompiles (asserted via the engine's StepMonitor).
+
+Cells:
+  * one clean-ID cell (the coverage/accuracy anchor + the calibration-drift
+    probe: the served log p(x) distribution is compared against the
+    calibration's own quantile sketch — a production engine serving the
+    calibration's population must sit on its sketch);
+  * one cell per ID x OoD dataset pair -> per-pair AUROC over served
+    log p(x) (reusing `binary_auroc`) and the abstention contrast (OoD must
+    abstain at least as often as ID);
+  * one cell per (corruption kind, severity 1..5) — `ops/corrupt.py`
+    seeded device-side perturbations of the ID set -> the risk-coverage
+    curve: abstention (1 - coverage) must rise monotonically with severity
+    while accuracy-on-answered holds above a floor.
+
+Memory stays bounded per chip (the "Memory Safe Computations" discipline):
+requests are submitted and drained in bucket-sized chunks, the corruption
+programs run on one chunk at a time, and the report carries per-sample
+SCALARS only (scores/outcomes), never images.
+
+The emitted `trust_report.json` stores RAW numbers — outcome counts,
+correct-on-answered counts, per-sample served scores — next to the derived
+rates, so `mgproto-telemetry check --trust` RE-DERIVES every verdict
+(cli/telemetry.py::trust_gates) and a tampered rate or AUROC field is
+caught against its own raw data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mgproto_tpu.serving.response import (
+    OUTCOME_ABSTAIN,
+    OUTCOME_PREDICT,
+)
+from mgproto_tpu.trust import metrics as _tm
+from mgproto_tpu.trust.auroc import binary_auroc
+
+TRUST_REPORT_FORMAT = "mgproto-trust-report-v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixConfig:
+    """Ladder shape + the committed floors the check suite gates against.
+
+    The floors are part of the REPORT (config block) rather than of the
+    checker: a matrix run states the bar it was held to, the gate suite
+    re-derives the raw numbers and holds them to that same bar, and
+    loosening a bar is a reviewed evidence edit, not a code change."""
+
+    kinds: Tuple[str, ...] = ("noise", "blur", "contrast", "pixelate")
+    severities: Tuple[int, ...] = (1, 2, 3, 4, 5)
+    seed: int = 0
+    # verdict floors/tolerances (the config block of the report)
+    auroc_floor: float = 0.70  # every ID x OoD pair must separate this well
+    answered_accuracy_floor: float = 0.70  # at EVERY severity
+    monotone_tol: float = 0.02  # abstention may dip this much between rungs
+    px_divergence_limit: float = 0.25  # clean-ID served-vs-calibration drift
+    auroc_rederive_tol: float = 1e-9  # recorded vs re-derived (tamper bound)
+    score_decimals: int = 5  # stored per-sample score precision
+
+
+def px_sketch_divergence(scores: np.ndarray, calibration) -> Optional[float]:
+    """Mean |served quantile - calibration quantile| over the interior
+    sketch points, in calibration-IQR units — the serving-path counterpart
+    of online/drift.py's px_divergence (same units, so the drift monitor's
+    thresholds transfer). None when there are no scores or the calibration
+    sketch is degenerate."""
+    s = np.asarray(scores, np.float64).ravel()
+    if s.size == 0 or calibration is None:
+        return None
+    ref = np.asarray(calibration.quantile_log_px, np.float64)
+    iqr = ref[75] - ref[25] if ref.size >= 101 else float(np.ptp(ref))
+    if not np.isfinite(iqr) or iqr <= 0:
+        return None
+    qs = np.linspace(0.0, 100.0, ref.size)[1:-1]  # interior points
+    served = np.percentile(s, qs)
+    return float(np.mean(np.abs(served - ref[1:-1])) / iqr)
+
+
+def _cell_from_responses(
+    responses, labels: Optional[np.ndarray], decimals: int
+) -> Dict[str, Any]:
+    """Raw per-cell accounting from one cell's typed responses: outcome
+    counts, correct-on-answered count, served log p(x) scores of the
+    GATED outcomes (predict+abstain — rejects/sheds carry no score)."""
+    outcomes: Dict[str, int] = {}
+    scores: List[float] = []
+    answered = correct = 0
+    for i, r in enumerate(responses):
+        outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+        if r.log_px is not None:
+            scores.append(round(float(r.log_px), decimals))
+        if r.outcome == OUTCOME_PREDICT:
+            answered += 1
+            if labels is not None and r.prediction == int(labels[i]):
+                correct += 1
+    n = len(responses)
+    gated = outcomes.get(OUTCOME_PREDICT, 0) + outcomes.get(
+        OUTCOME_ABSTAIN, 0
+    )
+    return {
+        "n": n,
+        "outcomes": outcomes,
+        "answered": answered,
+        "correct_answered": correct if labels is not None else None,
+        # derived-for-the-reader values; check re-derives from the raws
+        "abstain_rate": (
+            outcomes.get(OUTCOME_ABSTAIN, 0) / gated if gated else None
+        ),
+        "answered_accuracy": (
+            correct / answered if (labels is not None and answered) else None
+        ),
+        "scores": scores,
+    }
+
+
+def serve_cell(
+    engine,
+    images: np.ndarray,
+    labels: Optional[np.ndarray] = None,
+    request_prefix: str = "cell",
+    deadline_s: Optional[float] = None,
+    decimals: int = 5,
+) -> Dict[str, Any]:
+    """Drive one matrix cell through the engine in bucket-sized chunks
+    (bounded host+device memory) and account the typed responses. Every
+    submitted request must come back exactly once — the zero-dropped raw
+    numbers (`submitted`/`returned`) ride in the cell."""
+    chunk = engine.buckets[-1]
+    responses = []
+    submitted = 0
+    for lo in range(0, len(images), chunk):
+        part = images[lo : lo + chunk]
+        ids = [f"{request_prefix}:{lo + i}" for i in range(len(part))]
+        submitted += len(part)
+        responses.extend(
+            engine.serve_all(list(part), deadline_s=deadline_s,
+                             request_ids=ids)
+        )
+    cell = _cell_from_responses(responses, labels, decimals)
+    cell["submitted"] = submitted
+    cell["returned"] = len(responses)
+    return cell
+
+
+def run_matrix(
+    engine,
+    id_images: np.ndarray,
+    id_labels: np.ndarray,
+    ood_sets: Dict[str, np.ndarray],
+    config: MatrixConfig = MatrixConfig(),
+    interp: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """The full robustness matrix as one report dict (see module docstring).
+
+    `engine` must be a calibrated ServingEngine; it is warmed here if the
+    caller has not already done so. `interp` (optional) merges a sharded
+    interpretability result (`trust/interp_sharded.py` /
+    `mgproto-trust interp`) into the same report."""
+    from mgproto_tpu.ops.corrupt import make_corrupt_fn
+
+    if not engine.warmed_up:
+        engine.warmup()
+    # steady state begins AFTER warmup: flush any compiles the monitor saw
+    engine.monitor.check_recompiles()
+
+    id_images = np.asarray(id_images, np.float32)
+    id_labels = np.asarray(id_labels)
+    decimals = config.score_decimals
+
+    report: Dict[str, Any] = {
+        "trust_report": True,
+        "format": TRUST_REPORT_FORMAT,
+        "config": {
+            "kinds": list(config.kinds),
+            "severities": [int(s) for s in config.severities],
+            "seed": int(config.seed),
+            "auroc_floor": config.auroc_floor,
+            "answered_accuracy_floor": config.answered_accuracy_floor,
+            "monotone_tol": config.monotone_tol,
+            "px_divergence_limit": config.px_divergence_limit,
+            "auroc_rederive_tol": config.auroc_rederive_tol,
+            "buckets": [int(b) for b in engine.buckets],
+            "percentile": (
+                engine.gate.calibration.percentile
+                if engine.gate.calibration is not None else None
+            ),
+            "threshold_log_px": (
+                engine.gate.threshold
+                if engine.gate.calibration is not None else None
+            ),
+        },
+    }
+
+    # ---- clean ID anchor + calibration-drift probe
+    id_cell = serve_cell(
+        engine, id_images, id_labels, request_prefix="id",
+        decimals=decimals,
+    )
+    id_cell["px_divergence"] = px_sketch_divergence(
+        np.asarray(id_cell["scores"]), engine.gate.calibration
+    )
+    report["id"] = id_cell
+    _tm.counter(_tm.MATRIX_CELLS).inc(kind="id")
+    _tm.gauge(_tm.ABSTENTION_RATE).set(
+        id_cell["abstain_rate"] or 0.0, cell="id:0"
+    )
+    if id_cell["answered_accuracy"] is not None:
+        _tm.gauge(_tm.ANSWERED_ACCURACY).set(
+            id_cell["answered_accuracy"], cell="id:0"
+        )
+    if id_cell["px_divergence"] is not None:
+        _tm.gauge(_tm.PX_DIVERGENCE).set(id_cell["px_divergence"])
+
+    # ---- ID x OoD pairs
+    pairs = []
+    id_scores = np.asarray(id_cell["scores"], np.float64)
+    for name in sorted(ood_sets):
+        cell = serve_cell(
+            engine, np.asarray(ood_sets[name], np.float32), None,
+            request_prefix=f"ood:{name}", decimals=decimals,
+        )
+        cell["pair"] = name
+        cell["auroc"] = binary_auroc(
+            id_scores, np.asarray(cell["scores"], np.float64)
+        )
+        pairs.append(cell)
+        _tm.counter(_tm.MATRIX_CELLS).inc(kind="ood")
+        _tm.gauge(_tm.PAIR_AUROC).set(cell["auroc"], pair=name)
+        _tm.gauge(_tm.ABSTENTION_RATE).set(
+            cell["abstain_rate"] or 0.0, cell=f"ood:{name}"
+        )
+    report["pairs"] = pairs
+
+    # ---- corruption ladder (risk-coverage curves per kind)
+    from mgproto_tpu.ops.corrupt import per_sample_seeds
+
+    ladder: Dict[str, List[Dict[str, Any]]] = {}
+    for kind in config.kinds:
+        rows = []
+        for severity in config.severities:
+            fn = make_corrupt_fn(kind, int(severity))
+            corrupted = np.empty_like(id_images)
+            chunk = engine.buckets[-1]
+            for lo in range(0, len(id_images), chunk):
+                part = id_images[lo : lo + chunk]
+                seeds = per_sample_seeds(config.seed, len(part), offset=lo)
+                corrupted[lo : lo + len(part)] = np.asarray(
+                    fn(part, seeds), np.float32
+                )
+            cell = serve_cell(
+                engine, corrupted, id_labels,
+                request_prefix=f"{kind}:{severity}", decimals=decimals,
+            )
+            cell["severity"] = int(severity)
+            rows.append(cell)
+            _tm.counter(_tm.MATRIX_CELLS).inc(kind=kind)
+            _tm.gauge(_tm.ABSTENTION_RATE).set(
+                cell["abstain_rate"] or 0.0, cell=f"{kind}:{severity}"
+            )
+            if cell["answered_accuracy"] is not None:
+                _tm.gauge(_tm.ANSWERED_ACCURACY).set(
+                    cell["answered_accuracy"], cell=f"{kind}:{severity}"
+                )
+        ladder[kind] = rows
+    report["ladder"] = ladder
+
+    # ---- serving-path invariants, from the engine itself
+    report["steady_state_recompiles"] = int(engine.monitor.check_recompiles())
+    report["degraded"] = bool(engine.gate.degraded)
+    if interp:
+        report["interp"] = dict(interp)
+        for key, gname in (
+            ("consistency", _tm.INTERP_CONSISTENCY),
+            ("stability", _tm.INTERP_STABILITY),
+            ("purity", _tm.INTERP_PURITY),
+        ):
+            if isinstance(interp.get(key), (int, float)):
+                _tm.gauge(gname).set(float(interp[key]))
+
+    # self-gate for the reader: the SAME derivations check --trust applies
+    # (check re-derives from the raw numbers, never trusts this block)
+    from mgproto_tpu.cli.telemetry import trust_gates
+
+    report["gates"] = trust_gates(report)
+    for row in report["gates"]["rows"]:
+        _tm.counter(_tm.VERDICTS).inc(
+            result="pass" if row["ok"] else "fail"
+        )
+    return report

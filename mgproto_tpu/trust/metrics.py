@@ -1,0 +1,87 @@
+"""Trust-verification metric names and registration (jax-free).
+
+Companion to `serving/metrics.py` / `online/metrics.py`: every trust-plane
+event — matrix cells evaluated, per-pair AUROC, per-severity abstention and
+answered-accuracy, calibration drift on the served score sketch, sharded
+interpretability metric values, verdict outcomes — lands in the telemetry
+registry so `mgproto-telemetry summarize` renders the trust story next to
+throughput and drift. The whole family is PRE-registered with explicit
+zeros (`register_trust_metrics`, called by TelemetrySession) so a run that
+never verified still snapshots the series and `check` baselines can gate
+them — the repo convention `scripts/check_metric_registry.py` enforces.
+
+Values are rates/scores in [0, 1]-ish units or metric percentages, not
+times — no _seconds suffix by design (the unit-convention test allows
+_rate/_fraction/score-named gauges).
+"""
+
+from __future__ import annotations
+
+from mgproto_tpu.telemetry.registry import Counter, Gauge, default_registry
+
+# robustness matrix (trust/matrix.py)
+MATRIX_CELLS = "trust_matrix_cells_total"  # labeled kind= (ood|<corruption>)
+PAIR_AUROC = "trust_pair_auroc"  # labeled pair=<ood set>
+ABSTENTION_RATE = "trust_abstention_rate"  # labeled cell=<kind:severity>
+ANSWERED_ACCURACY = "trust_answered_accuracy"  # labeled cell=
+PX_DIVERGENCE = "trust_px_divergence"  # served-vs-calibration sketch drift
+VERDICTS = "trust_verdict_total"  # labeled result= pass | fail
+
+# sharded interpretability (trust/interp_sharded.py)
+INTERP_CONSISTENCY = "trust_interp_consistency"
+INTERP_STABILITY = "trust_interp_stability"
+INTERP_PURITY = "trust_interp_purity"
+
+COUNTER_HELP = {
+    MATRIX_CELLS:
+        "robustness-matrix cells evaluated through the serving path, by "
+        "kind (ood pair or corruption family)",
+    VERDICTS:
+        "trust verdicts derived by the matrix run, by result (pass/fail) "
+        "— the same derivations `mgproto-telemetry check --trust` re-runs "
+        "from the committed report's raw numbers",
+}
+
+GAUGE_HELP = {
+    PAIR_AUROC:
+        "per ID x OoD pair AUROC of served log p(x) (labeled pair=), "
+        "measured through the CALIBRATED serving path, not a bespoke loop",
+    ABSTENTION_RATE:
+        "abstain fraction of a matrix cell's typed responses (labeled "
+        "cell=<kind:severity>; clean ID is cell=id:0)",
+    ANSWERED_ACCURACY:
+        "accuracy over PREDICT outcomes only of a matrix cell (labeled "
+        "cell=) — the risk half of the risk-coverage curve",
+    PX_DIVERGENCE:
+        "mean |served-quantile - calibration-quantile| of clean-ID "
+        "log p(x), in calibration-IQR units (the serving-path counterpart "
+        "of drift_px_divergence)",
+    INTERP_CONSISTENCY:
+        "prototype consistency (%) from the sharded evaluator",
+    INTERP_STABILITY:
+        "prototype stability (%) from the sharded evaluator",
+    INTERP_PURITY:
+        "prototype purity mean (%) from the sharded evaluator",
+}
+
+ALL_COUNTERS = tuple(COUNTER_HELP)
+ALL_GAUGES = tuple(GAUGE_HELP)
+
+
+def counter(name: str) -> Counter:
+    """The named trust counter in the process-current registry."""
+    return default_registry().counter(name, COUNTER_HELP.get(name, ""))
+
+
+def gauge(name: str) -> Gauge:
+    """The named trust gauge in the process-current registry."""
+    return default_registry().gauge(name, GAUGE_HELP.get(name, ""))
+
+
+def register_trust_metrics(registry) -> None:
+    """Pre-create the trust family with explicit zero-valued unlabeled
+    series (the check_metric_registry contract)."""
+    for name in ALL_COUNTERS:
+        registry.counter(name, COUNTER_HELP[name]).inc(0.0)
+    for name in ALL_GAUGES:
+        registry.gauge(name, GAUGE_HELP[name]).set(0.0)

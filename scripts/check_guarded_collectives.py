@@ -20,8 +20,11 @@ never by re-wrapping the agreement primitive `any_across_hosts` (its ONE
 sanctioned policy wrapper is `resilience/preemption.py::
 requested_any_host`; other recovery callers route through it).
 
-AST-based (companion to check_no_blocking_sleep.py). Flags, in every module
-under mgproto_tpu/ except the allowlisted wrapper modules:
+AST-based (companion to check_no_blocking_sleep.py). The walk covers ALL
+of mgproto_tpu/ — new packages (e.g. mgproto_tpu/trust/, ISSUE 15) are
+covered BY CONSTRUCTION, and tests/test_trust.py proves the walk reaches
+them with a violation-detection case. Flags, in every module under
+mgproto_tpu/ except the allowlisted wrapper modules:
 
   * any import of `jax.experimental.multihost_utils` (plain, from-import,
     or aliased) and any attribute use of a name bound to it;

@@ -13,7 +13,11 @@ The online continual-learning plane (mgproto_tpu/online/, ISSUE 11) lives
 under the same contract: its consolidation/drift cadences are poll-driven
 `tick(now)` loops on injected clocks — a sleep there would either stall the
 pump that hosts the ticks or make the virtual-clock drift drill
-nondeterministic, so both packages are linted. The autoscaler
+nondeterministic, so both packages are linted. The trust verification
+plane (mgproto_tpu/trust/, ISSUE 15) is linted for the same reason: its
+matrix drives the production engine and its committed drill must stay
+deterministic — a sleep in a matrix cell would skew every latency it
+records. The autoscaler
 (serving/autoscale.py, ISSUE 13) is covered by the serving/ walk BY
 CONSTRUCTION — its control loop is a pump-hook `tick(now)` on the plane's
 clock, and tests/test_autoscale.py proves the walk reaches it with a
@@ -93,7 +97,7 @@ def _offending_calls(tree: ast.AST) -> Iterator[Tuple[int, str]]:
             )
 
 
-_LINTED_PACKAGES = ("serving", "online")
+_LINTED_PACKAGES = ("serving", "online", "trust")
 
 
 def offenders(repo_root: str) -> List[Tuple[str, int, str]]:

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Generate evidence/interp/sharded_parity.json — the committed fixture
+that pins the SHARDED interpretability evaluators (trust/interp_sharded.py)
+against the single-device implementations (engine/interpretability.py).
+
+Deterministic end to end: a seeded synthetic CUB-layout tree (images, part
+locations, visibility), a tiny seeded model on the virtual 8-device
+(data=2, model=4) CPU mesh, one clean + one noisy activation pass. The
+fixture records the single-device metrics; tests/test_trust.py re-derives
+BOTH paths against the same tree and asserts all three agree with the
+committed numbers — so a drift in either the geometry post-pass or the
+shard_mapped gather fails tier-1.
+
+Regenerate (only when the fixture's inputs legitimately change):
+
+    python scripts/interp_parity_fixture.py [--out evidence/interp/sharded_parity.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+SEED = 7
+NUM_CLASSES = 4
+PER_CLASS = 4  # test images per class
+PART_NUM = 5
+IMG = 32
+HALF = 8  # discriminative box half-size at 32px
+PART_THRESH = 0.4  # below the 0.8 default: a random model scores 0.0
+# there, and an all-zero pin would not catch a consistency regression
+MESH = (2, 4)  # (data, model) — classes divide the model axis
+
+
+def build_parity_tree(root: str, seed: int = SEED) -> None:
+    """Seeded mini CUB_200_2011-layout tree (images.txt, parts/, images/)."""
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    os.makedirs(os.path.join(root, "parts"), exist_ok=True)
+    images, labels, split, bboxes, part_locs = [], [], [], [], []
+    img_id = 0
+    for c in range(NUM_CLASSES):
+        folder = f"{c + 1:03d}.Class_{c}"
+        os.makedirs(os.path.join(root, "images", folder), exist_ok=True)
+        for i in range(PER_CLASS):
+            img_id += 1
+            w, h = 48, 40  # non-square original: exercises part rescaling
+            arr = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(
+                os.path.join(root, "images", folder, f"img_{i}.jpg")
+            )
+            images.append(f"{img_id} {folder}/img_{i}.jpg")
+            labels.append(f"{img_id} {c + 1}")
+            split.append(f"{img_id} 0")  # all test
+            bboxes.append(f"{img_id} 2.0 2.0 {w - 4}.0 {h - 4}.0")
+            for pid in range(1, PART_NUM + 1):
+                visible = int(rng.rand() < 0.8)
+                x, y = rng.randint(4, w - 4), rng.randint(4, h - 4)
+                part_locs.append(
+                    f"{img_id} {pid} {float(x)} {float(y)} {visible}"
+                )
+    def w_(name, rows):
+        with open(os.path.join(root, name), "w") as f:
+            f.write("\n".join(rows) + "\n")
+    w_("images.txt", images)
+    w_("image_class_labels.txt", labels)
+    w_("train_test_split.txt", split)
+    w_("bounding_boxes.txt", bboxes)
+    w_(os.path.join("parts", "parts.txt"),
+       [f"{p} part_{p}" for p in range(1, PART_NUM + 1)])
+    w_(os.path.join("parts", "part_locs.txt"), part_locs)
+
+
+def compute_metrics(tree_root: str, sharded: bool):
+    """(consistency, stability, purity, purity_std) over the tree via the
+    single-device or the sharded evaluators — shared with the tier-1
+    parity test."""
+    import dataclasses as dc
+
+    import jax
+
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.data import Cub2011Eval, DataLoader, ood_transform
+    from mgproto_tpu.data.cub_parts import CubParts
+    from mgproto_tpu.parallel import ShardedTrainer
+
+    cfg = tiny_test_config(num_classes=NUM_CLASSES, img_size=IMG)
+    cfg = cfg.replace(mesh=dc.replace(cfg.mesh, data=MESH[0], model=MESH[1]))
+    trainer = ShardedTrainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(jax.random.PRNGKey(SEED))
+    parts = CubParts(tree_root)
+    dataset = Cub2011Eval(tree_root, train=False,
+                          transform=ood_transform(IMG))
+
+    def batches():
+        return iter(DataLoader(dataset, 8, num_workers=0))
+
+    if sharded:
+        from mgproto_tpu.trust.interp_sharded import interp_metrics_sharded
+
+        m = interp_metrics_sharded(
+            trainer, state, batches, parts, NUM_CLASSES,
+            consistency_half_size=HALF, purity_half_size=HALF,
+            top_k=3, noise_seed=SEED, part_thresh=PART_THRESH,
+        )
+        return (m["consistency"], m["stability"], m["purity"],
+                m["purity_std"])
+    from mgproto_tpu.engine.interpretability import (
+        collect_gt_activations,
+        evaluate_consistency,
+        evaluate_purity,
+        evaluate_stability,
+        make_gt_act_fn,
+    )
+
+    act_fn = make_gt_act_fn(trainer.model)
+    clean = collect_gt_activations(trainer, state, batches(), act_fn=act_fn)
+    consistency = evaluate_consistency(
+        trainer, state, None, parts, NUM_CLASSES, half_size=HALF,
+        part_thresh=PART_THRESH, activations=clean,
+    )
+    stability = evaluate_stability(
+        trainer, state, batches, parts, NUM_CLASSES, half_size=HALF,
+        noise_seed=SEED, activations=clean, act_fn=act_fn,
+    )
+    purity, purity_std = evaluate_purity(
+        trainer, state, None, parts, NUM_CLASSES, half_size=HALF,
+        top_k=3, activations=clean,
+    )
+    return consistency, stability, purity, purity_std
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="evidence/interp/sharded_parity.json")
+    args = p.parse_args(argv)
+
+    from mgproto_tpu.hermetic import pin_cpu_devices
+
+    pin_cpu_devices(8)
+    import tempfile
+
+    tree = tempfile.mkdtemp(prefix="mgproto_interp_parity_")
+    build_parity_tree(tree)
+    single = compute_metrics(tree, sharded=False)
+    shard = compute_metrics(tree, sharded=True)
+    record = {
+        "interp_parity_fixture": True,
+        "what": "single-device interpretability metrics on the seeded "
+                "synthetic CUB tree — the committed pin both the "
+                "single-device and the shard_mapped (data=2, model=4) "
+                "evaluators must reproduce exactly (tests/test_trust.py)",
+        "seed": SEED,
+        "classes": NUM_CLASSES,
+        "per_class": PER_CLASS,
+        "part_num": PART_NUM,
+        "img_size": IMG,
+        "half_size": HALF,
+        "part_thresh": PART_THRESH,
+        "mesh": {"data": MESH[0], "model": MESH[1]},
+        "consistency": single[0],
+        "stability": single[1],
+        "purity": single[2],
+        "purity_std": single[3],
+        "sharded_matches": [
+            abs(a - b) for a, b in zip(single, shard)
+        ],
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+    print(json.dumps(record))
+    if max(record["sharded_matches"]) > 1e-9:
+        print("WARNING: sharded metrics diverge from single-device",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,6 +122,12 @@ def main() -> None:
     p.add_argument("--stage", default="nopush",
                    help="checkpoint stage to evaluate (reference reports its "
                         "headline numbers pre-push)")
+    p.add_argument("--score_rule", default="sum",
+                   choices=["sum", "max", "paper"],
+                   help="operating-point rule passed through to "
+                        "evaluate_with_ood (recorded in the summary as "
+                        "score_rule; AUROC per rule is reported under "
+                        "score_variants_auroc either way)")
     args = p.parse_args()
 
     from mgproto_tpu.hermetic import pin_cpu_devices
@@ -160,7 +166,10 @@ def main() -> None:
     _, _, test_loader, ood_loaders = build_pipelines(cfg)
     print(f"loaded {path}")
 
-    _, results = _test(trainer, state, test_loader, ood_loaders, print)
+    # the operating-point rule rides into the summary as "score_rule"
+    # (evaluate_with_ood records it in its results dict)
+    _, results = _test(trainer, state, test_loader, ood_loaders, print,
+                       score_rule=args.score_rule)
 
     # beyond-parity scoring comparison (VERDICT r3 item 7): evaluate_with_ood
     # now reports AUROC under alternative rules (max-over-classes,

@@ -121,19 +121,57 @@ def test_launch_scripts_parse():
         assert proc.returncode == 0, (script, proc.stderr)
 
 
+def _load_pyproject(path):
+    """pyproject.toml as a dict: stdlib `tomllib` on 3.11+, a minimal
+    vendored parse of the two tables this test reads on the container's
+    3.10 (tomllib landed in 3.11 — the import was the long-standing
+    pre-existing failure this guard fixes). The fallback handles exactly
+    what our pyproject uses: `[table.headers]`, `key = "string"`, and
+    `key = ["list", "of", "strings"]`."""
+    try:
+        import tomllib
+
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    except ModuleNotFoundError:
+        pass
+    import re
+
+    meta = {}
+    table = meta
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.fullmatch(r"\[([A-Za-z0-9_.\-]+)\]", line)
+            if m:
+                table = meta
+                for part in m.group(1).split("."):
+                    table = table.setdefault(part, {})
+                continue
+            m = re.fullmatch(r'([A-Za-z0-9_\-]+)\s*=\s*"([^"]*)"', line)
+            if m:
+                table[m.group(1)] = m.group(2)
+                continue
+            m = re.fullmatch(r"([A-Za-z0-9_\-]+)\s*=\s*\[(.*)\]", line)
+            if m:
+                table[m.group(1)] = re.findall(r'"([^"]*)"', m.group(2))
+    return meta
+
+
 def test_packaging_entry_points_resolve():
     """pyproject.toml's console scripts must point at real callables and the
     package-discovery glob must match the actual package name."""
     import importlib
-    import tomllib
 
     root = os.path.dirname(os.path.dirname(__file__))
-    with open(os.path.join(root, "pyproject.toml"), "rb") as f:
-        meta = tomllib.load(f)
+    meta = _load_pyproject(os.path.join(root, "pyproject.toml"))
     scripts = meta["project"]["scripts"]
     assert set(scripts) == {
         "mgproto-train", "mgproto-eval", "mgproto-interpret", "mgproto-prep",
         "mgproto-export", "mgproto-telemetry", "mgproto-serve",
+        "mgproto-online", "mgproto-trust",
     }
     for target in scripts.values():
         mod_name, fn_name = target.split(":")

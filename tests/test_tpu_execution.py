@@ -90,7 +90,20 @@ def _backward_parity(interpret: bool):
 
     g_f = np.asarray(jax.jit(jax.grad(loss_fused))(feat))
     g_u = np.asarray(jax.jit(jax.grad(loss_unfused))(feat))
-    np.testing.assert_allclose(g_f, g_u, rtol=1e-4, atol=1e-4)
+    # Tolerance root-caused (ISSUE 15, the PR-14 remat rationale): at
+    # T=HW the gradient sums C*K=2000 per-prototype terms per element,
+    # and the kernel's VMEM-tiled VJP accumulates them in a different
+    # ORDER than XLA's unfused reduce. Measured against a float64 oracle,
+    # the unfused f32 gradient is exact at these shapes while the fused
+    # kernel differs by up to ~2e-3 relative on small elements / ~1.5e-3
+    # absolute — pure f32 reassociation rounding, which scales with the
+    # LARGEST summed terms (|g| reaches ~6.8e3 here), not with the
+    # possibly-cancelled element value. A fixed atol=1e-4 sat below that
+    # noise floor; the atol is therefore leaf-scaled to the gradient's
+    # own magnitude.
+    np.testing.assert_allclose(
+        g_f, g_u, rtol=1e-4, atol=1e-6 * float(np.abs(g_u).max())
+    )
 
 
 def test_fused_backward_parity_interpret_cpu():
