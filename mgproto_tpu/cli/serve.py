@@ -319,6 +319,15 @@ serving chaos-injection env knobs (fault drills; all off by default):
                                       poison the first N hot-swap attempts
                                       with a trust-stripped artifact (the
                                       swap must fail CLOSED)
+  MGPROTO_CHAOS_TENANT_STORM_AT       from this request index the load drill
+                                      floods ONE tenant over its fair-share
+                                      quota (only its own tail may shed)
+  MGPROTO_CHAOS_TENANT_BAD_SWAP       poison the first N tenant-scoped head
+                                      swaps with a trust-stripped head (that
+                                      tenant fails closed, others serve on)
+  MGPROTO_CHAOS_TENANT_POISON_RATE    fraction of the storm tenant's traffic
+                                      made OoD junk (its drift monitor must
+                                      breach; quiet tenants stay flat)
 """
 
 

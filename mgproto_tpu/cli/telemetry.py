@@ -315,6 +315,105 @@ def _autoscale_section(last: Dict) -> Optional[Dict[str, Any]]:
     }
 
 
+def _tenant_nested(
+    snapshot: Dict, name: str, inner_key: str
+) -> Dict[str, Dict[str, float]]:
+    """{tenant: {inner_label: count}} for a tenant-labeled counter."""
+    out: Dict[str, Dict[str, float]] = {}
+    for s in snapshot.get(name, {}).get("series", []):
+        labels = s.get("labels", {})
+        t, k = labels.get("tenant"), labels.get(inner_key)
+        if t is not None and k is not None and s.get("value"):
+            row = out.setdefault(t, {})
+            row[k] = row.get(k, 0.0) + s["value"]
+    return out
+
+
+def _tenants_section(last: Dict) -> Optional[Dict[str, Any]]:
+    """Multi-tenant serving story (ISSUE 17): heads mounted on the shared
+    trunk, per-tenant request/shed/swap ledgers, head bytes, per-tenant
+    latency. The family is pre-registered, so presence alone says nothing;
+    the section renders only once a tenant has actually mounted or served
+    — a single-tenant fleet stays a single-tenant summary."""
+    from mgproto_tpu.serving import metrics as sm  # jax-free
+
+    names = (
+        sm.TENANTS_MOUNTED, sm.TENANT_MOUNTS, sm.TENANT_REQUESTS,
+        sm.TENANT_SHED, sm.TENANT_SWAPS, sm.TENANT_HEAD_BYTES,
+    )
+    if not any(name in last for name in names):
+        return None
+    mounted = _series_value(last, sm.TENANTS_MOUNTED)
+    mount_total = _series_value(last, sm.TENANT_MOUNTS)
+    requests = _series_by_label(last, sm.TENANT_REQUESTS, "tenant")
+    if not (mounted or mount_total or requests):
+        return None
+    head_bytes: Dict[str, float] = {}
+    for s in last.get(sm.TENANT_HEAD_BYTES, {}).get("series", []):
+        t = s.get("labels", {}).get("tenant")
+        if t is not None and s.get("value") is not None:
+            head_bytes[t] = s["value"]
+    latency: Dict[str, Dict[str, Any]] = {}
+    for s in last.get(sm.TENANT_REQUEST_SECONDS, {}).get("series", []):
+        t = s.get("labels", {}).get("tenant")
+        if t is None or not s.get("count"):
+            continue
+        row = latency.get(t)
+        if row is None:
+            latency[t] = {
+                "bounds": list(s["bounds"]),
+                "bucket_counts": list(s["bucket_counts"]),
+                "count": s["count"],
+                "sum": s["sum"],
+                "min": s["min"],
+                "max": s["max"],
+            }
+        else:
+            row["bucket_counts"] = [
+                a + b for a, b in
+                zip(row["bucket_counts"], s["bucket_counts"])
+            ]
+            row["count"] += s["count"]
+            row["sum"] += s["sum"]
+            for k, pick in (("min", min), ("max", max)):
+                if s[k] is not None:
+                    row[k] = (
+                        s[k] if row[k] is None else pick(row[k], s[k])
+                    )
+    latency_ms = {
+        t: {
+            "count": row["count"],
+            "mean_ms": round(1e3 * row["sum"] / row["count"], 3),
+            "p99_ms": round(
+                1e3 * percentile_from_buckets(row, 99.0), 3
+            ),
+        }
+        for t, row in latency.items()
+    }
+    try:
+        from mgproto_tpu.online import metrics as om  # jax-free
+
+        drift_breaches = _series_by_label(
+            last, om.DRIFT_BREACHES, "tenant"
+        )
+    except Exception:
+        drift_breaches = {}
+    return {
+        "mounted": mounted,
+        "mount_total": mount_total,
+        "unmount_total": _series_value(last, sm.TENANT_UNMOUNTS),
+        "requests_by_tenant": requests,
+        "outcomes_by_tenant": _tenant_nested(
+            last, sm.TENANT_REQUESTS, "outcome"
+        ),
+        "shed_by_tenant": _tenant_nested(last, sm.TENANT_SHED, "reason"),
+        "swaps_by_tenant": _tenant_nested(last, sm.TENANT_SWAPS, "result"),
+        "head_bytes_by_tenant": head_bytes,
+        "latency_by_tenant": latency_ms,
+        "drift_breaches_by_tenant": drift_breaches,
+    }
+
+
 def _drift_section(last: Dict) -> Optional[Dict[str, Any]]:
     """Online-learning drift story (ISSUE 11): p(x) sketch divergence,
     per-class bank shift top-k, captures by outcome, consolidation +
@@ -532,6 +631,10 @@ def summarize(telemetry_dir: str) -> Dict[str, Any]:
     autoscale = _autoscale_section(last)
     if autoscale is not None:
         summary["autoscale"] = autoscale
+
+    tenants = _tenants_section(last)
+    if tenants is not None:
+        summary["tenants"] = tenants
 
     drift = _drift_section(last)
     if drift is not None:
@@ -1228,6 +1331,168 @@ def autoscale_gates(record: Dict[str, Any]) -> Dict[str, Any]:
             "failed": sum(not r["ok"] for r in rows), "rows": rows}
 
 
+def tenant_gates(
+    record: Dict[str, Any], quiet_p99_tol: float = 2.0
+) -> Dict[str, Any]:
+    """Gate a committed multi-tenant isolation record (`load_test.py
+    --tenants N` -> evidence/tenant_baseline.json). Every verdict is
+    RE-DERIVED from the raw per-tenant ledgers — never from a stored
+    summary verdict, which would gate nothing:
+
+      * the per-tenant ledger balances: each tenant's submitted count
+        equals the sum of its outcomes, typed sheds equal the shed
+        outcome, and the tenant ledgers together cover ALL traffic in
+        the overall ledger (nothing untagged slipped past the plane);
+      * the quota storm stayed in its lane: the storm tenant shed with
+        the typed `tenant_quota` reason, every quiet tenant shed ZERO,
+        answered everything, and its in-storm p99 stayed within
+        `quiet_p99_tol` x its calm p99 (only tenants observed in BOTH
+        windows are compared; a mid-storm mount has no calm baseline);
+      * the sabotaged swap failed closed for the storm tenant ONLY —
+        quiet tenant's swap committed with a new head fingerprint while
+        the storm raged;
+      * the mid-storm mount cost head bytes and ZERO trunk compiles /
+        AOT misses (heads live outside executable identity);
+      * poisoned traffic breached ONLY the storm tenant's drift monitor
+        — quiet monitors stayed silent on the same trunk;
+      * warmup compiled at most buckets x replicas executables and
+        steady state recompiled ZERO."""
+    rows: List[Dict[str, Any]] = []
+
+    def gate(key: str, ok: bool, why: str = "") -> None:
+        rows.append({"key": key, "ok": bool(ok),
+                     "why": "" if ok else why, "baseline": None,
+                     "value": None, "direction": "tenants"})
+
+    t = record.get("tenants") or {}
+    gate("tenants.record", bool(t),
+         "record has no 'tenants' section — not a multi-tenant drill")
+    per = t.get("per_tenant") or {}
+    storm = t.get("storm_tenant")
+    gate("tenants.multi",
+         (t.get("count") or 0) >= 3 and storm in per,
+         f"count={t.get('count')} storm_tenant={storm!r} "
+         f"tenants={sorted(per)}")
+    overall = record.get("overall") or {}
+    gate("tenants.zero_dropped", overall.get("zero_dropped") is True,
+         "drill dropped requests")
+
+    bad_ledgers = []
+    bad_sheds = []
+    for name, row in sorted(per.items()):
+        outcomes = row.get("outcomes") or {}
+        if row.get("submitted") != sum(outcomes.values()):
+            bad_ledgers.append(
+                f"{name}: submitted={row.get('submitted')} "
+                f"outcomes_sum={sum(outcomes.values())}")
+        shed_typed = sum((row.get("shed_by_reason") or {}).values())
+        if shed_typed != (outcomes.get("shed") or 0):
+            bad_sheds.append(
+                f"{name}: typed={shed_typed} "
+                f"outcome={outcomes.get('shed') or 0}")
+    gate("tenants.ledger_consistent", bool(per) and not bad_ledgers,
+         "; ".join(bad_ledgers) or "no per-tenant rows")
+    gate("tenants.shed_ledger_consistent", bool(per) and not bad_sheds,
+         "; ".join(bad_sheds) or "no per-tenant rows")
+    tenant_sum = sum(row.get("submitted") or 0 for row in per.values())
+    gate("tenants.covers_all_traffic",
+         bool(per) and tenant_sum == overall.get("submitted"),
+         f"tenant ledgers sum {tenant_sum} vs overall "
+         f"{overall.get('submitted')}")
+
+    storm_row = per.get(storm) or {}
+    quiet = {n: r for n, r in per.items() if n != storm}
+    storm_sheds = storm_row.get("shed_by_reason") or {}
+    gate("tenants.storm_quota_shed",
+         (storm_sheds.get("tenant_quota") or 0) > 0,
+         f"storm tenant shed_by_reason={storm_sheds} — quota never bound")
+    noisy = [n for n, r in sorted(quiet.items())
+             if sum((r.get("shed_by_reason") or {}).values())
+             or (r.get("outcomes") or {}).get("shed")]
+    gate("tenants.quiet_zero_shed", bool(quiet) and not noisy,
+         f"quiet tenants shed: {noisy}" if noisy else "no quiet tenants")
+    unanswered = [
+        n for n, r in sorted(quiet.items())
+        if set(r.get("outcomes") or {}) - {"predict", "abstain"}
+    ]
+    gate("tenants.quiet_all_answered", bool(quiet) and not unanswered,
+         f"quiet tenants with non-answer outcomes: {unanswered}"
+         if unanswered else "no quiet tenants")
+    compared = []
+    slow = []
+    for name, row in sorted(quiet.items()):
+        calm = (row.get("calm") or {}).get("p99_ms")
+        in_storm = (row.get("storm") or {}).get("p99_ms")
+        if not isinstance(calm, (int, float)) or not isinstance(
+            in_storm, (int, float)
+        ):
+            continue  # mounted mid-storm: no calm baseline to hold flat
+        compared.append(name)
+        if in_storm > quiet_p99_tol * calm:
+            slow.append(f"{name}: storm p99 {in_storm} vs calm {calm}")
+    gate("tenants.quiet_p99_flat", bool(compared) and not slow,
+         "; ".join(slow) if slow
+         else "no quiet tenant observed in both calm and storm windows")
+
+    swaps = t.get("swaps") or []
+    storm_swaps = [s for s in swaps if s.get("tenant") == storm]
+    quiet_swaps = [s for s in swaps if s.get("tenant") != storm]
+    gate("tenants.bad_swap_fail_closed",
+         bool(storm_swaps)
+         and all(s.get("ok") is False and s.get("reason")
+                 for s in storm_swaps),
+         f"storm tenant swaps: {storm_swaps}")
+    gate("tenants.good_swap_committed",
+         any(s.get("ok") is True and s.get("reason") == "committed"
+             and s.get("head_fingerprint") for s in quiet_swaps),
+         f"quiet tenant swaps: {quiet_swaps}")
+
+    mounts = t.get("mounts") or []
+    mid_storm = [m for m in mounts if m.get("during_storm")]
+    gate("tenants.mid_storm_mount", bool(mid_storm),
+         "no tenant was mounted while the storm raged")
+    compiled = [
+        f"{m.get('tenant')}: trunk={m.get('trunk_compiles_delta')} "
+        f"aot_misses={m.get('aot_misses_delta')}"
+        for m in mounts
+        if m.get("trunk_compiles_delta") != 0
+        or m.get("aot_misses_delta") != 0
+    ]
+    gate("tenants.mount_zero_trunk_compiles",
+         bool(mounts) and not compiled,
+         "; ".join(compiled) or "no mounts recorded")
+    costless = [m.get("tenant") for m in mounts
+                if not (m.get("head_bytes") or 0) > 0]
+    gate("tenants.mount_head_cost_measured",
+         bool(mounts) and not costless,
+         f"mounts without measured head bytes: {costless}"
+         if costless else "no mounts recorded")
+
+    gate("tenants.storm_drift_breached",
+         (t.get("poison_injected") or 0) > 0
+         and (storm_row.get("drift_breaches") or 0) > 0,
+         f"poison_injected={t.get('poison_injected')} storm breaches="
+         f"{storm_row.get('drift_breaches')}")
+    leaked = [n for n, r in sorted(quiet.items())
+              if r.get("drift_breaches")]
+    gate("tenants.quiet_drift_silent", bool(quiet) and not leaked,
+         f"quiet tenants breached drift: {leaked}"
+         if leaked else "no quiet tenants")
+
+    cfg = record.get("config") or {}
+    budget = len(cfg.get("buckets") or []) * (cfg.get("replicas") or 0)
+    warm = record.get("warmup_compiles")
+    gate("tenants.warmup_bounded",
+         isinstance(warm, int) and 0 < warm <= budget,
+         f"warmup_compiles={warm} budget={budget}")
+    gate("tenants.zero_steady_recompiles",
+         record.get("steady_state_recompiles") == 0,
+         f"recompiled in steady state: "
+         f"{record.get('steady_state_recompiles')}")
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
 def weakscale_gates(
     record: Dict[str, Any],
     shrink_min_at_2: float = 1.8,
@@ -1766,6 +2031,14 @@ def check_main(argv: Optional[list] = None) -> int:
                         "scale-out under the ramp, AOT-cached scale-up "
                         "warmups, p99 flat band, bounded shed, zero-drop "
                         "scale-down — exit 1 on any failure")
+    p.add_argument("--tenants", default=None, metavar="FILE",
+                   help="gate a committed multi-tenant isolation record "
+                        "(load_test.py --tenants N -> evidence/"
+                        "tenant_baseline.json): quota storm sheds only "
+                        "the storm tenant, quiet p99 flat, bad swap "
+                        "fail-closed per tenant, mid-storm mount with "
+                        "zero trunk compiles, drift isolation — exit 1 "
+                        "on any failure")
     p.add_argument("--weakscale", default=None, metavar="FILE",
                    help="gate a committed weak-scaling record (bench.py "
                         "--measure weakscale -> evidence/weakscale_bench"
@@ -1863,6 +2136,12 @@ def check_main(argv: Optional[list] = None) -> int:
         result = autoscale_gates(record)
         _emit_suite("autoscale", result)
         suites_ok = suites_ok and result["ok"]
+    if args.tenants:
+        any_suite = True
+        record = _read_json(args.tenants, "tenant record")
+        result = tenant_gates(record)
+        _emit_suite("tenants", result)
+        suites_ok = suites_ok and result["ok"]
     if args.weakscale:
         any_suite = True
         record = _read_json(args.weakscale, "weakscale record")
@@ -1875,8 +2154,8 @@ def check_main(argv: Optional[list] = None) -> int:
     if args.dir is None or args.baseline is None:
         raise SystemExit(
             "check needs a telemetry dir AND --baseline (or --drift-drill "
-            "/ --stall-report / --autoscale / --weakscale / --trust FILE "
-            "alone)"
+            "/ --stall-report / --autoscale / --tenants / --weakscale / "
+            "--trust FILE alone)"
         )
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")

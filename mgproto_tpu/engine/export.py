@@ -275,6 +275,18 @@ def load_exported(path: str) -> Tuple[Any, Dict[str, Any]]:
     return exported, meta
 
 
+def artifact_head_fingerprint(path: str) -> str:
+    """The artifact's HEAD identity (ISSUE 17): sha256 over its embedded
+    calibration payload — the half of the serving identity that is
+    per-tenant. The trunk half is `artifact_aot_fingerprint` below; the
+    split is what lets N tenants share one compiled trunk in the AOT cache
+    while each mounts its own head. "" when the artifact carries no
+    calibration (a head that only serves degraded)."""
+    from mgproto_tpu.serving.tenants import head_fingerprint
+
+    return head_fingerprint(load_calibration(path))
+
+
 def artifact_aot_fingerprint(path: str) -> str:
     """The artifact face's AOT-cache program fingerprint: sha256 of the
     `.mgproto` file + the mixture fingerprint from its meta. The ONE

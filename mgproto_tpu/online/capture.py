@@ -93,10 +93,16 @@ class TrustedCapture:
         calibration,
         num_classes: int,
         config: Optional[CaptureConfig] = None,
+        tenant: Optional[str] = None,
     ):
         self.config = config or CaptureConfig()
         self.num_classes = int(num_classes)
         self.calibration = calibration
+        # multi-tenant serving (ISSUE 17): a tenant-owned reservoir labels
+        # its capture counters, so each tenant's self-labeling stream is
+        # accounted separately. None = the single-tenant tap, unchanged.
+        self.tenant = tenant
+        self._labels = {} if tenant is None else {"tenant": str(tenant)}
         self.threshold: Optional[float] = None
         if calibration is not None:
             self.threshold = calibration.threshold_for(
@@ -136,7 +142,9 @@ class TrustedCapture:
                 or resp.trust != "in_dist"
                 or resp.log_px is None
             ):
-                om.counter(om.CAPTURED).inc(outcome=OUTCOME_SKIPPED)
+                om.counter(om.CAPTURED).inc(
+                    outcome=OUTCOME_SKIPPED, **self._labels
+                )
                 return False
             if self.threshold is None or not (
                 float(resp.log_px) > self.threshold
@@ -144,11 +152,15 @@ class TrustedCapture:
                 # at-or-below the capture percentile (or no calibration to
                 # gate with): the poison drill's low-p(x) mislabeled junk
                 # lands here when it lands anywhere at all
-                om.counter(om.CAPTURED).inc(outcome=OUTCOME_GATE_REJECTED)
+                om.counter(om.CAPTURED).inc(
+                    outcome=OUTCOME_GATE_REJECTED, **self._labels
+                )
                 return False
             cls = int(resp.prediction)
             if not 0 <= cls < self.num_classes:
-                om.counter(om.CAPTURED).inc(outcome=OUTCOME_CLASS_UNKNOWN)
+                om.counter(om.CAPTURED).inc(
+                    outcome=OUTCOME_CLASS_UNKNOWN, **self._labels
+                )
                 return False
             self._stage(CapturedSample(
                 payload=payload,
@@ -156,7 +168,9 @@ class TrustedCapture:
                 log_px=float(resp.log_px),
                 request_id=resp.request_id,
             ))
-            om.counter(om.CAPTURED).inc(outcome=OUTCOME_ACCEPTED)
+            om.counter(om.CAPTURED).inc(
+                outcome=OUTCOME_ACCEPTED, **self._labels
+            )
             return True
         except Exception:
             return False
@@ -169,7 +183,9 @@ class TrustedCapture:
         but still bounded by the same reservoirs."""
         cls = int(class_id)
         if not 0 <= cls < self.num_classes:
-            om.counter(om.CAPTURED).inc(outcome=OUTCOME_CLASS_UNKNOWN)
+            om.counter(om.CAPTURED).inc(
+                outcome=OUTCOME_CLASS_UNKNOWN, **self._labels
+            )
             return False
         self._stage(CapturedSample(
             payload=payload,
@@ -178,7 +194,9 @@ class TrustedCapture:
             request_id=request_id,
             labeled=True,
         ))
-        om.counter(om.CAPTURED).inc(outcome=OUTCOME_LABELED)
+        om.counter(om.CAPTURED).inc(
+            outcome=OUTCOME_LABELED, **self._labels
+        )
         return True
 
     def was_captured(self, request_id: str) -> bool:

@@ -105,10 +105,17 @@ class DriftMonitor:
         calibration,
         config: Optional[DriftConfig] = None,
         clock=time.monotonic,
+        tenant: Optional[str] = None,
     ):
         self.config = config or DriftConfig()
         self.clock = clock
         self.calibration = calibration
+        # multi-tenant serving (ISSUE 17): a tenant-owned monitor labels
+        # every gauge/breach with its tenant, so one tenant's drifting
+        # traffic is ATTRIBUTED, not a fleet-wide alarm. None = the
+        # single-tenant monitor, metrics unchanged.
+        self.tenant = tenant
+        self._labels = {} if tenant is None else {"tenant": str(tenant)}
         self._scores: Deque[float] = deque(
             maxlen=max(int(self.config.px_window), 1)
         )
@@ -204,11 +211,13 @@ class DriftMonitor:
         ):
             signals.append(SIGNAL_BANK)
         if div is not None:
-            om.gauge(om.DRIFT_PX_DIVERGENCE).set(div)
-        om.gauge(om.DRIFT_SHIFT_MAX).set(mean_max)
-        om.gauge(om.DRIFT_COV_SHIFT_MAX).set(cov_max)
+            om.gauge(om.DRIFT_PX_DIVERGENCE).set(div, **self._labels)
+        om.gauge(om.DRIFT_SHIFT_MAX).set(mean_max, **self._labels)
+        om.gauge(om.DRIFT_COV_SHIFT_MAX).set(cov_max, **self._labels)
         for c, v in per_class.items():
-            om.gauge(om.DRIFT_CLASS_SHIFT).set(v, **{"class": str(c)})
+            om.gauge(om.DRIFT_CLASS_SHIFT).set(
+                v, **{"class": str(c), **self._labels}
+            )
         report = DriftReport(
             t=now,
             px_divergence=div,
@@ -223,12 +232,15 @@ class DriftMonitor:
             if self.first_breach is None:
                 self.first_breach = report
             for sig in signals:
-                om.counter(om.DRIFT_BREACHES).inc(signal=sig)
+                om.counter(om.DRIFT_BREACHES).inc(
+                    signal=sig, **self._labels
+                )
             record_event(
                 "drift_breach",
                 signals=",".join(signals),
                 px_divergence=div,
                 mean_shift_max=mean_max,
+                **self._labels,
             )
         self.last_report = report
         return report

@@ -50,7 +50,15 @@ MGPROTO_CHAOS_SERVE_SWAP_BAD_ARTIFACT (poison the first N hot-swap
 attempts with a trust-stripped artifact; the swap must fail closed), and
 for online learning (ISSUE 11) MGPROTO_CHAOS_ONLINE_POISON_RATE (fraction
 of requests replaced with low-p(x) mislabeled junk the trusted-capture
-gate must reject).
+gate must reject), and for multi-tenant serving (ISSUE 17)
+MGPROTO_CHAOS_TENANT_STORM_AT (from this request index the drill floods
+ONE tenant over its quota — fair-share admission must shed only that
+tenant's own tail), MGPROTO_CHAOS_TENANT_BAD_SWAP (poison the first N
+tenant-scoped head swaps with a trust-stripped head; that tenant's swap
+must fail closed while every other tenant keeps serving), and
+MGPROTO_CHAOS_TENANT_POISON_RATE (fraction of the storm tenant's requests
+replaced with OoD junk — its drift monitor must breach while quiet
+tenants' monitors stay flat).
 
 Multi-host pod faults (ISSUE 9): MGPROTO_CHAOS_KILL_HOST_AT /
 MGPROTO_CHAOS_WEDGE_HOST_AT make one PROCESS die hard (os._exit) or hang
@@ -136,6 +144,18 @@ class ChaosPlan:
     # poisoned traffic never reaches the memory banks; the drift drill
     # counts injections and asserts zero were captured.
     online_poison_rate: float = 0.0
+    # multi-tenant serving (ISSUE 17): from this request index on, the
+    # load drill floods ONE tenant (the storm tenant) over its fair-share
+    # quota; admission must shed only that tenant's own tail
+    # (tenant_quota), never another tenant's queued work
+    tenant_storm_at: Optional[int] = None
+    # the first N tenant-scoped head swaps stage a trust-stripped head;
+    # that ONE tenant's swap must fail closed (its gate degrades the
+    # staged head) while every other tenant keeps serving untouched
+    tenant_bad_swap: int = 0
+    # fraction of the storm tenant's requests replaced with OoD junk the
+    # per-tenant drift monitor must attribute to that tenant alone
+    tenant_poison_rate: float = 0.0
     # multi-host pod faults (ISSUE 9): when the batch for this global step
     # is drawn, the targeted process DIES hard (os._exit — a host crash) or
     # WEDGES (hangs mid-loop — a stuck host). Survivors must reach failure
@@ -166,6 +186,9 @@ class ChaosPlan:
             or self.serve_wedge_at is not None
             or self.serve_swap_bad_artifact > 0
             or self.online_poison_rate > 0.0
+            or self.tenant_storm_at is not None
+            or self.tenant_bad_swap > 0
+            or self.tenant_poison_rate > 0.0
             or self.kill_host_at is not None
             or self.wedge_host_at is not None
             or self.slow_host_ms > 0.0
@@ -192,6 +215,8 @@ class ChaosState:
         self._replica_kill_fired = False
         self._wedge_fired = False
         self._bad_swaps_left = int(plan.serve_swap_bad_artifact)
+        self._tenant_storm_counted = False
+        self._tenant_bad_swaps_left = int(plan.tenant_bad_swap)
         self._host_kill_fired = False
         self._host_wedge_fired = False
         self._host_slow_counted = False
@@ -354,6 +379,48 @@ class ChaosState:
             self._count("online_poison")
         return hit
 
+    # ---------------------------------------------------------- tenant plane
+    def tenant_storm_due(self, request_index: int) -> bool:
+        """True for every request from `tenant_storm_at` on: the drill
+        redirects that traffic at the storm tenant, flooding it over its
+        fair-share quota (the drill's phase structure bounds the window;
+        the injection counter fires once)."""
+        p = self.plan
+        if p.tenant_storm_at is None:
+            return False
+        due = int(request_index) >= int(p.tenant_storm_at)
+        if due:
+            with self._lock:
+                counted = self._tenant_storm_counted
+                self._tenant_storm_counted = True
+            if not counted:
+                self._count("tenant_storm")
+        return due
+
+    def tenant_bad_swap_due(self) -> bool:
+        """True for the first `tenant_bad_swap` tenant-scoped head swaps:
+        the staged head loses its trust data and that ONE tenant's swap
+        must fail closed while every other tenant keeps serving."""
+        with self._lock:
+            if self._tenant_bad_swaps_left <= 0:
+                return False
+            self._tenant_bad_swaps_left -= 1
+        self._count("tenant_bad_swap")
+        return True
+
+    def tenant_poison_due(self, request_index: int) -> bool:
+        """Deterministic per request index: the storm tenant's request
+        becomes OoD junk whose drift signature must land on THAT tenant's
+        monitor only (the drill drives the substitution)."""
+        p = self.plan
+        if p.tenant_poison_rate <= 0.0:
+            return False
+        rng = np.random.default_rng([p.seed, 0x7EA7, int(request_index)])
+        hit = bool(rng.random() < p.tenant_poison_rate)
+        if hit:
+            self._count("tenant_poison")
+        return hit
+
     def serve_device_error_due(self, dispatch_index: int) -> bool:
         """True exactly once per listed dispatch index (a breaker-paced
         retry of later work must be able to heal)."""
@@ -496,6 +563,11 @@ def plan_from_env(environ=None) -> Optional[ChaosPlan]:
         ),
         online_poison_rate=_get(
             "MGPROTO_CHAOS_ONLINE_POISON_RATE", float, 0.0
+        ),
+        tenant_storm_at=_get("MGPROTO_CHAOS_TENANT_STORM_AT", int, None),
+        tenant_bad_swap=_get("MGPROTO_CHAOS_TENANT_BAD_SWAP", int, 0),
+        tenant_poison_rate=_get(
+            "MGPROTO_CHAOS_TENANT_POISON_RATE", float, 0.0
         ),
         kill_host_at=_get("MGPROTO_CHAOS_KILL_HOST_AT", int, None),
         wedge_host_at=_get("MGPROTO_CHAOS_WEDGE_HOST_AT", int, None),

@@ -20,6 +20,19 @@ backend is failing. All three live here, host-side and jax-free.
     a half-open probe admits one batch — success closes the breaker and
     resets the schedule, failure re-opens it at the next longer delay.
 
+Multi-tenant admission (ISSUE 17): a request may carry a `tenant` id, and
+`submit` may carry that tenant's `quota` (its fair share of the queue,
+computed by the TenantDirectory). A tenant at quota sheds ITS OWN tail —
+deadline-aware within its share: its already-expired queued entries go
+first, and only then the newcomer, typed `tenant_quota`. Another tenant's
+entries are never touched, so one tenant's storm cannot evict anyone
+else's queued work. `pop_batch` becomes fair-share only when the queue
+actually holds more than one tenant lane: batch slots round-robin across
+lanes (FIFO within each lane), so a storm tenant cannot monopolize batch
+composition either. With zero or one lane the pop path is byte-for-byte
+the original FIFO — the disabled tenant plane costs one set-membership
+check.
+
 Clocks are injectable (`clock=`) so chaos tests drive deadline storms and
 breaker recovery deterministically, without sleeping.
 """
@@ -30,13 +43,14 @@ import dataclasses
 import itertools
 import time
 from collections import deque
-from typing import Any, Callable, Deque, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from mgproto_tpu.resilience.retry import backoff_delays
 from mgproto_tpu.serving import metrics as _m
 
 SHED_QUEUE_FULL = "queue_full"
 SHED_DEADLINE = "deadline"
+SHED_TENANT_QUOTA = "tenant_quota"
 
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
@@ -54,6 +68,10 @@ class ServeRequest:
     request_id: str
     deadline: Optional[float] = None
     enqueued_at: float = 0.0
+    # multi-tenant serving (ISSUE 17): the tenant lane this request belongs
+    # to. None (the default, and the whole single-tenant path) means "no
+    # lane" — admission, popping and accounting behave exactly as before.
+    tenant: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -82,17 +100,34 @@ class AdmissionQueue:
 
     def _shed(self, req: ServeRequest, reason: str) -> None:
         _m.counter(_m.SHED).inc(reason=reason)
+        if req.tenant is not None:
+            _m.counter(_m.TENANT_SHED).inc(tenant=req.tenant, reason=reason)
         self.shed.append(req)
+
+    def tenant_depths(self) -> Dict[str, int]:
+        """Queued entries per tenant lane (requests with no tenant are not
+        listed) — the batcher's per-tenant depth gauge reads this."""
+        out: Dict[str, int] = {}
+        for req in self._q:
+            if req.tenant is not None:
+                out[req.tenant] = out.get(req.tenant, 0) + 1
+        return out
 
     def submit(
         self,
         payload: Any,
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        quota: Optional[int] = None,
     ) -> Tuple[Optional[ServeRequest], Optional[str]]:
         """Admit a request; returns (request, None) on admission or
         (request, shed_reason) when it was shed instead. The shed request is
-        ALSO recorded in `self.shed` so the engine answers it typed."""
+        ALSO recorded in `self.shed` so the engine answers it typed.
+
+        `quota` (with `tenant`) is the tenant's fair share of this queue:
+        at quota the tenant sheds its own tail — its expired queued entries
+        first, then the newcomer (`tenant_quota`) — never anyone else's."""
         now = self.clock()
         rel = deadline_s if deadline_s is not None else self.default_deadline_s
         req = ServeRequest(
@@ -100,10 +135,29 @@ class AdmissionQueue:
             request_id=request_id or f"r{next(self._ids)}",
             deadline=None if rel is None else now + rel,
             enqueued_at=now,
+            tenant=tenant,
         )
         if req.expired(now):  # born dead (deadline storm): never queue it
             self._shed(req, SHED_DEADLINE)
             return req, SHED_DEADLINE
+        if tenant is not None and quota is not None:
+            held = sum(1 for r in self._q if r.tenant == tenant)
+            if held >= quota:
+                # deadline-aware within the tenant's OWN share: its
+                # already-expired entries free room first (they cannot be
+                # answered in time anyway); other tenants' entries are
+                # never candidates
+                keep: Deque[ServeRequest] = deque()
+                for queued in self._q:
+                    if queued.tenant == tenant and queued.expired(now):
+                        self._shed(queued, SHED_DEADLINE)
+                        held -= 1
+                    else:
+                        keep.append(queued)
+                self._q = keep
+                if held >= quota:
+                    self._shed(req, SHED_TENANT_QUOTA)
+                    return req, SHED_TENANT_QUOTA
         if len(self._q) >= self.capacity:
             # shed already-expired entries first (oldest first, anywhere in
             # the queue — an expired entry behind a viable head is just as
@@ -150,8 +204,15 @@ class AdmissionQueue:
 
     def pop_batch(self, max_size: int) -> List[ServeRequest]:
         """Up to `max_size` still-viable requests, FIFO; entries whose
-        deadline passed while queued are shed here, not served late."""
+        deadline passed while queued are shed here, not served late.
+
+        When the queue holds more than one tenant lane, batch slots are
+        filled round-robin across lanes (FIFO within each lane) so a storm
+        tenant's backlog cannot monopolize batch composition; with zero or
+        one lane this is exactly the original FIFO pop."""
         now = self.clock()
+        if len({r.tenant for r in self._q}) > 1:
+            return self._pop_batch_fair(max_size, now)
         out: List[ServeRequest] = []
         while self._q and len(out) < max_size:
             req = self._q.popleft()
@@ -159,6 +220,39 @@ class AdmissionQueue:
                 self._shed(req, SHED_DEADLINE)
                 continue
             out.append(req)
+        return out
+
+    def _pop_batch_fair(self, max_size: int, now: float) -> List[ServeRequest]:
+        """Round-robin pop across tenant lanes, lanes ordered by their
+        oldest entry's arrival (so the longest-waiting lane leads each
+        round); expired entries shed at pop exactly like the FIFO path."""
+        lanes: Dict[Any, Deque[ServeRequest]] = {}
+        order: List[Any] = []
+        for req in self._q:
+            if req.tenant not in lanes:
+                lanes[req.tenant] = deque()
+                order.append(req.tenant)
+            lanes[req.tenant].append(req)
+        out: List[ServeRequest] = []
+        removed: List[ServeRequest] = []
+        progressed = True
+        while len(out) < max_size and progressed:
+            progressed = False
+            for t in order:
+                if len(out) >= max_size:
+                    break
+                lane = lanes[t]
+                while lane:
+                    req = lane.popleft()
+                    removed.append(req)
+                    if req.expired(now):
+                        self._shed(req, SHED_DEADLINE)
+                        continue
+                    out.append(req)
+                    progressed = True
+                    break
+        gone = {id(r) for r in removed}
+        self._q = deque(r for r in self._q if id(r) not in gone)
         return out
 
     def drain_shed(self) -> List[ServeRequest]:

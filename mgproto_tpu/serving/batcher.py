@@ -86,6 +86,9 @@ class MicroBatcher:
         self.dispatch_cost_s = float(self.config.cost_prior_s)
         self.dispatches = 0
         self._busy_until = 0.0  # device-occupancy model (device_busy_s)
+        # tenant lanes whose depth gauge we have ever set (to zero drained
+        # lanes, _observe_depth); empty forever on single-tenant engines
+        self._tenant_lanes_seen: set = set()
 
     # ---------------------------------------------------------------- triggers
     def dispatch_due(self) -> Optional[str]:
@@ -172,3 +175,13 @@ class MicroBatcher:
             _m.gauge(_m.QUEUE_DEPTH).set(depth, replica=self.name)
         else:
             _m.gauge(_m.QUEUE_DEPTH).set(depth)
+        if getattr(self.engine, "tenants", None) is not None:
+            # per-tenant lane depths (ISSUE 17): refreshed here — on the
+            # same cadence as the fleet gauge — and zeroed for lanes that
+            # drained, so a quiet tenant reads 0, not its last storm value
+            depths = self.engine.queue.tenant_depths()
+            for t in self._tenant_lanes_seen - set(depths):
+                _m.gauge(_m.TENANT_QUEUE_DEPTH).set(0.0, tenant=t)
+            for t, d in depths.items():
+                _m.gauge(_m.TENANT_QUEUE_DEPTH).set(float(d), tenant=t)
+            self._tenant_lanes_seen |= set(depths)

@@ -64,6 +64,7 @@ from mgproto_tpu.serving.response import (
     ServeResponse,
     record as _record_response,
 )
+from mgproto_tpu.serving.tenants import REASON_TENANT_UNMOUNTED
 from mgproto_tpu.serving.validate import (
     ValidationFailure,
     ValidationSpec,
@@ -145,10 +146,17 @@ class ServingEngine:
         aot_cache: Optional[Any] = None,
         aot_fingerprint: Optional[str] = None,
         explain_table: Optional[Dict[str, Any]] = None,
+        tenants: Optional[Any] = None,
     ):
         """`infer_fn` maps float32 images [b, H, W, 3] to
         {"logits": [b, C], "log_px": [b]} and is jit-wrapped here so the
-        recompile detector can watch its cache."""
+        recompile detector can watch its cache.
+
+        `tenants` (serving/tenants.py TenantDirectory) turns on the
+        multi-tenant plane: requests carrying a tenant id gate through
+        that tenant's head, pay its fair-share quota, and feed its drift/
+        capture state. None (the default) is the single-tenant engine,
+        byte-identical to the pre-tenant build."""
         import jax
 
         if not buckets:
@@ -209,6 +217,10 @@ class ServingEngine:
             # plain program's in the AOT cache (different output contract)
             self.aot_fingerprint += ":explain"
         self.compute_dtype = str(expected_compute_dtype or "")
+        # multi-tenant plane (ISSUE 17): heads live in the directory, the
+        # TRUNK lives here. A head never touches aot_fingerprint, _jit, or
+        # _exec, so mounting a tenant can never cost a trunk compile.
+        self.tenants = tenants
         # per-bucket compiled executables: populated by warmup (cache hit
         # or AOT compile); dispatch uses these, so the jit dispatch cache
         # stays empty in steady state and the recompile detector's zero
@@ -450,12 +462,18 @@ class ServingEngine:
         payload: Any,
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> List[ServeResponse]:
         """Validate + admit one request. Returns the immediate typed
         responses this submission produced: a validation reject, a shed
         response for THIS request, and/or shed responses for queued
         requests evicted past their deadline to make room. Empty list =
-        queued; the response comes from `process_pending`."""
+        queued; the response comes from `process_pending`.
+
+        A `tenant` id routes the request through that tenant's mounted
+        head: an unmounted tenant is REJECTED typed (never silently served
+        through the wrong head), and admission enforces the tenant's
+        fair-share quota."""
         t0 = self.clock()
         seq = self._request_seq
         self._request_seq += 1
@@ -468,6 +486,10 @@ class ServingEngine:
             # born dead: shedding is cheaper than validating, so a deadline
             # storm never spends host CPU on payloads nobody can wait for
             _m.counter(_m.SHED).inc(reason="deadline")
+            if tenant is not None:
+                _m.counter(_m.TENANT_SHED).inc(
+                    tenant=tenant, reason="deadline"
+                )
             return [
                 self._respond(
                     ServeResponse(
@@ -476,9 +498,32 @@ class ServingEngine:
                         reason="deadline",
                         degraded=self.gate.degraded,
                         latency_s=0.0,
+                        tenant=tenant,
                     )
                 )
             ]
+        quota = None
+        if tenant is not None:
+            quota = (
+                self.tenants.quota_for(tenant, self.queue.capacity)
+                if self.tenants is not None else None
+            )
+            if quota is None:
+                # no directory, or the directory has no such head: typed
+                # reject — traffic for an unmounted tenant must never be
+                # gated through another tenant's (or the global) head
+                return [
+                    self._respond(
+                        ServeResponse(
+                            request_id=request_id or f"v{seq}",
+                            outcome=OUTCOME_REJECT,
+                            reason=REASON_TENANT_UNMOUNTED,
+                            degraded=self.gate.degraded,
+                            latency_s=self.clock() - t0,
+                            tenant=tenant,
+                        )
+                    )
+                ]
         try:
             clean = validate_image(payload, self.spec)
         except ValidationFailure as e:
@@ -490,11 +535,13 @@ class ServingEngine:
                         reason=e.reason,
                         degraded=self.gate.degraded,
                         latency_s=self.clock() - t0,
+                        tenant=tenant,
                     )
                 )
             ]
         req, shed_reason = self.queue.submit(
-            clean, request_id=request_id, deadline_s=deadline_s
+            clean, request_id=request_id, deadline_s=deadline_s,
+            tenant=tenant, quota=quota,
         )
         if shed_reason is None and _reqtrace.enabled():
             # request tracing (obs/reqtrace.py): stamp admission. Mints
@@ -514,6 +561,7 @@ class ServingEngine:
             reason=reason,
             degraded=self.gate.degraded,
             latency_s=self.clock() - req.enqueued_at,
+            tenant=req.tenant,
         )
 
     # ------------------------------------------------------------- processing
@@ -592,6 +640,10 @@ class ServingEngine:
         responses = []
         for req in self.queue.drain_all():
             _m.counter(_m.SHED).inc(reason=reason)
+            if req.tenant is not None:
+                _m.counter(_m.TENANT_SHED).inc(
+                    tenant=req.tenant, reason=reason
+                )
             responses.append(self._respond(self._shed_response(req, reason)))
         for req in self.queue.drain_shed():
             responses.append(
@@ -705,14 +757,39 @@ class ServingEngine:
         log_px: np.ndarray, extras: Optional[Tuple] = None,
     ) -> List[ServeResponse]:
         preds = np.argmax(logits, axis=-1)
-        try:
-            labels = self.gate.decide(log_px)
-            degraded = self.gate.degraded
-        except Exception:
-            # the gate itself erring must not take serving down: degrade
-            # THIS batch to ungated classification, flagged per response
-            labels = [TRUST_UNGATED] * len(batch)
-            degraded = True
+        # per-request gate selection (ISSUE 17): a request carrying a
+        # tenant id gates through that tenant's mounted head; everything
+        # else — and every engine without a directory — uses the engine
+        # gate exactly as before (the batch-level decide below is the
+        # single-tenant fast path, untouched when tenants is None)
+        gates = [self.gate] * len(batch)
+        if self.tenants is not None:
+            for i, req in enumerate(batch):
+                if req.tenant is not None:
+                    g = self.tenants.gate_for(req.tenant)
+                    if g is not None:
+                        gates[i] = g
+        per_row = any(g is not self.gate for g in gates)
+        if per_row:
+            labels = []
+            degraded_rows = []
+            for g, score in zip(gates, log_px):
+                try:
+                    labels.append(g.decide([float(score)])[0])
+                    degraded_rows.append(g.degraded)
+                except Exception:
+                    labels.append(TRUST_UNGATED)
+                    degraded_rows.append(True)
+        else:
+            try:
+                labels = self.gate.decide(log_px)
+                degraded_rows = [self.gate.degraded] * len(batch)
+            except Exception:
+                # the gate itself erring must not take serving down:
+                # degrade THIS batch to ungated classification, flagged
+                # per response
+                labels = [TRUST_UNGATED] * len(batch)
+                degraded_rows = [True] * len(batch)
         # continual-learning tap (online/capture.py): disabled is ONE
         # module-global None-check per batch — the reqtrace discipline
         tap = _capture.get_active()
@@ -731,17 +808,19 @@ class ServingEngine:
                     extras[0][i], extras[1][i]
                 )
                 _m.counter(_m.EXPLANATIONS).inc()
+            gate = gates[i]
             resp = ServeResponse(
                 request_id=req.request_id,
                 outcome=outcome,
                 prediction=int(pred),
                 log_px=float(score),
                 trust=label,
-                trust_score=self.gate.trust_score(float(score)),
-                confidence=self.gate.confidence(row),
-                degraded=degraded or label == TRUST_UNGATED,
+                trust_score=gate.trust_score(float(score)),
+                confidence=gate.confidence(row),
+                degraded=degraded_rows[i] or label == TRUST_UNGATED,
                 latency_s=self.clock() - req.enqueued_at,
                 explain=explain_rows,
+                tenant=req.tenant,
             )
             resp = self._respond(resp)
             if tap is not None:
@@ -749,6 +828,10 @@ class ServingEngine:
                 # background consolidation. O(1) reservoir append; never
                 # raises (capture's own contract).
                 tap.on_response(req.payload, resp)
+            if self.tenants is not None:
+                # the tenant tap (drift window + per-tenant capture) —
+                # one None-check when the plane is off
+                self.tenants.on_response(req.payload, resp)
             out.append(resp)
         return out
 

@@ -20,7 +20,8 @@ things and nothing else:
 
 Endpoints:
 
-  POST /v1/predict   {"id"?, "image": nested lists, "deadline_ms"?}
+  POST /v1/predict   {"id"?, "image": nested lists, "deadline_ms"?,
+                     "tenant"?}
                      -> one ServeResponse JSON. Status: 200 predict/abstain,
                      400 reject (503 when the cause is circuit_open/
                      device_error — retryable), 429 shed (503 on shutdown).
@@ -164,10 +165,11 @@ class Frontend:
             def step():
                 out: List[ServeResponse] = []
                 admin_results = [fn() for fn, _fut in admin]
-                for payload, rid, deadline_s in work:
+                for payload, rid, deadline_s, tenant in work:
                     out.extend(
                         self.replicas.submit(
-                            payload, request_id=rid, deadline_s=deadline_s
+                            payload, request_id=rid, deadline_s=deadline_s,
+                            tenant=tenant,
                         )
                     )
                 out.extend(self.replicas.poll())
@@ -203,8 +205,8 @@ class Frontend:
 
         def final():
             out: List[ServeResponse] = [
-                shed_response(rid, REASON_SHUTDOWN)
-                for _payload, rid, _deadline in work
+                shed_response(rid, REASON_SHUTDOWN, tenant=tenant)
+                for _payload, rid, _deadline, tenant in work
             ]
             out.extend(self.replicas.drain(REASON_SHUTDOWN))
             return out
@@ -336,6 +338,10 @@ class Frontend:
         try:
             rec = json.loads(raw)
             payload = rec["image"]
+            # multi-tenant serving (ISSUE 17): the tenant id on the wire.
+            # Absent = the single-tenant path, byte-identical responses.
+            tenant = rec.get("tenant")
+            tenant = str(tenant) if tenant is not None else None
             deadline_ms = rec.get("deadline_ms")
             # parsed inside the guard: a non-numeric deadline_ms is a
             # malformed request (typed 400), not an unhandled handler crash
@@ -366,7 +372,7 @@ class Frontend:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self._pending[rid] = fut
-        self._inbox.append((payload, rid, deadline_s))
+        self._inbox.append((payload, rid, deadline_s, tenant))
         self._kick.set()
         try:
             resp = await asyncio.wait_for(

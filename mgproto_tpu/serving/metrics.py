@@ -54,6 +54,22 @@ AUTOSCALE_TARGET = "autoscale_replicas_target"
 AUTOSCALE_EVENTS = "autoscale_events_total"
 # --- trust plane (ISSUE 15): explanations as a served product ---
 EXPLANATIONS = "serving_explanations_total"
+# --- multi-tenant serving (ISSUE 17): one fleet, many heads ---
+# Per-tenant series are LABELED (tenant=<id>); the unlabeled zero is the
+# pre-registration the registry lint demands. The per-tenant latency
+# histogram is a SEPARATE family from REQUEST_SECONDS on purpose:
+# summarize merges every label series of one histogram name, so tenant-
+# labeled observations folded into the global family would double-count.
+TENANT_REQUESTS = "tenant_requests_total"
+TENANT_REQUEST_SECONDS = "tenant_request_seconds"
+TENANT_SHED = "tenant_shed_total"
+TENANT_MOUNTS = "tenant_mount_total"
+TENANT_UNMOUNTS = "tenant_unmount_total"
+TENANT_SWAPS = "tenant_swap_total"
+TENANTS_MOUNTED = "tenants_mounted"
+TENANT_QUEUE_DEPTH = "tenant_queue_depth"
+TENANT_HEAD_BYTES = "tenant_head_bytes"
+TENANT_MOUNT_SECONDS = "tenant_mount_seconds"
 
 COUNTER_HELP = {
     REQUESTS: "requests by outcome (predict/abstain/reject/shed)",
@@ -95,6 +111,20 @@ COUNTER_HELP = {
     EXPLANATIONS:
         "predict outcomes answered WITH a prototype explanation block "
         "(ServingEngine explain=True; abstain/reject/shed never explain)",
+    TENANT_REQUESTS:
+        "requests by tenant and outcome (labeled tenant=, outcome=; the "
+        "per-tenant view of serving_requests_total)",
+    TENANT_SHED:
+        "requests shed by tenant and reason (labeled tenant=, reason=; "
+        "tenant_quota = the tenant's own tail under fair-share admission)",
+    TENANT_MOUNTS:
+        "tenant heads mounted into the directory (labeled tenant=)",
+    TENANT_UNMOUNTS:
+        "tenant heads unmounted from the directory (labeled tenant=)",
+    TENANT_SWAPS:
+        "tenant-scoped head swap attempts by result (labeled tenant=, "
+        "result=committed/rejected; a rejection is that tenant's TrustGate "
+        "failing closed — other tenants keep serving)",
 }
 
 GAUGE_HELP = {
@@ -110,6 +140,14 @@ GAUGE_HELP = {
     AUTOSCALE_TARGET:
         "replica count the autoscaler is currently steering toward "
         "(within its [min, max] bounds)",
+    TENANTS_MOUNTED: "tenant heads currently mounted in the directory",
+    TENANT_QUEUE_DEPTH:
+        "admission-queue entries currently held per tenant (labeled "
+        "tenant=; refreshed by the micro-batcher's depth observation)",
+    TENANT_HEAD_BYTES:
+        "resident bytes of a tenant's mounted head — calibration sketch, "
+        "per-class temperatures, gate state (labeled tenant=; the "
+        "marginal-cost-per-tenant numerator against the shared trunk)",
 }
 
 # batch fill is a fraction in (0, 1]; the default time buckets would dump
@@ -124,6 +162,13 @@ HIST_HELP = {
         "per-request stage latency by stage (queue=admission wait + "
         "batcher linger, device=dispatch time, total=arrival to response); "
         "populated only while request tracing (obs/reqtrace.py) is enabled",
+    TENANT_REQUEST_SECONDS:
+        "per-request latency by tenant (labeled tenant=, outcome=; "
+        "observed only for requests that carry a tenant id)",
+    TENANT_MOUNT_SECONDS:
+        "wall seconds to mount one tenant head (directory-clock measured; "
+        "the marginal-cost-per-tenant denominator — zero trunk compiles "
+        "by construction, so this is head-bytes work only)",
 }
 
 HIST_BUCKETS = {

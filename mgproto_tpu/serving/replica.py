@@ -304,11 +304,13 @@ class ReplicaSet:
         payload,
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> List[ServeResponse]:
         """Route one request to a ready replica. Same contract as
-        `ServingEngine.submit`: the returned list holds any IMMEDIATE typed
-        responses (reject/shed, for this or evicted requests); empty means
-        queued, `poll()` will answer it."""
+        `ServingEngine.submit` (including the tenant id passthrough): the
+        returned list holds any IMMEDIATE typed responses (reject/shed,
+        for this or evicted requests); empty means queued, `poll()` will
+        answer it."""
         seq = self._admit_seq
         self._admit_seq += 1
         rid = request_id or f"g{seq}"
@@ -337,9 +339,9 @@ class ReplicaSet:
                 _reqtrace.plane_event("replica_wedge", replica=target.name)
                 target = self._pick()
         if target is None:
-            return [shed_response(rid, REASON_NO_REPLICA)]
+            return [shed_response(rid, REASON_NO_REPLICA, tenant=tenant)]
         return target.engine.submit(
-            payload, request_id=rid, deadline_s=deadline_s
+            payload, request_id=rid, deadline_s=deadline_s, tenant=tenant
         )
 
     # ------------------------------------------------------------------- pumping

@@ -60,10 +60,15 @@ class ServeResponse:
     # absent from to_dict() — everywhere else (the timings discipline);
     # never populated on abstain/reject/shed.
     explain: Optional[Any] = None
+    # multi-tenant serving (ISSUE 17): the tenant lane this response
+    # belongs to. None — and absent from to_dict() — on the whole
+    # single-tenant path (the timings discipline), so the wire format and
+    # the metrics account are byte-identical when the tenant plane is off.
+    tenant: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
-        for opt in ("timings", "explain"):
+        for opt in ("timings", "explain", "tenant"):
             if d.get(opt) is None:
                 d.pop(opt, None)
         return d
@@ -79,6 +84,16 @@ def record(resp: ServeResponse) -> ServeResponse:
     _m.histogram(_m.REQUEST_SECONDS).observe(
         max(resp.latency_s, 0.0), outcome=resp.outcome
     )
+    if resp.tenant is not None:
+        # the per-tenant view rides a SEPARATE histogram family (see
+        # serving/metrics.py): summarize merges label series per name, so
+        # tenant labels inside REQUEST_SECONDS would double-count
+        _m.counter(_m.TENANT_REQUESTS).inc(
+            tenant=resp.tenant, outcome=resp.outcome
+        )
+        _m.histogram(_m.TENANT_REQUEST_SECONDS).observe(
+            max(resp.latency_s, 0.0), tenant=resp.tenant, outcome=resp.outcome
+        )
     if resp.degraded and resp.outcome == OUTCOME_PREDICT:
         _m.counter(_m.DEGRADED_REQUESTS).inc()
     if _reqtrace.enabled():
@@ -93,10 +108,13 @@ def shed_response(
     reason: str,
     latency_s: float = 0.0,
     degraded: bool = False,
+    tenant: Optional[str] = None,
 ) -> ServeResponse:
     """A recorded typed shed — the plane's answer when no engine can serve
     (dead replica with no survivors, graceful shutdown, lost reroute)."""
     _m.counter(_m.SHED).inc(reason=reason)
+    if tenant is not None:
+        _m.counter(_m.TENANT_SHED).inc(tenant=tenant, reason=reason)
     return record(
         ServeResponse(
             request_id=request_id,
@@ -104,5 +122,6 @@ def shed_response(
             reason=reason,
             degraded=degraded,
             latency_s=latency_s,
+            tenant=tenant,
         )
     )

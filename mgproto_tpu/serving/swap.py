@@ -65,18 +65,27 @@ class SwapReport:
         return dataclasses.asdict(self)
 
 
-def verify_standby(engine, require_calibrated: bool = True) -> Optional[str]:
-    """The promotion gate: None when the standby may take traffic, else the
-    REJECT_* reason. Fingerprint mismatch outranks uncalibrated: the gate
-    degrades itself on mismatch, and reporting that as 'uncalibrated' would
-    hide the actual operator error (stale calibration, not missing one)."""
-    if not getattr(engine, "warmed_up", False):
-        return REJECT_NOT_WARMED
-    if engine.gate.fingerprint_mismatch:
+def verify_head(gate, require_calibrated: bool = True) -> Optional[str]:
+    """The trust half of the promotion gate: the verdicts that depend only
+    on a TrustGate, shared by the fleet-level standby verification below
+    and the per-tenant head swap (serving/tenants.py — a tenant's staged
+    head passes or fails the SAME contract as a whole green fleet).
+    Fingerprint mismatch outranks uncalibrated: the gate degrades itself on
+    mismatch, and reporting that as 'uncalibrated' would hide the actual
+    operator error (stale calibration, not missing one)."""
+    if gate.fingerprint_mismatch:
         return REJECT_FINGERPRINT
-    if engine.gate.degraded and require_calibrated:
+    if gate.degraded and require_calibrated:
         return REJECT_UNCALIBRATED
     return None
+
+
+def verify_standby(engine, require_calibrated: bool = True) -> Optional[str]:
+    """The promotion gate: None when the standby may take traffic, else
+    the REJECT_* reason — an engine must be warmed AND trust-verified."""
+    if not getattr(engine, "warmed_up", False):
+        return REJECT_NOT_WARMED
+    return verify_head(engine.gate, require_calibrated=require_calibrated)
 
 
 def stage_standby(

@@ -1,0 +1,405 @@
+"""Multi-tenant serving: one shared trunk, many MGProto heads (ISSUE 17).
+
+MGProto factors cleanly into a heavy TRUNK (backbone + prototype program —
+the thing XLA compiles and the AOT cache serializes) and a light HEAD per
+tenant: the calibration (quantile sketch, thresholds, per-class
+temperatures), its TrustGate, and the tenant's online state (drift monitor,
+trusted-capture reservoir). The directory here mounts and unmounts heads at
+runtime against ONE engine fleet:
+
+  * ZERO TRUNK COMPILES PER TENANT, BY CONSTRUCTION. The engine's AOT key
+    is (trunk fingerprint, bucket shape, dtype) — see
+    `ServingEngine._aot_key`. A head never touches `aot_fingerprint`, the
+    jit handle, or the per-bucket executables, so mounting tenant N+1 costs
+    head bytes + gate construction and nothing else. The load drill proves
+    it the hard way: a mid-storm mount with the recompile detector watching
+    must report a compile delta of exactly zero.
+  * FAIR-SHARE ADMISSION. `quota_for` turns a tenant's weight into its
+    share of the admission queue; the queue enforces it by shedding the
+    tenant's OWN tail (typed `tenant_quota`, serving/admission.py) —
+    deadline-aware within that share — so one tenant's storm cannot evict
+    another tenant's queued work, and `pop_batch` round-robins batch slots
+    across lanes so the storm cannot monopolize batch composition either.
+  * TENANT-SCOPED BLUE/GREEN. `swap` stages a replacement head and verifies
+    it through the same fail-closed contract as the fleet swap
+    (serving/swap.py::verify_head): an uncalibrated or stale-fingerprint
+    head is REJECTED for that one tenant while its old head — and every
+    other tenant — keeps serving. The chaos knob
+    MGPROTO_CHAOS_TENANT_BAD_SWAP drills exactly that.
+  * PER-TENANT DRIFT + CAPTURE. Each head may carry its own DriftMonitor
+    and TrustedCapture (tenant-labeled metrics): one tenant's traffic
+    drifting breaches that tenant's monitor only — attribution, not a
+    fleet-wide alarm.
+
+The whole plane is opt-in: an engine built without a directory has
+`tenants is None` and pays a single None-check (the reqtrace discipline);
+responses, metrics, and the wire format are byte-identical to the
+single-tenant build.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from mgproto_tpu.obs.flightrec import record_event
+from mgproto_tpu.resilience import chaos as _chaos
+from mgproto_tpu.serving import metrics as _m
+from mgproto_tpu.serving.calibration import Calibration
+from mgproto_tpu.serving.gate import TrustGate
+
+# typed reject for traffic addressed at a tenant the directory does not
+# hold (never silently served through the wrong head)
+REASON_TENANT_UNMOUNTED = "tenant_unmounted"
+
+SWAP_COMMITTED = "committed"
+SWAP_REJECTED = "rejected"
+REJECT_NOT_MOUNTED = "not_mounted"
+
+
+def head_fingerprint(calibration: Optional[Calibration]) -> str:
+    """Identity of a head: sha256 over the calibration payload. Two tenants
+    serving the same trunk but different thresholds/temperatures have
+    different heads; "" = no calibration (a degraded head)."""
+    if calibration is None:
+        return ""
+    return hashlib.sha256(calibration.to_json().encode()).hexdigest()
+
+
+def head_nbytes(calibration: Optional[Calibration]) -> int:
+    """Resident bytes of a mounted head's trust data (float64 quantile
+    sketch + per-class temperatures + percentile thresholds + operating
+    point) — the marginal-cost-per-tenant numerator against the shared
+    trunk. Deterministic (a function of the payload, not the allocator)."""
+    if calibration is None:
+        return 0
+    return 8 * (
+        len(calibration.quantile_log_px)
+        + len(calibration.per_class_temperature)
+        + len(calibration.thresholds)
+        + 1  # threshold_log_px
+    )
+
+
+@dataclasses.dataclass
+class TenantHead:
+    """One tenant's mounted state: everything tenant-specific, nothing the
+    trunk compiled. Mutable on purpose — `swap` replaces the trust data in
+    place under the directory lock."""
+
+    tenant: str
+    calibration: Optional[Calibration]
+    gate: TrustGate
+    head_fingerprint: str
+    head_bytes: int
+    quota_weight: float
+    mounted_at: float
+    drift: Optional[Any] = None  # online.drift.DriftMonitor
+    capture: Optional[Any] = None  # online.capture.TrustedCapture
+    class_slots: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class MountReport:
+    """What one mount cost — head bytes and seconds against a shared trunk
+    (the trunk-compile count is the ENGINE's story: the drill reads the
+    recompile monitor around the mount and asserts the delta is zero)."""
+
+    tenant: str
+    head_fingerprint: str
+    head_bytes: int
+    mount_seconds: float
+    class_slots: Tuple[int, ...] = ()
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["class_slots"] = list(self.class_slots)
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSwapReport:
+    """One tenant-scoped head swap attempt — always returned, never raised
+    (a refused promotion is an outcome, the fleet-swap discipline)."""
+
+    ok: bool
+    tenant: str
+    reason: str  # SWAP_COMMITTED or a swap.REJECT_* / REJECT_NOT_MOUNTED
+    head_fingerprint: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class TenantDirectory:
+    """The mounted heads, and every tenant-scoped operation over them.
+
+    Thread-safe: mounts/swaps come from the operator path while the
+    engine's dispatch loop reads gates and taps responses. Reads are
+    dict lookups under the lock — never device work, never blocking."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        class_directory: Optional[Any] = None,
+    ):
+        self.clock = clock
+        # optional PR-11 class-bucket machinery (online/classes.py): a
+        # tenant mounting with class_names claims padded slots, so its
+        # classes ride the SAME compiled width — zero trunk recompiles
+        self.class_directory = class_directory
+        self._lock = threading.Lock()
+        self._heads: Dict[str, TenantHead] = {}
+
+    # ------------------------------------------------------------- inventory
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._heads)
+
+    def head_for(self, tenant: str) -> Optional[TenantHead]:
+        with self._lock:
+            return self._heads.get(tenant)
+
+    def gate_for(self, tenant: str) -> Optional[TrustGate]:
+        head = self.head_for(tenant)
+        return None if head is None else head.gate
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heads)
+
+    # --------------------------------------------------------------- mounting
+    def mount(
+        self,
+        tenant: str,
+        calibration: Optional[Calibration],
+        quota_weight: float = 1.0,
+        class_names: Sequence[str] = (),
+        expected_fingerprint: Optional[str] = None,
+        expected_compute_dtype: Optional[str] = None,
+        percentile: Optional[float] = None,
+        drift_config: Optional[Any] = None,
+        capture_config: Optional[Any] = None,
+        num_classes: Optional[int] = None,
+    ) -> MountReport:
+        """Mount one tenant head. Cost: head bytes + gate construction —
+        the trunk is shared and NOT recompiled (see module docstring).
+
+        `class_names` claims class-bucket slots through the PR-11
+        directory (mount-once: slots stay claimed after unmount, because
+        the compiled width they ride is a property of the trunk, not of
+        the tenant). `drift_config`/`capture_config` attach per-tenant
+        online state; capture needs `num_classes` for its reservoirs."""
+        t0 = self.clock()
+        if quota_weight <= 0.0:
+            raise ValueError(
+                f"tenant {tenant!r}: quota_weight must be > 0, "
+                f"got {quota_weight}"
+            )
+        gate = TrustGate(
+            calibration,
+            expected_fingerprint=expected_fingerprint,
+            percentile=percentile,
+            expected_compute_dtype=expected_compute_dtype,
+        )
+        slots: List[int] = []
+        if class_names:
+            if self.class_directory is None:
+                raise ValueError(
+                    f"tenant {tenant!r} asks for class slots "
+                    f"{list(class_names)} but the directory has no "
+                    "class-bucket machinery attached"
+                )
+            for name in class_names:
+                existing = self.class_directory.slot_of(str(name))
+                slots.append(
+                    existing if existing is not None
+                    else self.class_directory.add_class(str(name))
+                )
+        drift = None
+        if drift_config is not None:
+            from mgproto_tpu.online.drift import DriftMonitor
+
+            drift = DriftMonitor(
+                calibration, config=drift_config, clock=self.clock,
+                tenant=tenant,
+            )
+        capture = None
+        if capture_config is not None:
+            if num_classes is None:
+                raise ValueError(
+                    f"tenant {tenant!r}: capture_config needs num_classes"
+                )
+            from mgproto_tpu.online.capture import TrustedCapture
+
+            capture = TrustedCapture(
+                calibration, num_classes=int(num_classes),
+                config=capture_config, tenant=tenant,
+            )
+        head = TenantHead(
+            tenant=str(tenant),
+            calibration=calibration,
+            gate=gate,
+            head_fingerprint=head_fingerprint(calibration),
+            head_bytes=head_nbytes(calibration),
+            quota_weight=float(quota_weight),
+            mounted_at=t0,
+            drift=drift,
+            capture=capture,
+            class_slots=tuple(slots),
+        )
+        with self._lock:
+            if tenant in self._heads:
+                raise ValueError(
+                    f"tenant {tenant!r} is already mounted; use swap() to "
+                    "replace its head"
+                )
+            self._heads[str(tenant)] = head
+            count = len(self._heads)
+        seconds = max(self.clock() - t0, 0.0)
+        _m.counter(_m.TENANT_MOUNTS).inc(tenant=head.tenant)
+        _m.gauge(_m.TENANTS_MOUNTED).set(float(count))
+        _m.gauge(_m.TENANT_HEAD_BYTES).set(
+            float(head.head_bytes), tenant=head.tenant
+        )
+        _m.histogram(_m.TENANT_MOUNT_SECONDS).observe(
+            seconds, tenant=head.tenant
+        )
+        record_event(
+            "tenant_mount", tenant=head.tenant,
+            head_bytes=head.head_bytes, seconds=seconds,
+        )
+        return MountReport(
+            tenant=head.tenant,
+            head_fingerprint=head.head_fingerprint,
+            head_bytes=head.head_bytes,
+            mount_seconds=seconds,
+            class_slots=head.class_slots,
+        )
+
+    def unmount(self, tenant: str) -> bool:
+        """Drop a tenant's head (its claimed class slots stay claimed —
+        the compiled width is trunk state, see `mount`). False when the
+        tenant was not mounted."""
+        with self._lock:
+            head = self._heads.pop(tenant, None)
+            count = len(self._heads)
+        if head is None:
+            return False
+        _m.counter(_m.TENANT_UNMOUNTS).inc(tenant=str(tenant))
+        _m.gauge(_m.TENANTS_MOUNTED).set(float(count))
+        _m.gauge(_m.TENANT_HEAD_BYTES).set(0.0, tenant=str(tenant))
+        record_event("tenant_unmount", tenant=str(tenant))
+        return True
+
+    # ------------------------------------------------------------- fair share
+    def quota_for(self, tenant: str, capacity: int) -> Optional[int]:
+        """The tenant's fair share of an admission queue: capacity split
+        proportional to quota weights over the MOUNTED tenants, floor 1
+        (every mounted tenant can always queue something). None for an
+        unmounted tenant — the engine rejects those typed before quota
+        ever applies."""
+        with self._lock:
+            head = self._heads.get(tenant)
+            if head is None:
+                return None
+            total = sum(h.quota_weight for h in self._heads.values())
+        share = head.quota_weight / total if total > 0 else 1.0
+        return max(1, int(int(capacity) * share))
+
+    # ----------------------------------------------------------- head swap
+    def swap(
+        self,
+        tenant: str,
+        calibration: Optional[Calibration],
+        expected_fingerprint: Optional[str] = None,
+        expected_compute_dtype: Optional[str] = None,
+        percentile: Optional[float] = None,
+    ) -> TenantSwapReport:
+        """Tenant-scoped blue/green: stage a replacement head, verify it
+        through the fleet swap's fail-closed contract (swap.verify_head),
+        and only then replace the mounted head atomically. A rejection —
+        uncalibrated, stale fingerprint, chaos-stripped — leaves the OLD
+        head serving; no other tenant is touched either way."""
+        from mgproto_tpu.serving.swap import verify_head
+
+        if self.head_for(tenant) is None:
+            _m.counter(_m.TENANT_SWAPS).inc(
+                tenant=str(tenant), result=SWAP_REJECTED
+            )
+            record_event(
+                "tenant_swap_rejected", tenant=str(tenant),
+                reason=REJECT_NOT_MOUNTED,
+            )
+            return TenantSwapReport(
+                ok=False, tenant=str(tenant), reason=REJECT_NOT_MOUNTED
+            )
+        chaos = _chaos.get_active()
+        if chaos is not None and chaos.tenant_bad_swap_due():
+            # drill: the operator pushed a head with no trust data; the
+            # verification below must refuse it exactly like the real thing
+            calibration = None
+        staged = TrustGate(
+            calibration,
+            expected_fingerprint=expected_fingerprint,
+            percentile=percentile,
+            expected_compute_dtype=expected_compute_dtype,
+        )
+        reason = verify_head(staged)
+        if reason is not None:
+            _m.counter(_m.TENANT_SWAPS).inc(
+                tenant=str(tenant), result=SWAP_REJECTED
+            )
+            record_event(
+                "tenant_swap_rejected", tenant=str(tenant), reason=reason
+            )
+            return TenantSwapReport(
+                ok=False, tenant=str(tenant), reason=reason
+            )
+        with self._lock:
+            head = self._heads.get(tenant)
+            if head is None:  # unmounted between verify and commit
+                return TenantSwapReport(
+                    ok=False, tenant=str(tenant), reason=REJECT_NOT_MOUNTED
+                )
+            head.calibration = calibration
+            head.gate = staged
+            head.head_fingerprint = head_fingerprint(calibration)
+            head.head_bytes = head_nbytes(calibration)
+        if head.drift is not None:
+            # the monitor now watches for drift away from the NEW head
+            head.drift.rebase(calibration)
+        if head.capture is not None:
+            head.capture.retarget(calibration)
+        _m.counter(_m.TENANT_SWAPS).inc(
+            tenant=str(tenant), result=SWAP_COMMITTED
+        )
+        _m.gauge(_m.TENANT_HEAD_BYTES).set(
+            float(head.head_bytes), tenant=str(tenant)
+        )
+        record_event("tenant_swap_committed", tenant=str(tenant))
+        return TenantSwapReport(
+            ok=True, tenant=str(tenant), reason=SWAP_COMMITTED,
+            head_fingerprint=head.head_fingerprint,
+        )
+
+    # ------------------------------------------------------------ serve tap
+    def on_response(self, payload: Any, resp: Any) -> None:
+        """Per-response tenant tap, called by the engine POST-record: feed
+        the tenant's drift window and trusted-capture reservoir. O(1) per
+        response; never raises (the capture tap's own contract)."""
+        tenant = getattr(resp, "tenant", None)
+        if tenant is None:
+            return
+        head = self.head_for(tenant)
+        if head is None:
+            return
+        if head.drift is not None:
+            if resp.log_px is not None:
+                head.drift.observe_px(resp.log_px)
+            head.drift.evaluate()  # cadence-gated; no-op between intervals
+        if head.capture is not None:
+            head.capture.on_response(payload, resp)

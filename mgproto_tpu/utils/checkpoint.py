@@ -757,8 +757,16 @@ def save_state_w_condition(
     forwards to `save_checkpoint` (the `--ckpt_format` plumbing) — the
     accuracy gate is host-symmetric (the test pass is SPMD), so under
     multi-host every process takes the same save/skip branch and the
-    coordinated protocol's barriers stay aligned."""
-    if accuracy <= target_accuracy:
+    coordinated protocol's barriers stay aligned.
+
+    The comparison is non-strict at the boundary (save when accuracy ==
+    target): the default target of 0.0 means "keep every stage
+    checkpoint", and an early epoch that evaluates to exactly 0.0
+    accuracy must still leave its stage checkpoint behind — resume and
+    the full-schedule e2e both read the stage set, not the accuracy. At
+    the reference's real thresholds (0.6/0.7) ties are measure-zero, so
+    parity is unaffected where it matters."""
+    if accuracy < target_accuracy:
         return None
     meta = dict(metadata or {})
     meta.update(epoch=epoch, stage=stage, accuracy=accuracy)

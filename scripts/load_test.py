@@ -41,6 +41,23 @@ Online-learning drift drill (ISSUE 11; --drift-at / --online):
   they say. MGPROTO_CHAOS_ONLINE_POISON_RATE injects low-p(x) MISLABELED
   requests that the capture gate must reject (counted + asserted).
 
+Multi-tenant isolation drill (ISSUE 17; --tenants N):
+
+  --tenants N        mount N tenant heads (t0..t{N-1}) on ONE shared trunk
+                     and round-robin the traffic across them. Mid-run the
+                     drill storms t0 far over its fair-share quota (typed
+                     `tenant_quota` sheds of t0's OWN tail — never another
+                     tenant's), poisons t0's traffic with off-manifold
+                     junk so only ITS drift monitor breaches, mounts a
+                     brand-new tenant mid-storm (head bytes only — zero
+                     trunk compiles, the AOT trunk key never changes), and
+                     fires a tenant-scoped blue/green pair: chaos rejects
+                     t0's head swap fail-closed
+                     (MGPROTO_CHAOS_TENANT_BAD_SWAP) while a quiet
+                     tenant's commits. The result gains a "tenants" block
+                     gated by `mgproto-telemetry check --tenants`
+                     (baseline: evidence/tenant_baseline.json).
+
 Output is ONE JSON line (stdout, and --out FILE): per-phase p50/p99 latency
 + shed-rate curves, shed-by-reason, breaker open-time fraction, batch-fill
 stats, dispatch-trigger counts, swap reports, restart counts, steady-state
@@ -66,6 +83,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DEFAULT_PHASES = "2x60,2x300,2x60"
+
+# the tenant drill's default schedule: constant-rate phases, so the ONLY
+# overload in the run is the injected t0 storm — quiet tenants must ride
+# through it with zero sheds (the isolation gate)
+TENANT_PHASES = "2x40,3x40,2x40"
 
 
 class VirtualClock:
@@ -124,6 +146,20 @@ def _label_counts(snapshot: Dict, name: str, key: str) -> Dict[str, float]:
         label = s.get("labels", {}).get(key)
         if label is not None and s.get("value"):
             out[label] = out.get(label, 0.0) + s["value"]
+    return out
+
+
+def _tenant_label_counts(
+    snapshot: Dict, name: str, inner_key: str
+) -> Dict[str, Dict[str, float]]:
+    """{tenant: {inner_label: count}} for a tenant-labeled counter."""
+    out: Dict[str, Dict[str, float]] = {}
+    for s in snapshot.get(name, {}).get("series", []):
+        labels = s.get("labels", {})
+        t, k = labels.get("tenant"), labels.get(inner_key)
+        if t is not None and k is not None and s.get("value"):
+            row = out.setdefault(t, {})
+            row[k] = row.get(k, 0.0) + s["value"]
     return out
 
 
@@ -522,6 +558,12 @@ def run_load_test(
     autoscale: Optional[Tuple[int, int]] = None,
     autoscale_interval_s: float = 0.1,
     aot_cache_dir: Optional[str] = None,
+    tenants: Optional[int] = None,
+    tenant_storm_at: Optional[int] = None,
+    tenant_storm_burst: int = 24,
+    tenant_mount_at: Optional[int] = None,
+    tenant_swap_at: Optional[int] = None,
+    tenant_poison_rate: Optional[float] = None,
 ) -> Dict:
     """Drive the storm; returns the result record (see module docstring).
     Importable — tests/test_load_plane.py runs the acceptance drill through
@@ -561,14 +603,54 @@ def run_load_test(
     )
 
     online_mode = online or drift_at is not None
+    tenant_mode = tenants is not None
+    if tenant_mode:
+        if int(tenants) < 2:
+            raise ValueError(
+                f"tenants needs N >= 2 (isolation is a two-party "
+                f"property), got {tenants}"
+            )
+        if online_mode or autoscale is not None:
+            raise ValueError(
+                "tenants does not combine with online/drift_at/autoscale "
+                "(one drill at a time)"
+            )
     if poison_rate is None:
         poison_rate = float(
             os.environ.get("MGPROTO_CHAOS_ONLINE_POISON_RATE") or 0.0
         )
+    # tenant drill geometry: the storm window is the MIDDLE phase (first
+    # and last stay calm, so every tenant has a clean before/after latency
+    # baseline); mount and swap land inside the storm, where isolation is
+    # hardest to fake
+    phase_counts = [max(int(round(d * r)), 1) for d, r in phases]
+    tenant_bad_swaps = 0
+    storm_end = 0
+    if tenant_mode:
+        storm_phase = min(1, len(phases) - 1)
+        storm_start = sum(phase_counts[:storm_phase])
+        storm_end = sum(phase_counts[:storm_phase + 1])
+        if tenant_storm_at is None:
+            env = os.environ.get("MGPROTO_CHAOS_TENANT_STORM_AT")
+            tenant_storm_at = int(env) if env else storm_start
+        if tenant_poison_rate is None:
+            env = os.environ.get("MGPROTO_CHAOS_TENANT_POISON_RATE")
+            tenant_poison_rate = float(env) if env else 0.5
+        if tenant_mount_at is None:
+            tenant_mount_at = (tenant_storm_at + storm_end) // 2
+        if tenant_swap_at is None:
+            tenant_swap_at = tenant_storm_at + (
+                (storm_end - tenant_storm_at) * 3 // 4
+            )
+        tenant_bad_swaps = int(
+            os.environ.get("MGPROTO_CHAOS_TENANT_BAD_SWAP") or 1
+        )
     registry = MetricRegistry()
     prev_registry = set_current_registry(registry)
     sm.register_serving_metrics(registry)
-    if online_mode:
+    if online_mode or tenant_mode:
+        # tenant heads carry per-tenant drift monitors and capture
+        # reservoirs (the online plane's instruments, tenant-labeled)
         from mgproto_tpu.online.metrics import register_online_metrics
 
         register_online_metrics(registry)
@@ -582,6 +664,11 @@ def run_load_test(
         serve_wedge_at=wedge_at,
         serve_swap_bad_artifact=bad_swaps,
         online_poison_rate=poison_rate if online_mode else 0.0,
+        tenant_storm_at=tenant_storm_at if tenant_mode else None,
+        tenant_bad_swap=tenant_bad_swaps,
+        tenant_poison_rate=(
+            float(tenant_poison_rate) if tenant_mode else 0.0
+        ),
     )
     prev_chaos = chaos_mod.set_active(
         chaos_mod.ChaosState(plan) if plan.any_active() else None
@@ -592,12 +679,10 @@ def run_load_test(
         service_s = service_ms / 1000.0
         aot_cache = None
         made_cache_dir = None
-        if autoscale is not None:
-            mn, mx = int(autoscale[0]), int(autoscale[1])
-            if mn < 1 or mx < mn:
-                raise ValueError(f"autoscale needs 1 <= min <= max, "
-                                 f"got {autoscale}")
-            replicas = mn  # the drill starts at the MIN fleet, by design
+        if autoscale is not None or tenant_mode:
+            # tenant mode shares the AOT cache too: the trunk executable
+            # is keyed by trunk fingerprint ALONE (heads are outside the
+            # executable identity), so N tenants share one compiled set
             import tempfile
 
             from mgproto_tpu.serving.aotcache import ExecutableCache
@@ -605,6 +690,12 @@ def run_load_test(
             if aot_cache_dir is None:
                 made_cache_dir = tempfile.mkdtemp(prefix="mgproto_aot_")
             aot_cache = ExecutableCache(aot_cache_dir or made_cache_dir)
+        if autoscale is not None:
+            mn, mx = int(autoscale[0]), int(autoscale[1])
+            if mn < 1 or mx < mn:
+                raise ValueError(f"autoscale needs 1 <= min <= max, "
+                                 f"got {autoscale}")
+            replicas = mn  # the drill starts at the MIN fleet, by design
         plane: Optional[OnlinePlane] = None
         if online_mode:
             import dataclasses as _dc
@@ -660,6 +751,77 @@ def run_load_test(
             ]
             calib = calibrate(trainer, state, id_batches)
 
+        directory = None
+        tenant_names: List[str] = []
+        storm_tenant: Optional[str] = None
+        mount_calib = None
+        tenant_drift_cfg = None
+        tenant_capture_cfg = None
+        tenant_mounts: List[Dict] = []
+        if tenant_mode:
+            from mgproto_tpu.online.capture import CaptureConfig
+            from mgproto_tpu.online.drift import DriftConfig
+            from mgproto_tpu.serving.tenants import TenantDirectory
+
+            directory = TenantDirectory(clock=clock)
+            # threshold sits between the measured clean ceiling (~0.27 —
+            # quiet tenants under storm-cadence dispatch) and the poisoned
+            # floor (~0.68 — t0 at 50% off-manifold traffic): wide margin
+            # on both sides of the isolation gate
+            tenant_drift_cfg = DriftConfig(
+                px_window=96,
+                min_px_samples=32,
+                eval_interval_s=0.25,
+                px_divergence_threshold=0.45,
+                mean_shift_threshold=0.0,
+            )
+            tenant_capture_cfg = CaptureConfig(
+                percentile=capture_percentile,
+                capacity_per_class=capture_capacity,
+                seed=seed,
+            )
+            # tenant heads calibrate on a LARGER ID sample than the stock
+            # drill's engine calibration: the per-tenant drift monitor
+            # compares live scores against the head's quantile sketch, and
+            # an 8-sample sketch is noisy enough to false-breach a QUIET
+            # tenant — which would forfeit the isolation gate
+            def _tenant_batches(rng_x):
+                return [
+                    (
+                        rng_x.rand(
+                            4, cfg.model.img_size, cfg.model.img_size, 3
+                        ).astype(np.float32),
+                        rng_x.randint(0, cfg.model.num_classes, (4,))
+                        .astype(np.int32),
+                    )
+                    for _ in range(8)
+                ]
+
+            tenant_calib = calibrate(
+                trainer, state, _tenant_batches(np.random.RandomState(seed + 3))
+            )
+            # a second calibration (fresh ID batches) is the DIFFERENT
+            # head the mid-storm mount and the blue/green pair ship —
+            # distinct head fingerprint, same trunk
+            mount_calib = calibrate(
+                trainer, state, _tenant_batches(np.random.RandomState(seed + 2))
+            )
+            for t in range(int(tenants)):
+                rep_m = directory.mount(
+                    f"t{t}", tenant_calib,
+                    drift_config=tenant_drift_cfg,
+                    capture_config=tenant_capture_cfg,
+                    num_classes=cfg.model.num_classes,
+                )
+                tenant_mounts.append({
+                    **rep_m.to_dict(),
+                    "during_storm": False,
+                    "trunk_compiles_delta": 0,
+                    "aot_misses_delta": 0,
+                })
+            tenant_names = list(directory.tenants())
+            storm_tenant = tenant_names[0]
+
         tracer = None
         if trace_out:
             # request tracing on the VIRTUAL clock, into a private tracer
@@ -682,6 +844,7 @@ def run_load_test(
                     queue_capacity=queue_capacity,
                     default_deadline_s=deadline_ms / 1000.0,
                     aot_cache=aot_cache,
+                    tenants=directory,
                 )
 
         if autoscale is not None:
@@ -748,6 +911,12 @@ def run_load_test(
         poison_injected = 0
         chaos = chaos_mod.get_active()
         drift_injected_t: Optional[float] = None
+        tenant_of: Dict[str, str] = {}
+        tenant_submitted: Dict[str, int] = {}
+        tenant_swap_reports: List[Dict] = []
+        tenant_poison_injected = 0
+        tenant_storm_extras = 0
+        poison_seq = 0
         i = 0
         for phase_idx, (duration_s, rps) in enumerate(phases):
             n = max(int(round(duration_s * rps)), 1)
@@ -761,23 +930,103 @@ def run_load_test(
                     swap_reports.append(
                         hot_swap(rs, factory).to_dict()
                     )
-                rid = f"q{i}"
-                submitted.append(rid)
-                phase_of[rid] = phase_idx
-                index_of[rid] = i
-                if plane is not None:
-                    if drift_at is not None and i == drift_at:
-                        plane.start_drift(clock())
-                        drift_injected_t = clock()
-                    poisoned = (
-                        chaos is not None and chaos.online_poison_due(i)
+                storm_now = (
+                    tenant_mode
+                    and chaos is not None
+                    and i < storm_end
+                    and chaos.tenant_storm_due(i)
+                )
+                if tenant_mode and i == tenant_mount_at:
+                    # mid-storm mount: a brand-new tenant arrives while t0
+                    # storms. The marginal cost is head bytes alone — the
+                    # shared trunk's executables and AOT entries are
+                    # untouched (the deltas below are the proof, re-read
+                    # after a poll so any recompile would have been folded
+                    # into the counter)
+                    pre_compiles = rs.steady_recompiles
+                    pre_misses = registry.counter(sm.AOT_MISSES).value()
+                    new_name = f"t{len(tenant_names)}"
+                    rep_m = directory.mount(
+                        new_name, mount_calib,
+                        drift_config=tenant_drift_cfg,
+                        capture_config=tenant_capture_cfg,
+                        num_classes=cfg.model.num_classes,
                     )
-                    poison_injected += poisoned
-                    payload = plane.next_payload(rid, poisoned)
-                else:
-                    payload = payload_rng.rand(img, img, 3).astype(np.float32)
+                    responses.extend(rs.poll())
+                    tenant_mounts.append({
+                        **rep_m.to_dict(),
+                        "during_storm": bool(storm_now),
+                        "trunk_compiles_delta":
+                            rs.steady_recompiles - pre_compiles,
+                        "aot_misses_delta":
+                            registry.counter(sm.AOT_MISSES).value()
+                            - pre_misses,
+                    })
+                    tenant_names.append(new_name)  # joins rotation NOW
+                if tenant_mode and i == tenant_swap_at:
+                    # tenant-scoped blue/green pair: chaos sabotages the
+                    # FIRST (the storm tenant's) — it must fail closed for
+                    # t0 ALONE; the quiet tenant's then commits cleanly on
+                    # the same directory
+                    quiet = next(
+                        t for t in tenant_names if t != storm_tenant
+                    )
+                    tenant_swap_reports.append(
+                        directory.swap(storm_tenant, mount_calib).to_dict()
+                    )
+                    tenant_swap_reports.append(
+                        directory.swap(quiet, mount_calib).to_dict()
+                    )
+                rid = f"q{i}"
+                arrivals: List[Tuple[str, Optional[str]]] = [(rid, None)]
+                if tenant_mode:
+                    arrivals = [(rid, tenant_names[i % len(tenant_names)])]
+                    if storm_now:
+                        # the storm: EXTRA t0 requests per tick, far past
+                        # its fair-share quota — its own tail sheds (typed
+                        # tenant_quota); nobody else's does
+                        for j in range(int(tenant_storm_burst)):
+                            arrivals.append((f"q{i}x{j}", storm_tenant))
+                            tenant_storm_extras += 1
                 before = len(responses)
-                responses.extend(rs.submit(payload, request_id=rid))
+                for arid, tenant in arrivals:
+                    submitted.append(arid)
+                    phase_of[arid] = phase_idx
+                    index_of[arid] = i
+                    if plane is not None:
+                        if drift_at is not None and i == drift_at:
+                            plane.start_drift(clock())
+                            drift_injected_t = clock()
+                        poisoned = (
+                            chaos is not None and chaos.online_poison_due(i)
+                        )
+                        poison_injected += poisoned
+                        payload = plane.next_payload(arid, poisoned)
+                    else:
+                        payload = (
+                            payload_rng.rand(img, img, 3)
+                            .astype(np.float32)
+                        )
+                    if tenant is not None:
+                        tenant_of[arid] = tenant
+                        tenant_submitted[tenant] = (
+                            tenant_submitted.get(tenant, 0) + 1
+                        )
+                        if (
+                            storm_now
+                            and tenant == storm_tenant
+                            and chaos.tenant_poison_due(poison_seq)
+                        ):
+                            # off-manifold junk INSIDE t0's lane: only ITS
+                            # drift monitor may breach
+                            tenant_poison_injected += 1
+                            payload = (
+                                payload * 6.0 - 3.0
+                            ).astype(np.float32)
+                        poison_seq += int(tenant == storm_tenant)
+                    responses.extend(
+                        rs.submit(payload, request_id=arid, tenant=tenant)
+                    )
                 responses.extend(rs.poll())
                 if scaler is not None:
                     decision = scaler.tick(clock())
@@ -999,6 +1248,70 @@ def run_load_test(
                 ),
                 "accuracy_windows": windows,
             }
+        if tenant_mode:
+            # per-tenant accounting from GROUND TRUTH (the responses and
+            # the heads themselves); the metric-side TENANT_SHED counts
+            # ride along so the telemetry gates can cross-derive verdicts
+            lat_by_tenant: Dict[str, Dict[str, List[float]]] = {}
+            outcomes_by_tenant: Dict[str, Dict[str, int]] = {}
+            for r in responses:
+                t = tenant_of.get(r.request_id)
+                if t is None:
+                    continue
+                row = outcomes_by_tenant.setdefault(t, {})
+                row[r.outcome] = row.get(r.outcome, 0) + 1
+                if r.outcome in ("predict", "abstain"):
+                    idx = index_of.get(r.request_id, 0)
+                    window = (
+                        "storm"
+                        if tenant_storm_at <= idx < storm_end
+                        else "calm"
+                    )
+                    lat_by_tenant.setdefault(
+                        t, {"calm": [], "storm": []}
+                    )[window].append(r.latency_s * 1000.0)
+            shed_by_tenant = _tenant_label_counts(
+                snapshot, sm.TENANT_SHED, "reason"
+            )
+            per_tenant: Dict[str, Dict] = {}
+            for t in directory.tenants():
+                head = directory.head_for(t)
+                lat = lat_by_tenant.get(t, {"calm": [], "storm": []})
+                per_tenant[t] = {
+                    "submitted": tenant_submitted.get(t, 0),
+                    "outcomes": outcomes_by_tenant.get(t, {}),
+                    "shed_by_reason": shed_by_tenant.get(t, {}),
+                    "quota": directory.quota_for(t, queue_capacity),
+                    "head_fingerprint": head.head_fingerprint,
+                    "head_bytes": head.head_bytes,
+                    "drift_breaches":
+                        head.drift.breaches if head.drift else 0,
+                    "capture":
+                        head.capture.stats() if head.capture else None,
+                    "calm": _pcts(lat["calm"]),
+                    "storm": _pcts(lat["storm"]),
+                }
+            result["tenants"] = {
+                "count": len(directory),
+                "initial": int(tenants),
+                "storm_tenant": storm_tenant,
+                "storm_at": tenant_storm_at,
+                "storm_end": storm_end,
+                "storm_burst": int(tenant_storm_burst),
+                "storm_extras": tenant_storm_extras,
+                "mount_at": tenant_mount_at,
+                "swap_at": tenant_swap_at,
+                "bad_swap": tenant_bad_swaps,
+                "poison_rate": tenant_poison_rate,
+                "poison_injected": tenant_poison_injected,
+                "per_tenant": per_tenant,
+                "mounts": tenant_mounts,
+                "swaps": tenant_swap_reports,
+                "aot": {
+                    "hits": registry.counter(sm.AOT_HITS).value(),
+                    "misses": registry.counter(sm.AOT_MISSES).value(),
+                },
+            }
         if tracer is not None:
             os.makedirs(os.path.dirname(trace_out) or ".", exist_ok=True)
             tracer.export_chrome_trace(trace_out)
@@ -1087,6 +1400,32 @@ def main(argv: Optional[list] = None) -> int:
                         "evidence/autoscale_baseline.json)")
     p.add_argument("--autoscale-interval-s", type=float, default=0.1,
                    help="autoscaler decision cadence (virtual seconds)")
+    p.add_argument("--tenants", type=int, default=None,
+                   help="mount N tenant heads (t0..t{N-1}) on one shared "
+                        "trunk and run the isolation drill: t0 quota "
+                        "storm, mid-storm tenant mount (zero trunk "
+                        "compiles), tenant-scoped blue/green (chaos "
+                        "rejects t0's), t0-only drift poison; the result "
+                        "gains a 'tenants' block (baseline: "
+                        "evidence/tenant_baseline.json)")
+    p.add_argument("--tenant-storm-at", type=int, default=None,
+                   help="request index the t0 quota storm starts at "
+                        "(default: start of the middle phase; env "
+                        "MGPROTO_CHAOS_TENANT_STORM_AT)")
+    p.add_argument("--tenant-storm-burst", type=int, default=24,
+                   help="extra t0 requests injected per arrival tick "
+                        "during the storm")
+    p.add_argument("--tenant-mount-at", type=int, default=None,
+                   help="request index the mid-storm tenant mount fires "
+                        "at (default: middle of the storm window)")
+    p.add_argument("--tenant-swap-at", type=int, default=None,
+                   help="request index the tenant-scoped blue/green pair "
+                        "fires at (default: 3/4 through the storm)")
+    p.add_argument("--tenant-poison-rate", type=float, default=None,
+                   help="fraction of the storm tenant's requests replaced "
+                        "with off-manifold junk (drives ITS drift monitor "
+                        "alone; default MGPROTO_CHAOS_TENANT_POISON_RATE "
+                        "or 0.5)")
     p.add_argument("--out", default="",
                    help="write the JSON line here (e.g. "
                         "evidence/load_test_baseline.json)")
@@ -1109,6 +1448,19 @@ def main(argv: Optional[list] = None) -> int:
             raise SystemExit(
                 f"--autoscale needs 1 <= MIN <= MAX, got {args.autoscale!r}"
             )
+
+    if args.tenants is not None:
+        if args.tenants < 2:
+            raise SystemExit(f"--tenants needs N >= 2, got {args.tenants}")
+        if args.autoscale or args.online or args.drift_at is not None:
+            raise SystemExit(
+                "--tenants does not combine with --autoscale/--online/"
+                "--drift-at (one drill at a time)"
+            )
+        if args.phases == DEFAULT_PHASES:
+            # constant-rate schedule: the injected storm must be the ONLY
+            # overload, or quiet-tenant isolation could not be asserted
+            args.phases = TENANT_PHASES
 
     result = run_load_test(
         seed=args.seed,
@@ -1138,6 +1490,12 @@ def main(argv: Optional[list] = None) -> int:
         poison_rate=args.poison_rate,
         autoscale=autoscale,
         autoscale_interval_s=args.autoscale_interval_s,
+        tenants=args.tenants,
+        tenant_storm_at=args.tenant_storm_at,
+        tenant_storm_burst=args.tenant_storm_burst,
+        tenant_mount_at=args.tenant_mount_at,
+        tenant_swap_at=args.tenant_swap_at,
+        tenant_poison_rate=args.tenant_poison_rate,
     )
     line = json.dumps(result, sort_keys=True)
     print(line)

@@ -137,13 +137,21 @@ def test_wedged_drill_names_the_straggler(wedged_drill):
     h0, h1 = fs["hosts"]["0"], fs["hosts"]["1"]
     # barrier waits land on the FAST host (it waits for the straggler)
     assert h0["barrier_waits"] >= DRILL_STEPS
-    assert h0["barrier_wait_fraction"] > 0.4
+    # The bands below discriminate "host 1 straggles by ~150 ms/step"
+    # from "nobody straggles" (where every value is ~0) — they are NOT
+    # precision measurements. The drill runs real subprocesses with real
+    # sleeps, and under full-suite CPU contention the fast host's own
+    # steps stretch (shrinking its wait fraction) while the wedged
+    # host's injected sleep stretches past its nominal value (growing
+    # the implied skew), so the bands are wide on both sides: a missing
+    # straggler still lands orders of magnitude outside them.
+    assert h0["barrier_wait_fraction"] > 0.2
     # the wedged host carries the skew; its implied absolute skew matches
     # the injected delay within tolerance (EMAs settle from zero, so the
     # band is generous but one-sided: host 0 must carry ~none)
     skew_s = h1["host_step_skew_fraction"] * h1["step_time_ema_seconds"]
-    assert 0.4 * DRILL_SLOW_MS / 1e3 <= skew_s <= 1.5 * DRILL_SLOW_MS / 1e3
-    assert h0["host_step_skew_fraction"] < 0.1
+    assert 0.2 * DRILL_SLOW_MS / 1e3 <= skew_s <= 3.0 * DRILL_SLOW_MS / 1e3
+    assert h0["host_step_skew_fraction"] < 0.25
     assert h1["straggler_suspected"] >= 1 and h0["straggler_suspected"] == 0
     # the targeted capture exists on host 1 only, cost-fallback mode
     cap_root = os.path.join(model_dir, "profile")
@@ -221,7 +229,9 @@ def test_clean_drill_passes_fleet_gates_and_perturbation_fails(
     # the clean fleet is quiet: nobody straggled, nobody captured
     fs = fleet_summary(telem)
     assert fs["fleet"]["straggler_suspected_total"] == 0
-    assert fs["fleet"]["max_skew_fraction"] < 0.3
+    # wide band for the same reason as the wedged drill's: a genuinely
+    # wedged host reads ~0.75 here, a clean one ~0 plus scheduler noise
+    assert fs["fleet"]["max_skew_fraction"] < 0.45
     # perturb the skew gate: its band collapses below zero -> any run fails
     with open(BASELINE) as f:
         baseline = json.load(f)
