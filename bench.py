@@ -1422,6 +1422,315 @@ def measure_weakscale() -> dict:
     }
 
 
+def measure_quant() -> dict:
+    """Hermetic int8 weight-only serving harness (`python bench.py
+    --measure quant`, CPU-friendly — the ISSUE 20 deliverable): ONE
+    record carrying everything `mgproto-telemetry check --quant`
+    re-derives, all measured through the PRODUCTION export + serving
+    stack over the trust drill's seeded toy:
+
+      * per-leaf weight-byte rows (f32 vs int8+scales) — the >=3x
+        backbone reduction, re-summable;
+      * int8 program vs its embedded dequantize-to-f32 debug twin:
+        per-sample per-logit and log p(x) deltas (the parity pin);
+      * the serve-bucket ladder: `plan_serve_buckets` with the explicit
+        weight-resident term under ONE shared budget, f32 vs int8 — the
+        int8 ladder must be strictly longer (modeled-latency/packing
+        headroom the 4x weight shrink buys);
+      * two full trust matrices (trust/matrix.py) — one per artifact,
+        raw scores and outcome counts included, so OoD-AUROC and
+        answered-accuracy deltas are re-derivable;
+      * the quant-mismatch drill: an f32-stamped calibration grafted
+        into a copy of the int8 artifact must trip
+        serving_quant_mismatch_total, degrade the gate, and be rejected
+        by `verify_head` with 'quant_mismatch' — fail-closed, OBSERVED.
+
+    Env knobs: BENCH_QUANT_BUCKETS (default "1,2,4,8"),
+    BENCH_QUANT_PER_CLASS (default 8), BENCH_QUANT_KINDS (default
+    "noise,contrast"), BENCH_QUANT_SEVERITIES (default "1,3,5"),
+    BENCH_QUANT_TOL (default 1e-3 — the parity pin)."""
+    if os.environ.get("BENCH_FAIL_INJECT"):
+        # deterministic failure for the cached-fallback contract tests
+        raise RuntimeError("BENCH_FAIL_INJECT: simulated quant failure")
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from mgproto_tpu.cli.trust import _samples
+    from mgproto_tpu.config import tiny_test_config
+    from mgproto_tpu.engine.export import (
+        artifact_meta,
+        embed_calibration,
+        export_eval,
+        load_artifact,
+        save_artifact,
+    )
+    from mgproto_tpu.engine.train import Trainer
+    from mgproto_tpu.online.capture import CapturedSample
+    from mgproto_tpu.online.consolidate import (
+        Consolidator,
+        ConsolidatorConfig,
+    )
+    from mgproto_tpu.perf.planner import plan_serve_buckets
+    from mgproto_tpu.perf.quant import quantize_params
+    from mgproto_tpu.serving import metrics as sm
+    from mgproto_tpu.serving.calibration import calibrate, gmm_fingerprint
+    from mgproto_tpu.serving.engine import ServingEngine
+    from mgproto_tpu.serving.swap import verify_head
+    from mgproto_tpu.telemetry.registry import (
+        MetricRegistry,
+        set_current_registry,
+    )
+    from mgproto_tpu.trust.matrix import MatrixConfig, run_matrix
+
+    buckets = tuple(
+        int(b)
+        for b in os.environ.get("BENCH_QUANT_BUCKETS", "1,2,4,8")
+        .split(",") if b.strip()
+    )
+    per_class = _env_int("BENCH_QUANT_PER_CLASS", 8)
+    kinds = tuple(
+        k.strip()
+        for k in os.environ.get("BENCH_QUANT_KINDS", "noise,contrast")
+        .split(",") if k.strip()
+    )
+    severities = tuple(
+        int(s)
+        for s in os.environ.get("BENCH_QUANT_SEVERITIES", "1,3,5")
+        .split(",") if s.strip()
+    )
+    tol = float(os.environ.get("BENCH_QUANT_TOL", "1e-3"))
+    classes, seed = 4, 0
+
+    registry = MetricRegistry()
+    prev = set_current_registry(registry)
+    tmp = tempfile.mkdtemp(prefix="mgproto_quant_")
+    try:
+        sm.register_serving_metrics(registry)
+        # ---- bootstrap the trust drill's toy through the production
+        # consolidation path (real served accuracy, not decorative)
+        cfg = tiny_test_config(num_classes=classes)
+        cfg = cfg.replace(em=_dc.replace(cfg.em, mean_lr=0.05))
+        trainer = Trainer(cfg, steps_per_epoch=1)
+        state = trainer.init_state(jax.random.PRNGKey(seed))
+        img = cfg.model.img_size
+        rng = np.random.RandomState(seed + 11)
+        cons = Consolidator(
+            trainer, state,
+            config=ConsolidatorConfig(cadence_s=1.0, batch_width=8),
+            clock=lambda: 0.0,
+        )
+        for _ in range(20):
+            for c in range(classes):
+                cons.ingest([
+                    CapturedSample(p, c, None, "bootstrap", True)
+                    for p in _samples(rng, c, img, 8)
+                ])
+        state = cons.candidate_state(state)
+
+        # ---- quantize; the int8 program serves the ROUND-TRIPPED grid,
+        # so its calibration is measured through those exact weights
+        q = quantize_params(state.params)
+        rt_state = state.replace(params=q.materialize(barrier=False))
+        qc = q.quant_config()
+        int8_w, f32_w = qc["total_weight_bytes"], qc["total_f32_bytes"]
+        reduction = f32_w / max(int8_w, 1)
+        if reduction < 3.0:
+            raise RuntimeError(
+                f"weight-bytes reduction {reduction:.2f}x < the 3x "
+                "acceptance floor — quantization covered too little of "
+                "the backbone"
+            )
+
+        calib_batches = [
+            (_samples(rng, c, img, 8), np.full((8,), c, np.int32))
+            for c in range(classes) for _ in range(2)
+        ]
+        calib_f32 = calibrate(trainer, state, calib_batches,
+                              source="quant-bench f32")
+        calib_int8 = calibrate(trainer, rt_state, calib_batches,
+                               source="quant-bench int8",
+                               quant_config=q.policy.tag)
+
+        # ---- the two artifacts, through the production export path
+        f32_path = os.path.join(tmp, "f32.mgproto")
+        int8_path = os.path.join(tmp, "int8.mgproto")
+        fp = gmm_fingerprint(state.gmm)
+        save_artifact(
+            f32_path, export_eval(trainer, state),
+            artifact_meta(cfg, None, True, gmm_fingerprint=fp),
+            calibration=calib_f32,
+        )
+        save_artifact(
+            int8_path, export_eval(trainer, state, quantized=q),
+            artifact_meta(cfg, None, True, gmm_fingerprint=fp, quant=qc),
+            calibration=calib_int8,
+            dequant=export_eval(trainer, rt_state),
+        )
+
+        # ---- parity: int8 program vs its dequantize-to-f32 debug twin
+        id_parts, id_labels = [], []
+        for c in range(classes):
+            id_parts.append(_samples(rng, c, img, per_class))
+            id_labels.append(np.full((per_class,), c, np.int32))
+        id_images = np.concatenate(id_parts).astype(np.float32)
+        id_labels = np.concatenate(id_labels)
+        call8, _ = load_artifact(int8_path)
+        calld, _ = load_artifact(int8_path, dequantize=True)
+        out8 = jax.device_get(call8(id_images))
+        outd = jax.device_get(calld(id_images))
+        logit_delta = [
+            float(d) for d in
+            np.abs(out8["logits"] - outd["logits"]).max(axis=1)
+        ]
+        px_delta = [
+            float(d) for d in np.abs(out8["log_px"] - outd["log_px"])
+        ]
+        parity = {
+            "tolerance": tol,
+            "logit_delta_max_per_sample": logit_delta,
+            "log_px_delta": px_delta,
+            "max_logit_delta": max(logit_delta),
+            "max_log_px_delta": max(px_delta),
+        }
+
+        # ---- engines + trust matrices (drill-scale committed bars, the
+        # run_synthetic_matrix convention: the MACHINERY is what's gated)
+        mc = MatrixConfig(
+            seed=seed, kinds=kinds, severities=severities,
+            auroc_floor=0.85, answered_accuracy_floor=0.30,
+            monotone_tol=0.05,
+        )
+        ood = {
+            "inverted": np.concatenate([
+                _samples(rng, c, img, per_class // 2, channel=-2.0)
+                for c in range(classes)
+            ]),
+            "dimmed": np.concatenate([
+                _samples(rng, c, img, per_class // 2, channel=0.0)
+                for c in range(classes)
+            ]),
+        }
+        trust = {}
+        engines = {}
+        for name, path in (("f32", f32_path), ("int8", int8_path)):
+            engine = ServingEngine.from_artifact(path, buckets=buckets)
+            engine.warmup()
+            engines[name] = engine
+            trust[name] = run_matrix(engine, id_images, id_labels, ood, mc)
+
+        # ---- planner ladder under ONE shared budget: probe the int8
+        # program peaks first, then size the budget so every int8 bucket
+        # fits with zero slack to spare — the f32 artifact's 4x weight
+        # residency must then push its top buckets over
+        _, probe = plan_serve_buckets(
+            engines["int8"], budget_bytes=1 << 50, margin=0.0,
+            weight_bytes=int8_w,
+        )
+        max_peak8 = max(
+            r.detail["program_peak_bytes"] for r in probe.reports
+        )
+        budget = int8_w + max_peak8 + 4096
+        planner = {"budget_bytes": int(budget),
+                   "per_replica_hbm_drop_bytes": int(f32_w - int8_w)}
+        for name, w in (("f32", f32_w), ("int8", int8_w)):
+            fitting, outcome = plan_serve_buckets(
+                engines[name], budget_bytes=budget, margin=0.0,
+                weight_bytes=w,
+            )
+            planner[name] = {
+                "weight_resident_bytes": int(w),
+                "rows": [
+                    {
+                        "batch": r.candidate.batch,
+                        "program_peak_bytes": int(
+                            r.detail["program_peak_bytes"]
+                        ),
+                        "weight_resident_bytes": int(
+                            r.detail["weight_resident_bytes"]
+                        ),
+                        "total_bytes": int(r.peak_bytes),
+                        "fits": bool(r.fits),
+                    }
+                    for r in outcome.reports
+                ],
+            }
+            planner[f"{name}_buckets_fit"] = [int(b) for b in fitting]
+        if not len(planner["int8_buckets_fit"]) > len(
+            planner["f32_buckets_fit"]
+        ):
+            raise RuntimeError(
+                f"int8 ladder {planner['int8_buckets_fit']} did not "
+                f"outgrow f32 {planner['f32_buckets_fit']} under budget "
+                f"{budget}"
+            )
+
+        # ---- mismatch drill: f32-stamped calibration grafted into a
+        # copy of the int8 artifact — fail-closed must be OBSERVED
+        mm_path = os.path.join(tmp, "mismatch.mgproto")
+        shutil.copy(int8_path, mm_path)
+        embed_calibration(mm_path, calib_f32)
+        mm_engine = ServingEngine.from_artifact(mm_path, buckets=buckets)
+        drill = {
+            "quant_mismatch_total": registry.counter(
+                sm.QUANT_MISMATCHES
+            ).value(),
+            "degraded": bool(mm_engine.gate.degraded),
+            "swap_reject": verify_head(mm_engine.gate),
+        }
+
+        record = {
+            "metric": "quant",
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "backend": jax.default_backend(),
+            "config": {
+                "tiny": True,
+                "classes": classes,
+                "per_class": per_class,
+                "buckets": list(buckets),
+                "kinds": list(kinds),
+                "severities": list(severities),
+                "seed": seed,
+                "auroc_rederive_tol": 1e-9,
+            },
+            "weights": {
+                "rows": [dict(r) for r in q.report],
+                "f32_total": int(f32_w),
+                "int8_total": int(int8_w),
+                "reduction": round(reduction, 3),
+                "num_quantized": qc["num_quantized"],
+                "num_skipped": qc["num_skipped"],
+            },
+            "parity": parity,
+            "planner": planner,
+            "trust": trust,
+            "floors": {
+                "weight_reduction_min": 3.0,
+                "tolerance": tol,
+                "auroc_delta_limit": 0.05,
+                "answered_accuracy_delta_limit": 0.10,
+                "px_divergence_limit": mc.px_divergence_limit,
+            },
+            "drill": drill,
+        }
+        # self-gate with the SAME suite `check --quant` applies — a record
+        # this measure would commit must already pass its own re-derivation
+        from mgproto_tpu.cli.telemetry import quant_gates
+
+        gates = quant_gates(record)
+        record["gates"] = gates
+        if not gates["ok"]:
+            failing = [r for r in gates["rows"] if not r["ok"]]
+            raise RuntimeError(f"quant self-gate failed: {failing}")
+        return record
+    finally:
+        set_current_registry(prev)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _fail(error_obj: dict) -> None:
     """Terminal failure path: emit the live diagnostics, then — if a watcher
     window ever captured a real number — the cached result as the final line
@@ -1609,6 +1918,10 @@ if __name__ == "__main__":
             _measure_with_cached_fallback(
                 measure_weakscale, "weakscale_bench.json"
             )
+        if measure == "quant":
+            # hermetic int8 weight-only serving harness (ISSUE 20), same
+            # cached-fallback/staleness degrade machinery
+            _measure_with_cached_fallback(measure_quant, "quant_bench.json")
         if measure == "weakscale_probe":
             # child mode of measure_weakscale: ONE chip count, whose
             # device pool the parent fixed via XLA_FLAGS before spawn

@@ -78,6 +78,17 @@ def main(argv: Optional[list] = None) -> None:
                         "(serving/aotcache.py)")
     p.add_argument("--aot_buckets", default="1,2,4,8",
                    help="bucket sizes to precompile into the AOT cache")
+    p.add_argument("--quantize", choices=("none", "int8"), default="none",
+                   help="weight-only quantization of the backbone's conv/"
+                        "dense kernels (perf/quant.py): 'int8' bakes int8 "
+                        "tensors + per-output-channel f32 scales into the "
+                        "program (dequantize-in-kernel — 1 byte/param "
+                        "steady-state weight traffic), stamps quant_config "
+                        "into meta.json + the calibration, and embeds the "
+                        "dequantize-to-f32 debug program; 'none' (default) "
+                        "writes today's f32 artifact byte-identically. The "
+                        "GMM head, priors, log p(x) and calibration math "
+                        "are never quantized")
     args = p.parse_args(argv)
     cfg = config_from_args(args)
 
@@ -101,19 +112,43 @@ def main(argv: Optional[list] = None) -> None:
     state = restore_checkpoint(path, state)
 
     dynamic = args.static_batch <= 0
+    qparams = None
+    dequant = None
+    if args.quantize != "none":
+        from mgproto_tpu.perf.quant import (
+            quantize_params,
+            resolve_quant_policy,
+        )
+
+        qparams = quantize_params(
+            state.params, resolve_quant_policy(args.quantize)
+        )
+        # calibration + the debug program both run on the ROUND-TRIPPED
+        # weights: ID thresholds must be measured under exactly the grid
+        # the int8 program serves, and the dequant blob is its f32 twin
+        state = state.replace(params=qparams.materialize(barrier=False))
+        dequant = export_eval(
+            trainer, state, dynamic_batch=dynamic,
+            static_batch=max(args.static_batch, 1),
+        )
     exported = export_eval(
         trainer, state, dynamic_batch=dynamic,
         static_batch=max(args.static_batch, 1),
+        quantized=qparams,
     )
     meta = artifact_meta(
         cfg, path, dynamic,
         gmm_fingerprint=gmm_fingerprint(state.gmm),
         static_batch=max(args.static_batch, 1),
+        quant=qparams.quant_config() if qparams is not None else None,
     )
     calib = None
     if args.calibrate:
         calib = calibrate_from_config(
-            cfg, trainer, state, percentile=args.calib_percentile
+            cfg, trainer, state, percentile=args.calib_percentile,
+            quant_config=(
+                qparams.policy.tag if qparams is not None else ""
+            ),
         )
     explain = None
     if args.explain:
@@ -142,13 +177,15 @@ def main(argv: Optional[list] = None) -> None:
             explain_table(state, provenance=provenance),
         )
     save_artifact(
-        args.out, exported, meta, calibration=calib, explain=explain
+        args.out, exported, meta, calibration=calib, explain=explain,
+        dequant=dequant,
     )
     line = {
         "artifact": args.out,
         "bytes": os.path.getsize(args.out),
         "calibrated": calib is not None,
         "explain": explain is not None,
+        "quantize": args.quantize,
         **{k: meta[k] for k in ("arch", "num_classes", "img_size",
                                 "dynamic_batch", "checkpoint",
                                 "gmm_fingerprint")},

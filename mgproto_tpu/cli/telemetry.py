@@ -234,6 +234,11 @@ def _serving_section(last: Dict) -> Optional[Dict[str, Any]]:
         "fingerprint_mismatches": _series_value(
             last, sm.FINGERPRINT_MISMATCHES
         ),
+        # int8 weight-only serving (ISSUE 20): mismatch counter is pre-
+        # registered (explicit 0 = "no artifact/calibration quant skew"),
+        # the weight-bytes gauge is nonzero only under a quantized artifact
+        "quant_mismatches": _series_value(last, sm.QUANT_MISMATCHES),
+        "quant_weight_bytes": _series_value(last, sm.QUANT_WEIGHT_BYTES),
         "device_errors": _series_value(last, sm.DEVICE_ERRORS),
         "breaker_state": _series_value(last, sm.BREAKER_STATE),
         "breaker_transitions": _series_by_label(
@@ -1835,6 +1840,234 @@ def trust_gates(record: Dict[str, Any]) -> Dict[str, Any]:
             "failed": sum(not r["ok"] for r in rows), "rows": rows}
 
 
+def quant_gates(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Gate a committed int8-serving record (bench.py --measure quant ->
+    evidence/quant_bench.json) — the ISSUE 20 acceptance criteria,
+    RE-DERIVED from the record's RAW numbers (per-leaf byte rows,
+    per-sample parity deltas, per-bucket planner terms, the two embedded
+    trust-matrix reports' raw scores and outcome counts), never from
+    stored ratio/AUROC/fit fields, which would gate nothing:
+
+      * backbone weight bytes re-summed from the per-leaf rows must match
+        the recorded totals (tamper bound) AND the f32/int8 ratio must
+        clear the committed reduction floor (>= 3x);
+      * parity maxima re-derived from the per-sample delta arrays
+        (per-logit and log p(x), int8 program vs its dequantize-to-f32
+        debug twin) must match the recorded maxima and sit inside the
+        committed tolerance;
+      * the serve-bucket ladder re-derived from each bucket's
+        program-peak + weight-resident terms vs the shared budget must
+        match the recorded fit lists, and the int8 ladder must be
+        STRICTLY longer than the f32 one — the 4x weight shrink has to
+        buy real batch headroom, and the recorded per-replica HBM drop
+        must equal the weight-resident difference;
+      * between the f32 and int8 trust matrices: every ID x OoD pair's
+        AUROC re-derived from raw served scores in BOTH reports (each
+        also matching its own recorded value), with |delta| inside the
+        committed limit; answered accuracy per corruption cell re-derived
+        from raw counts with |delta| inside its limit; the int8 clean-ID
+        sketch still sits on its calibration (px divergence under limit);
+      * the mismatch drill fired: the quant-skewed calibration tripped
+        serving_quant_mismatch_total, the gate degraded, and verify_head
+        rejected the swap with 'quant_mismatch' — fail-closed, observed;
+      * zero steady-state recompiles in both embedded matrices."""
+    rows: List[Dict[str, Any]] = []
+
+    def gate(key, ok, why="", baseline_v=None, value=None):
+        rows.append({"key": key, "ok": bool(ok), "why": "" if ok else why,
+                     "baseline": baseline_v, "value": value,
+                     "direction": "quant"})
+
+    floors = record.get("floors") or {}
+    weights = record.get("weights") or {}
+    parity = record.get("parity") or {}
+    planner = record.get("planner") or {}
+    trust = record.get("trust") or {}
+    drill = record.get("drill") or {}
+    gate("quant.schema",
+         record.get("metric") == "quant" and bool(weights.get("rows"))
+         and bool(parity) and bool(planner) and bool(floors)
+         and bool(trust.get("f32")) and bool(trust.get("int8"))
+         and bool(drill),
+         "not a quant record (missing metric/weights/parity/planner/"
+         "floors/trust.f32/trust.int8/drill)")
+
+    # --- weight bytes: re-sum the per-leaf rows, then the reduction floor
+    leaf_rows = weights.get("rows") or []
+    f32_sum = sum(int(r.get("f32_bytes") or 0) for r in leaf_rows)
+    int8_sum = sum(int(r.get("quant_bytes") or 0) for r in leaf_rows)
+    gate("quant.weight_rows_resum",
+         leaf_rows and f32_sum == weights.get("f32_total")
+         and int8_sum == weights.get("int8_total"),
+         f"per-leaf rows re-sum to f32={f32_sum} int8={int8_sum} but the "
+         f"record claims f32={weights.get('f32_total')} "
+         f"int8={weights.get('int8_total')}",
+         baseline_v=(weights.get("f32_total"), weights.get("int8_total")),
+         value=(f32_sum, int8_sum))
+    floor = floors.get("weight_reduction_min")
+    reduction = (f32_sum / int8_sum) if int8_sum else None
+    gate("quant.weight_reduction_floor",
+         reduction is not None and isinstance(floor, (int, float))
+         and reduction >= floor,
+         f"re-derived weight-bytes reduction {reduction} < committed "
+         f"floor {floor}",
+         baseline_v=floor,
+         value=round(reduction, 3) if reduction else reduction)
+
+    # --- parity: maxima re-derived from the per-sample arrays
+    tol = floors.get("tolerance")
+    for key, recorded_key in (("logit_delta_max_per_sample",
+                               "max_logit_delta"),
+                              ("log_px_delta", "max_log_px_delta")):
+        deltas = parity.get(key) or []
+        derived = max((abs(float(d)) for d in deltas), default=None)
+        recorded = parity.get(recorded_key)
+        gate(f"quant.parity_rederives[{key}]",
+             derived is not None and isinstance(recorded, (int, float))
+             and abs(derived - recorded) <= 1e-12,
+             f"recorded {recorded_key}={recorded} does not follow from "
+             f"the {len(deltas)} per-sample deltas (re-derived {derived})",
+             baseline_v=recorded, value=derived)
+        gate(f"quant.parity_tolerance[{key}]",
+             derived is not None and isinstance(tol, (int, float))
+             and derived <= tol,
+             f"int8-vs-dequantized-f32 delta {derived} exceeds the "
+             f"committed tolerance {tol}",
+             baseline_v=tol, value=derived)
+
+    # --- planner ladder: re-derive fits from the recorded raw terms
+    budget = planner.get("budget_bytes")
+    fits: Dict[str, List[int]] = {}
+    for variant in ("f32", "int8"):
+        vrows = (planner.get(variant) or {}).get("rows") or []
+        derived_fit = []
+        resum_ok = bool(vrows) and isinstance(budget, (int, float))
+        for r in vrows:
+            total = (int(r.get("program_peak_bytes") or 0)
+                     + int(r.get("weight_resident_bytes") or 0))
+            if total != r.get("total_bytes"):
+                resum_ok = False
+            if isinstance(budget, (int, float)) and total <= budget:
+                derived_fit.append(int(r.get("batch")))
+        fits[variant] = derived_fit
+        recorded_fit = planner.get(f"{variant}_buckets_fit")
+        gate(f"quant.ladder_rederives[{variant}]",
+             resum_ok and derived_fit == recorded_fit,
+             f"fit list re-derived from peak+weight terms vs budget "
+             f"{budget} is {derived_fit}, record claims {recorded_fit} "
+             "(or a row's total_bytes does not equal its terms)",
+             baseline_v=recorded_fit, value=derived_fit)
+    gate("quant.ladder_grows",
+         len(fits.get("int8") or []) > len(fits.get("f32") or []),
+         f"int8 serve-bucket ladder {fits.get('int8')} is not longer than "
+         f"f32 {fits.get('f32')} — quantization bought no batch headroom "
+         "under the shared budget",
+         baseline_v=fits.get("f32"), value=fits.get("int8"))
+    drop = planner.get("per_replica_hbm_drop_bytes")
+    w_f32 = (planner.get("f32") or {}).get("weight_resident_bytes")
+    w_int8 = (planner.get("int8") or {}).get("weight_resident_bytes")
+    gate("quant.hbm_drop_rederives",
+         isinstance(w_f32, int) and isinstance(w_int8, int)
+         and drop == w_f32 - w_int8 and drop > 0,
+         f"recorded per-replica HBM drop {drop} != f32 weight-resident "
+         f"{w_f32} - int8 {w_int8} (or not positive)",
+         baseline_v=drop,
+         value=(w_f32 - w_int8) if isinstance(w_f32, int)
+         and isinstance(w_int8, int) else None)
+
+    # --- trust deltas: both matrices re-derived, then compared
+    from mgproto_tpu.trust.auroc import binary_auroc as _auroc
+
+    def acc(cell) -> Optional[float]:
+        answered = cell.get("answered") or 0
+        correct = cell.get("correct_answered")
+        if not answered or not isinstance(correct, (int, float)):
+            return None
+        return correct / answered
+
+    reports = {v: trust.get(v) or {} for v in ("f32", "int8")}
+    for variant, rep in reports.items():
+        gate(f"quant.zero_steady_recompiles[{variant}]",
+             rep.get("steady_state_recompiles") == 0,
+             f"{variant} matrix recompiled in steady state: "
+             f"{rep.get('steady_state_recompiles')}")
+    aurocs: Dict[str, Dict[str, float]] = {"f32": {}, "int8": {}}
+    rtol = (record.get("config") or {}).get("auroc_rederive_tol", 1e-9)
+    for variant, rep in reports.items():
+        id_scores = (rep.get("id") or {}).get("scores") or []
+        for p in rep.get("pairs") or []:
+            name = p.get("pair")
+            derived = (
+                _auroc(id_scores, p.get("scores") or [])
+                if id_scores and p.get("scores") else None
+            )
+            recorded = p.get("auroc")
+            gate(f"quant.auroc_rederives[{variant}:{name}]",
+                 derived is not None
+                 and isinstance(recorded, (int, float))
+                 and abs(derived - recorded) <= rtol,
+                 f"{variant} recorded AUROC {recorded} does not follow "
+                 f"from the raw scores (re-derived {derived})",
+                 baseline_v=recorded, value=derived)
+            if derived is not None:
+                aurocs[variant][name] = derived
+    limit = floors.get("auroc_delta_limit")
+    for name in sorted(aurocs["f32"]):
+        a, b = aurocs["f32"].get(name), aurocs["int8"].get(name)
+        delta = abs(a - b) if a is not None and b is not None else None
+        gate(f"quant.auroc_delta[{name}]",
+             delta is not None and isinstance(limit, (int, float))
+             and delta <= limit,
+             f"int8 shifts OoD AUROC by {delta} (f32 {a} vs int8 {b}), "
+             f"outside the committed limit {limit}",
+             baseline_v=limit, value=delta)
+    acc_limit = floors.get("answered_accuracy_delta_limit")
+    f32_ladder = reports["f32"].get("ladder") or {}
+    int8_ladder = reports["int8"].get("ladder") or {}
+    for kind in sorted(f32_ladder):
+        cells_a = {c.get("severity"): c for c in f32_ladder.get(kind) or []}
+        cells_b = {c.get("severity"): c
+                   for c in int8_ladder.get(kind) or []}
+        for sev in sorted(cells_a):
+            a, b = acc(cells_a[sev]), acc(cells_b.get(sev) or {})
+            # full abstention on either side makes the risk vacuous — the
+            # trust suite's own monotone/floor gates cover that cell
+            if a is None or b is None:
+                continue
+            delta = abs(a - b)
+            gate(f"quant.answered_accuracy_delta[{kind}:{sev}]",
+                 isinstance(acc_limit, (int, float)) and delta <= acc_limit,
+                 f"int8 shifts accuracy-on-answered by {delta} "
+                 f"(f32 {a} vs int8 {b}) at {kind}:{sev}, outside the "
+                 f"committed limit {acc_limit}",
+                 baseline_v=acc_limit, value=round(delta, 4))
+    div = (reports["int8"].get("id") or {}).get("px_divergence")
+    div_limit = floors.get("px_divergence_limit")
+    gate("quant.int8_calibration_matches_serving",
+         isinstance(div, (int, float))
+         and isinstance(div_limit, (int, float)) and div <= div_limit,
+         f"int8 clean-ID served-score divergence {div} vs limit "
+         f"{div_limit} — the int8 serving path is not the distribution "
+         "its calibration measured",
+         baseline_v=div_limit, value=div)
+
+    # --- mismatch drill: fail-closed must have been OBSERVED, not assumed
+    gate("quant.mismatch_drill_counted",
+         (drill.get("quant_mismatch_total") or 0) >= 1,
+         "the quant-skewed calibration never tripped "
+         "serving_quant_mismatch_total",
+         baseline_v=1, value=drill.get("quant_mismatch_total"))
+    gate("quant.mismatch_drill_degraded", drill.get("degraded") is True,
+         "the gate did not degrade on quant-config mismatch")
+    gate("quant.mismatch_drill_swap_rejected",
+         drill.get("swap_reject") == "quant_mismatch",
+         f"verify_head returned {drill.get('swap_reject')!r}, expected "
+         "'quant_mismatch'",
+         baseline_v="quant_mismatch", value=drill.get("swap_reject"))
+    return {"ok": all(r["ok"] for r in rows), "checked": len(rows),
+            "failed": sum(not r["ok"] for r in rows), "rows": rows}
+
+
 def stall_report_gates(
     record: Dict[str, Any],
     baseline: Optional[Dict[str, Any]] = None,
@@ -2057,6 +2290,18 @@ def check_main(argv: Optional[list] = None) -> int:
                         "at every severity, calibration-vs-serving sketch "
                         "agreement, zero dropped requests, zero steady-"
                         "state recompiles — exit 1 on any failure")
+    p.add_argument("--quant", default=None, metavar="FILE",
+                   help="gate a committed int8-serving record (bench.py "
+                        "--measure quant -> evidence/quant_bench.json): "
+                        "backbone weight bytes re-summed from per-leaf "
+                        "rows with >=3x reduction, int8-vs-dequantized "
+                        "parity maxima re-derived inside tolerance, "
+                        "serve-bucket ladder re-derived from raw peak+"
+                        "weight terms and strictly longer under int8, "
+                        "f32-vs-int8 trust-matrix AUROC/accuracy deltas "
+                        "re-derived from raw scores inside committed "
+                        "limits, quant-mismatch drill fail-closed, zero "
+                        "steady-state recompiles — exit 1 on any failure")
     p.add_argument("--stall-report", default=None, metavar="FILE",
                    help="gate a stall-budget report (scripts/"
                         "trace_report.py output): schema sanity, and with "
@@ -2148,6 +2393,12 @@ def check_main(argv: Optional[list] = None) -> int:
         result = weakscale_gates(record)
         _emit_suite("weakscale", result)
         suites_ok = suites_ok and result["ok"]
+    if args.quant:
+        any_suite = True
+        record = _read_json(args.quant, "quant record")
+        result = quant_gates(record)
+        _emit_suite("quant", result)
+        suites_ok = suites_ok and result["ok"]
     if args.dir is None and any_suite:
         _flush_json()
         return 0 if suites_ok else 1
@@ -2155,7 +2406,7 @@ def check_main(argv: Optional[list] = None) -> int:
         raise SystemExit(
             "check needs a telemetry dir AND --baseline (or --drift-drill "
             "/ --stall-report / --autoscale / --tenants / --weakscale / "
-            "--trust FILE alone)"
+            "--trust / --quant FILE alone)"
         )
     if not os.path.isdir(args.dir):
         raise SystemExit(f"not a directory: {args.dir}")

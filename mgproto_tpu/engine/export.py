@@ -24,6 +24,19 @@ Artifact layout: a single zip (conventionally `*.mgproto`) holding
                      per-class temperatures, stamped with the fingerprint
                      of the GMM they were measured under. The serving
                      engine refuses to trust-gate without it.
+
+Int8 weight-only artifacts (ISSUE 20, perf/quant.py): with
+`mgproto-export --quantize int8` the MAIN program's baked trunk constants
+are int8 kernels + per-output-channel f32 scales, dequantized in-kernel
+behind `lax.optimization_barrier` (without the barrier XLA constant-folds
+the dequant at compile time and bakes the f32 tensors right back — 4-byte
+weight traffic restored, silently). meta.json then carries a
+`quant_config` block (mode, tag, byte accounting, content fingerprint),
+and a second staged program — `dequant.stablehlo`, the same dequantized
+weights exported as plain f32 constants — rides along as the debug/parity
+reference reachable via `load_artifact(dequantize=True)`. `--quantize
+none` writes today's artifact byte-identically: no extra blob, no
+`quant_config` key, nothing for old loaders to trip on.
 """
 
 from __future__ import annotations
@@ -50,11 +63,15 @@ _CALIB_NAME = "calibration.json"
 # without --explain pays nothing for an artifact that carries these.
 _EXPLAIN_BLOB = "explain.stablehlo"
 _EXPLAIN_TABLE = "explain.json"
+# opt-in int8 debug sidecar (ISSUE 20): the dequantize-to-f32 twin of a
+# quantized main program (same rounded weight VALUES, plain f32 constants)
+_DEQUANT_BLOB = "dequant.stablehlo"
 
 
 def export_eval(trainer, state, dynamic_batch: bool = True,
                 static_batch: int = 8,
-                platforms: Tuple[str, ...] = ("cpu", "tpu", "cuda")):
+                platforms: Tuple[str, ...] = ("cpu", "tpu", "cuda"),
+                quantized=None):
     """Stage the eval step out as a jax.export.Exported.
 
     The returned program maps f32 images [b, H, W, 3] (already normalized,
@@ -65,7 +82,14 @@ def export_eval(trainer, state, dynamic_batch: bool = True,
     of StableHLO cannot handle symbolic dims). `platforms` defaults to a
     multi-platform lowering — without it jax.export pins the artifact to the
     EXPORTING machine's backend, so a TPU-side export could not serve on a
-    CPU host (the exact portability this feature promises)."""
+    CPU host (the exact portability this feature promises).
+
+    `quantized` (a perf/quant.py QuantizedParams) swaps the trunk params
+    for their int8 + per-channel-scale form, dequantized INSIDE the traced
+    program behind an optimization barrier: the staged constants are the
+    1-byte tensors, the dequant multiply fuses into the consuming conv
+    read at serve time. The GMM head / log p(x) path is untouched — it
+    reads state.gmm, which quantization never sees."""
     cfg = trainer.cfg
     if trainer._fused:
         # re-resolve on a plain Trainer with the portable path forced; the
@@ -76,7 +100,14 @@ def export_eval(trainer, state, dynamic_batch: bool = True,
         trainer = Trainer(portable, steps_per_epoch=1)
 
     def infer(images):
-        out = trainer._eval(state, images, None)
+        eval_state = state
+        if quantized is not None:
+            # materialize inside the trace so the barrier keeps the int8
+            # constants live in the exported module
+            eval_state = state.replace(
+                params=quantized.materialize(barrier=True)
+            )
+        out = trainer._eval(eval_state, images, None)
         return {"logits": out.logits, "log_px": out.log_px}
 
     if dynamic_batch:
@@ -90,12 +121,14 @@ def export_eval(trainer, state, dynamic_batch: bool = True,
 
 
 def save_artifact(path: str, exported, meta: Dict[str, Any],
-                  calibration=None, explain=None) -> None:
+                  calibration=None, explain=None, dequant=None) -> None:
     """One-file artifact: the serialized program + meta.json (+ the
     serving calibration when given — a `serving.calibration.Calibration`
     or an already-serialized dict; + the explain sidecars when given — an
     (exported_explain_program, table_dict) pair from `export_explain` /
-    `explain_table`)."""
+    `explain_table`; + the dequantize-to-f32 debug program when given —
+    the quantized export's parity reference, `load_artifact(
+    dequantize=True)`)."""
     with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_DEFLATED) as z:
         z.writestr(_BLOB_NAME, bytes(exported.serialize()))
         z.writestr(_META_NAME, json.dumps(meta, indent=2, sort_keys=True))
@@ -108,6 +141,8 @@ def save_artifact(path: str, exported, meta: Dict[str, Any],
                 _EXPLAIN_TABLE,
                 json.dumps(table, indent=2, sort_keys=True),
             )
+        if dequant is not None:
+            z.writestr(_DEQUANT_BLOB, bytes(dequant.serialize()))
 
 
 def _calib_json(calibration) -> str:
@@ -300,6 +335,14 @@ def artifact_aot_fingerprint(path: str) -> str:
     return file_fingerprint(path) + ":" + (meta.get("gmm_fingerprint") or "")
 
 
+def quant_tag(meta: Dict[str, Any]) -> str:
+    """The serving-seam quant identity of an artifact's meta block
+    (perf/quant.py quant_config "tag"; "" for unquantized / pre-quant
+    artifacts). The ONE derivation `ServingEngine.from_artifact`,
+    `export_aot_cache` and the serve CLI share."""
+    return str((meta.get("quant_config") or {}).get("tag") or "")
+
+
 def export_aot_cache(
     path: str,
     buckets: Sequence[int] = (1, 2, 4, 8),
@@ -333,28 +376,48 @@ def export_aot_cache(
             exported.in_avals[0].shape[0]
         )
         buckets = (int(static),)
+    quant = quant_tag(meta)
     jit_call = jax.jit(exported.call)
     stored: Dict[str, bool] = {}
     for b in sorted(set(int(x) for x in buckets)):
         spec = jax.ShapeDtypeStruct((b, img, img, 3), jnp.float32)
         compiled = jit_call.lower(spec).compile()
-        key = cache.key(fingerprint, (b, img, img, 3), dtype)
+        key = cache.key(fingerprint, (b, img, img, 3), dtype, quant=quant)
         stored[f"b{b}"] = cache.store(key, compiled)
     return {
         "cache_dir": cache.cache_dir,
         "program_fingerprint": fingerprint,
         "compute_dtype": dtype,
+        "quant": quant,
         "stored": stored,
         "environment": environment_fingerprint(),
     }
 
 
-def load_artifact(path: str) -> Tuple[Callable, Dict[str, Any]]:
+def load_artifact(
+    path: str, dequantize: bool = False
+) -> Tuple[Callable, Dict[str, Any]]:
     """(callable, meta): the callable maps images -> {"logits", "log_px"}.
 
     Needs only jax — deliberately no mgproto_tpu imports in the load path
     (the artifact must stay loadable from a bare serving environment; this
-    helper is a convenience over `jax.export.deserialize`)."""
+    helper is a convenience over `jax.export.deserialize`).
+
+    `dequantize=True` loads the quantized artifact's dequantize-to-f32
+    DEBUG program (`dequant.stablehlo`: the same rounded weight values as
+    plain f32 constants — for pinning int8-serving outputs against an
+    all-f32 execution, tests/test_quant.py). On an unquantized artifact
+    the flag is a documented no-op: there is only one program and it IS
+    the f32 one."""
+    if dequantize:
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+            meta = json.loads(z.read(_META_NAME))
+            blob = (
+                _DEQUANT_BLOB if _DEQUANT_BLOB in names else _BLOB_NAME
+            )
+            exported = jax_export.deserialize(z.read(blob))
+        return exported.call, meta
     exported, meta = load_exported(path)
     return exported.call, meta
 
@@ -362,14 +425,18 @@ def load_artifact(path: str) -> Tuple[Callable, Dict[str, Any]]:
 def artifact_meta(cfg, checkpoint_path: Optional[str],
                   dynamic_batch: bool,
                   gmm_fingerprint: Optional[str] = None,
-                  static_batch: Optional[int] = None) -> Dict[str, Any]:
+                  static_batch: Optional[int] = None,
+                  quant: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Provenance block written next to the program. `gmm_fingerprint`
     identifies the mixture the weights carry (serving/calibration.py) —
     the serving gate matches it against the embedded calibration's stamp
-    and fails closed on disagreement."""
+    and fails closed on disagreement. `quant` is a QuantizedParams
+    .quant_config() block; when None (the f32 path) the `quant_config`
+    key is NOT written at all, keeping `--quantize none` byte-identical
+    to a pre-quant export."""
     from mgproto_tpu.perf.precision import policy_meta, resolve_policy
 
-    return {
+    meta: Dict[str, Any] = {
         "gmm_fingerprint": gmm_fingerprint,
         "static_batch": None if dynamic_batch else static_batch,
         "format": "mgproto-stablehlo-v1",
@@ -393,3 +460,6 @@ def artifact_meta(cfg, checkpoint_path: Optional[str],
         "checkpoint": checkpoint_path,
         "jax_version": jax.__version__,
     }
+    if quant is not None:
+        meta["quant_config"] = quant
+    return meta

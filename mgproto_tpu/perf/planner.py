@@ -248,7 +248,8 @@ def batch_shard_size(cfg) -> int:
 
 
 def state_bytes_per_chip(
-    cfg, model_size: Optional[int] = None, state=None
+    cfg, model_size: Optional[int] = None, state=None,
+    quant_mode: str = "",
 ) -> Dict[str, int]:
     """Per-chip bytes of the sharded TrainState groups under the
     weak-scaling layout (parallel/sharding.py state_partition_specs) —
@@ -264,7 +265,16 @@ def state_bytes_per_chip(
 
     `state` (a TrainState-shaped pytree of arrays or ShapeDtypeStructs)
     skips the eval_shape — callers that already traced one
-    (measure_candidate per candidate) pass it instead of re-tracing."""
+    (measure_candidate per candidate) pass it instead of re-tracing.
+
+    `quant_mode="int8"` (ISSUE 20, serving only) models the params group
+    as int8 weight-only quantized (perf/quant.py::weight_bytes_report
+    shape math over the same eval_shape params: 1 byte/elem + a per-
+    output-channel f32 scale vector on the quantizable kernels, f32 on
+    everything else). The f32 figure stays in the result as
+    `param_bytes_per_chip_f32`, and `quant_mode` is echoed, so the
+    planner's predicted per-replica HBM drop is auditable from the one
+    dict."""
     import jax
 
     from mgproto_tpu.parallel.sharding import (
@@ -290,13 +300,27 @@ def state_bytes_per_chip(
             for f in fields
         )
 
-    return {
+    out = {
         "bank_bytes_per_chip": group("memory"),
         "opt_bytes_per_chip": group(
             "opt_state", "warm_opt_state", "proto_opt_state"
         ),
         "param_bytes_per_chip": group("params"),
     }
+    if quant_mode == "int8":
+        from mgproto_tpu.perf.quant import weight_bytes_report
+
+        # params are replicated under the serving layout, so the per-chip
+        # figure scales by the same int8/f32 byte ratio as the whole tree
+        rep = weight_bytes_report(state.params)
+        f32 = out["param_bytes_per_chip"]
+        out["param_bytes_per_chip_f32"] = f32
+        out["param_bytes_per_chip"] = (
+            int(round(f32 * rep["int8_bytes"] / rep["f32_bytes"]))
+            if rep["f32_bytes"] else f32
+        )
+        out["quant_mode"] = quant_mode
+    return out
 
 
 def lower_split_programs(trainer, state, images, labels, seeds, use_mine,
@@ -607,12 +631,23 @@ def plan_serve_buckets(
     margin: Optional[float] = None,
     measure: Optional[Callable] = None,
     log: Optional[Callable[[str], None]] = None,
+    weight_bytes: int = 0,
 ) -> Tuple[List[int], PlanOutcome]:
     """`mgproto-serve --auto_tune`: size the warmup bucket set from the
     same memory model. Each requested bucket's serving program is lowered
     and its compiled-module peak read; buckets over budget are dropped
     BEFORE warmup would OOM compiling them. Returns (fitting bucket sizes,
     outcome). No prefetch headroom — serving holds one batch.
+
+    `weight_bytes` (ISSUE 20): resident bytes of the artifact's baked
+    weight constants, added to every bucket's measured program peak.
+    XLA's compiled-module memory analysis counts live buffers, not
+    constants baked into the program, so the weight-resident term must be
+    modeled explicitly — pass the artifact's quant_config
+    total_weight_bytes (int8) or total_f32_bytes (f32) and the bucket
+    ladder honestly grows when the backbone shrinks 4x. Each report's
+    detail records both terms (program_peak_bytes / weight_resident_bytes)
+    so the split stays auditable.
 
     Known cost: the planning compile is AOT and does not populate the
     engine's jit dispatch cache, so warmup recompiles the fitting buckets
@@ -626,9 +661,23 @@ def plan_serve_buckets(
         )
         return _program_peak(engine._jit.lower(zeros).compile())
 
+    inner = measure or bucket_measure
+
+    def with_weights(cand: PlanCandidate):
+        measured = inner(cand)
+        peak, detail = (
+            measured if isinstance(measured, tuple) else (int(measured), {})
+        )
+        detail = dict(
+            detail,
+            program_peak_bytes=int(peak),
+            weight_resident_bytes=int(weight_bytes),
+        )
+        return int(peak) + int(weight_bytes), detail
+
     planner = HBMPlanner(
         budget_bytes=budget_bytes, margin=margin,
-        measure=measure or bucket_measure, log=log,
+        measure=with_weights, log=log,
     )
     cands = [
         PlanCandidate(batch=int(b), prefetch_depth=0)

@@ -1,0 +1,321 @@
+"""QuantPolicy + per-channel int8 weight-only quantization (ISSUE 20).
+
+The serving program is byte-bound the same way training was pre-bf16
+(evidence/stall_report_b256.json: 43.7% HBM-bound), and per-replica HBM is
+what caps buckets-per-chip and tenants-per-fleet. This module quantizes the
+backbone's conv/dense KERNELS to int8 with one float32 scale per output
+channel at EXPORT time; the exported inference program carries the int8
+tensors + scale vectors as its baked constants and dequantizes in-kernel
+(`q.astype(f32) * scale`, fused by XLA into the consuming conv read), so
+steady-state weight traffic is 1 byte/param + a scale vector instead of 4.
+
+What is NEVER quantized — the MGProto-specific hard part: a generative
+classifier's absolute p(x) scale is exactly what naive quantization breaks,
+so everything the trust plane rides on keeps full precision BY TYPE:
+
+  * the GMM banks / means / priors (state.gmm, state.memory) — they live
+    outside state.params and this module never sees them;
+  * biases, BatchNorm scale/offset/statistics, proxy matrices — structurally
+    skipped (only `kernel` leaves with ndim >= 2 are eligible);
+  * log p(x) / density math and the serving calibration — pinned f32 fields
+    on QuantPolicy (refused in __post_init__, mirroring
+    perf/precision.py::PrecisionPolicy), linted statically by
+    scripts/check_dtype_discipline.py's int8 extension.
+
+The quantization choice is the boring-on-purpose one: symmetric (no zero
+point — a zero point adds an int add on the fused dequant path and buys
+nothing for weight distributions centered on 0 by init+decay), per OUTPUT
+channel (the last kernel axis for both flax convs [kh, kw, cin, cout] and
+dense [in, out]), scale = amax/127 so the representable range exactly
+covers the observed weights. Round-trip error is bounded by scale/2 per
+element (asserted in tests/test_quant.py).
+
+`quant_config()` is the provenance block stamped into the artifact's
+meta.json; its `tag` ("int8:per_channel:symmetric") is the serving-seam
+identity: the AOT cache key gains it as an axis, the calibration is stamped
+with it, and TrustGate fails closed on a mismatch exactly like a
+fingerprint mismatch (serving_quant_mismatch_total).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+QUANT_FORMAT = "mgproto-quant-v1"
+SUPPORTED_QUANT_MODES = ("none", "int8")
+
+# the serving-seam identity of the one supported scheme; "" = unquantized
+QUANT_TAG_INT8 = "int8:per_channel:symmetric"
+
+
+class QuantError(ValueError):
+    """A request violated the quantization policy's f32 invariants."""
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantPolicy:
+    """What may be quantized, stated as a type. Only `mode` is a knob; the
+    f32 fields are stated (not configurable) because the trust plane's
+    correctness arguments depend on them — the GMM banks/priors, log p(x)
+    scores and calibration math must keep the scale the thresholds were
+    measured on (see module docstring and perf/precision.py)."""
+
+    mode: str = "none"  # backbone conv/dense kernels: none | int8
+    granularity: str = "per_channel"  # one f32 scale per output channel
+    symmetric: bool = True  # no zero point
+    gmm_dtype: str = "float32"  # mixture banks / means / priors
+    score_dtype: str = "float32"  # density / log p(x) math
+    calibration_dtype: str = "float32"  # serving threshold math
+
+    def __post_init__(self):
+        if self.mode not in SUPPORTED_QUANT_MODES:
+            raise QuantError(
+                f"quantize mode must be one of {SUPPORTED_QUANT_MODES}, "
+                f"got {self.mode!r}"
+            )
+        if self.granularity != "per_channel":
+            raise QuantError(
+                "granularity is not a knob: per-tensor scales lose the "
+                "per-output-channel dynamic range conv kernels need "
+                f"(got {self.granularity!r})"
+            )
+        if not self.symmetric:
+            raise QuantError(
+                "asymmetric quantization is not a knob: a zero point adds "
+                "an integer add to the fused dequant path for no benefit "
+                "on zero-centered weight distributions"
+            )
+        for field in ("gmm_dtype", "score_dtype", "calibration_dtype"):
+            if getattr(self, field) != "float32":
+                raise QuantError(
+                    f"{field} is not a knob: it must stay float32 "
+                    f"(got {getattr(self, field)!r}); quantizing the GMM/"
+                    "score/calibration path shifts the p(x) scale every "
+                    "trust threshold depends on"
+                )
+
+    @property
+    def quantized(self) -> bool:
+        return self.mode != "none"
+
+    @property
+    def tag(self) -> str:
+        """Serving-seam identity ("" for f32 — matches unstamped
+        pre-quant calibrations by construction)."""
+        return QUANT_TAG_INT8 if self.mode == "int8" else ""
+
+
+def resolve_quant_policy(mode: str) -> QuantPolicy:
+    """The policy a `--quantize MODE` flag implies."""
+    return QuantPolicy(mode=str(mode or "none"))
+
+
+def _is_quantizable(path: Tuple[str, ...], leaf: Any) -> bool:
+    """Backbone conv/dense kernels only: named `kernel`, rank >= 2,
+    floating. Everything else — biases, BN scale/offset, proxies, any
+    1-D vector — keeps f32 (their bytes are noise; their scale is not)."""
+    if not any(str(k) == "kernel" for k in path):
+        return False
+    shape = getattr(leaf, "shape", ())
+    dtype = getattr(leaf, "dtype", None)
+    if len(shape) < 2 or dtype is None:
+        return False
+    return np.issubdtype(np.dtype(dtype), np.floating)
+
+
+def quantize_array(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric int8: (q[int8, w.shape],
+    scale[f32, out_channels]). The output channel is the LAST axis (flax
+    convs are [kh, kw, cin, cout], dense [in, out]). A dead channel
+    (amax == 0) gets scale 1.0 so dequant round-trips its exact zeros."""
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=reduce_axes)
+    scale = np.where(amax > 0.0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_array(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """The inverse the serving program computes in-kernel."""
+    return q.astype(np.float32) * np.asarray(scale, np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedParams:
+    """A quantized snapshot of a trunk params pytree.
+
+    `entries` holds one record per leaf, in treedef order:
+    ("f32", leaf) for skipped leaves, ("int8", q, scale) for quantized
+    kernels. `materialize()` rebuilds a params pytree of dequantized f32
+    arrays — with `barrier=True` (inside a jax trace) each int8/scale pair
+    is wrapped in `lax.optimization_barrier` so XLA cannot constant-fold
+    the dequant back into a baked f32 tensor, which would silently restore
+    the 4-byte weight traffic the whole exercise removes."""
+
+    policy: QuantPolicy
+    treedef: Any
+    entries: Tuple[Tuple, ...]
+    report: Tuple[Dict[str, Any], ...]
+
+    def materialize(self, barrier: bool = False):
+        import jax
+
+        leaves = []
+        for entry in self.entries:
+            if entry[0] == "f32":
+                leaves.append(entry[1])
+                continue
+            _, q, scale = entry
+            if barrier:
+                q, scale = jax.lax.optimization_barrier((q, scale))
+                import jax.numpy as jnp
+
+                leaves.append(q.astype(jnp.float32) * scale)
+            else:
+                leaves.append(dequantize_array(q, scale))
+        import jax
+
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    @property
+    def num_quantized(self) -> int:
+        return sum(1 for r in self.report if r["quantized"])
+
+    @property
+    def num_skipped(self) -> int:
+        return sum(1 for r in self.report if not r["quantized"])
+
+    @property
+    def f32_weight_bytes(self) -> int:
+        """f32 bytes of the QUANTIZED leaves only — the honest numerator
+        of the reduction ratio (skipped leaves move the same bytes either
+        way)."""
+        return sum(r["f32_bytes"] for r in self.report if r["quantized"])
+
+    @property
+    def quantized_weight_bytes(self) -> int:
+        """int8 + scale bytes of the quantized leaves."""
+        return sum(
+            r["quant_bytes"] for r in self.report if r["quantized"]
+        )
+
+    @property
+    def total_weight_bytes(self) -> int:
+        """Resident backbone weight bytes of the quantized program
+        (quantized leaves as int8+scales, skipped leaves as f32)."""
+        return sum(r["quant_bytes"] for r in self.report)
+
+    @property
+    def total_f32_bytes(self) -> int:
+        """Resident backbone weight bytes of the f32 program."""
+        return sum(r["f32_bytes"] for r in self.report)
+
+    def fingerprint(self) -> str:
+        """Content hash over the quantized tensors + scales (the analogue
+        of the GMM fingerprint for the quantized weight constants)."""
+        h = hashlib.sha256()
+        for entry in self.entries:
+            if entry[0] == "int8":
+                _, q, scale = entry
+                h.update(np.ascontiguousarray(q).tobytes())
+                h.update(np.ascontiguousarray(scale).tobytes())
+        return h.hexdigest()
+
+    def quant_config(self) -> Dict[str, Any]:
+        """The meta.json provenance block (and the mismatch-detection
+        identity: `tag` is what calibrations are stamped with and what
+        the AOT cache key carries)."""
+        return {
+            "format": QUANT_FORMAT,
+            "mode": self.policy.mode,
+            "granularity": self.policy.granularity,
+            "symmetric": self.policy.symmetric,
+            "tag": self.policy.tag,
+            "num_quantized": self.num_quantized,
+            "num_skipped": self.num_skipped,
+            "f32_weight_bytes": int(self.f32_weight_bytes),
+            "quantized_weight_bytes": int(self.quantized_weight_bytes),
+            "total_weight_bytes": int(self.total_weight_bytes),
+            "total_f32_bytes": int(self.total_f32_bytes),
+            "fingerprint": self.fingerprint(),
+        }
+
+
+def quantize_params(params, policy: Optional[QuantPolicy] = None):
+    """Quantize a trunk params pytree under `policy` (default int8).
+
+    Host-side numpy — runs once at export time. Returns QuantizedParams;
+    with mode "none" every leaf is a skipped f32 entry (materialize() is
+    then the identity, which is what makes `--quantize none` byte-exact)."""
+    import jax
+
+    policy = policy or QuantPolicy(mode="int8")
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        params
+    )
+    entries: List[Tuple] = []
+    report: List[Dict[str, Any]] = []
+    for key_path, leaf in leaves_with_paths:
+        path = tuple(
+            getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))
+            for k in key_path
+        )
+        arr = np.asarray(leaf)
+        f32_bytes = int(arr.size * 4)
+        if policy.quantized and _is_quantizable(path, arr):
+            q, scale = quantize_array(arr)
+            entries.append(("int8", q, scale))
+            report.append({
+                "path": "/".join(str(p) for p in path),
+                "shape": list(arr.shape),
+                "quantized": True,
+                "f32_bytes": f32_bytes,
+                "quant_bytes": int(q.nbytes + scale.nbytes),
+            })
+        else:
+            entries.append(("f32", np.asarray(leaf)))
+            report.append({
+                "path": "/".join(str(p) for p in path),
+                "shape": list(arr.shape),
+                "quantized": False,
+                "f32_bytes": f32_bytes,
+                "quant_bytes": f32_bytes,
+            })
+    return QuantizedParams(
+        policy=policy,
+        treedef=treedef,
+        entries=tuple(entries),
+        report=tuple(report),
+    )
+
+
+def weight_bytes_report(params) -> Dict[str, int]:
+    """Shape-math weight bytes (works on ShapeDtypeStructs — no values
+    needed): what the trunk's weights cost resident as f32 vs as
+    int8+per-channel-scales. The planner's quant model
+    (perf/planner.py::state_bytes_per_chip) rides on this."""
+    import jax
+
+    leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(params)
+    f32_total = 0
+    int8_total = 0
+    for key_path, leaf in leaves_with_paths:
+        path = tuple(
+            getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))
+            for k in key_path
+        )
+        shape = tuple(getattr(leaf, "shape", ()))
+        n = int(np.prod(shape)) if shape else 1
+        f32_bytes = n * 4
+        f32_total += f32_bytes
+        if _is_quantizable(path, leaf):
+            out_ch = int(shape[-1])
+            int8_total += n * 1 + out_ch * 4
+        else:
+            int8_total += f32_bytes
+    return {"f32_bytes": int(f32_total), "int8_bytes": int(int8_total)}

@@ -13,9 +13,15 @@ is serialized via `jax.experimental.serialize_executable` into a
 content-addressed entry keyed by everything that makes a compiled binary
 valid to reuse:
 
-    (program fingerprint, bucket shape, compute dtype,
+    (program fingerprint, bucket shape, compute dtype, quant tag,
      device kind, topology (platform + device count),
      jax version, jaxlib version)
+
+The quant axis (ISSUE 20, perf/quant.py) keeps an int8 weight-only
+program and its f32 sibling from ever sharing an entry: the file
+fingerprint usually separates them already, but live-state faces and any
+future in-place requantization would not, so the tag is part of the key
+unconditionally ("" = unquantized).
 
 Any change to any component changes the digest, so a stale executable is
 simply ABSENT (a miss → normal compile), never served. The entry file
@@ -96,15 +102,19 @@ def cache_key(
     program_fingerprint: str,
     bucket_shape: Sequence[int],
     compute_dtype: str,
+    quant: str = "",
     env: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The full cache key as a flat JSON-able dict. `env` is injectable so
-    tests can simulate a jax upgrade / device change without one."""
+    tests can simulate a jax upgrade / device change without one. `quant`
+    is the artifact's quant tag (meta.json quant_config.tag, "" = f32) —
+    present in every key so int8 and f32 programs can never collide."""
     key = {
         "format": "mgproto-aotx-v1",
         "program_fingerprint": str(program_fingerprint or ""),
         "bucket_shape": [int(d) for d in bucket_shape],
         "compute_dtype": str(compute_dtype or ""),
+        "quant": str(quant or ""),
     }
     key.update(env if env is not None else environment_fingerprint())
     return key
@@ -150,9 +160,11 @@ class ExecutableCache:
         program_fingerprint: str,
         bucket_shape: Sequence[int],
         compute_dtype: str,
+        quant: str = "",
     ) -> Dict[str, Any]:
         return cache_key(
-            program_fingerprint, bucket_shape, compute_dtype, env=self._env
+            program_fingerprint, bucket_shape, compute_dtype,
+            quant=quant, env=self._env,
         )
 
     def path_for(self, key: Dict[str, Any]) -> str:

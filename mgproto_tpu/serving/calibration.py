@@ -72,6 +72,13 @@ class Calibration:
     # stale fingerprint does, so the gate fails closed on mismatch.
     # "" = unknown (pre-policy calibration): honored for back-compat.
     compute_dtype: str = ""
+    # quant tag of the served weights the ID scores were measured under
+    # (perf/quant.py quant_config "tag"; "" = unquantized/full precision).
+    # Unlike compute_dtype, "" is not "unknown" — it is the f32 identity:
+    # a quantized program refuses an empty-stamped calibration fail-closed
+    # (serving/gate.py), because thresholds measured on unrounded weights
+    # do not transfer to the rounded grid.
+    quant_config: str = ""
 
     # ---------------------------------------------------------------- derive
     @staticmethod
@@ -83,6 +90,7 @@ class Calibration:
         percentiles: Sequence[float] = DEFAULT_PERCENTILES,
         source: str = "",
         compute_dtype: str = "",
+        quant_config: str = "",
     ) -> "Calibration":
         """Build from per-sample held-out ID scores (log p(x) [N] and class
         log-likelihoods [N, C]), host-side float64 like the eval driver."""
@@ -121,6 +129,7 @@ class Calibration:
             num_id_samples=int(scores.size),
             source=source,
             compute_dtype=str(compute_dtype),
+            quant_config=str(quant_config),
         )
 
     # ---------------------------------------------------------------- lookup
@@ -171,6 +180,8 @@ class Calibration:
                 source=str(d.get("source", "")),
                 # absent in pre-policy calibrations: "" = unknown, honored
                 compute_dtype=str(d.get("compute_dtype", "")),
+                # absent in pre-quant calibrations: "" = the f32 identity
+                quant_config=str(d.get("quant_config", "")),
             )
         except (KeyError, TypeError, ValueError) as e:
             raise CalibrationError(f"malformed calibration payload: {e}")
@@ -187,6 +198,7 @@ class Calibration:
 def calibrate(
     trainer, state, id_batches: Iterable, percentile: float = DEFAULT_PERCENTILE,
     percentiles: Sequence[float] = DEFAULT_PERCENTILES, source: str = "",
+    quant_config: str = "",
 ) -> Calibration:
     """Derive a Calibration from a held-out ID loader through the SAME eval
     step the engine serves with (`Trainer.eval_step` -> engine/evaluate.py's
@@ -202,13 +214,17 @@ def calibrate(
         percentiles=percentiles,
         source=source,
         # stamp the precision policy the scores were measured under: the
-        # gate refuses to apply these thresholds to a different dtype
+        # gate refuses to apply these thresholds to a different dtype.
+        # quant_config flows in from the caller (mgproto-export --quantize
+        # measures through the round-tripped weights and stamps their tag)
         compute_dtype=trainer.cfg.model.compute_dtype,
+        quant_config=quant_config,
     )
 
 
 def calibrate_from_config(
-    cfg, trainer, state, percentile: float = DEFAULT_PERCENTILE
+    cfg, trainer, state, percentile: float = DEFAULT_PERCENTILE,
+    quant_config: str = "",
 ) -> Calibration:
     """CLI-facing wrapper: derive the calibration from the config's held-
     out ID loader (`cfg.data.test_dir`), with its provenance recorded. The
@@ -221,4 +237,5 @@ def calibrate_from_config(
     return calibrate(
         trainer, state, test_loader, percentile=percentile,
         source=f"test_dir={cfg.data.test_dir}",
+        quant_config=quant_config,
     )

@@ -136,6 +136,7 @@ class ServingEngine:
         calibration: Optional[Calibration] = None,
         expected_fingerprint: Optional[str] = None,
         expected_compute_dtype: Optional[str] = None,
+        expected_quant: Optional[str] = None,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         percentile: Optional[float] = None,
         queue_capacity: int = 64,
@@ -174,6 +175,7 @@ class ServingEngine:
             expected_fingerprint=expected_fingerprint,
             percentile=percentile,
             expected_compute_dtype=expected_compute_dtype,
+            expected_quant=expected_quant,
         )
         self.queue = AdmissionQueue(
             capacity=queue_capacity,
@@ -217,6 +219,11 @@ class ServingEngine:
             # plain program's in the AOT cache (different output contract)
             self.aot_fingerprint += ":explain"
         self.compute_dtype = str(expected_compute_dtype or "")
+        # quant identity of the served program (perf/quant.py tag, "" =
+        # f32): an axis of the AOT cache key, so an int8 program can never
+        # deserialize an f32 executable (or vice versa) — wrong-program
+        # serves are structurally impossible, only counted misses
+        self.quant_config = str(expected_quant or "")
         # multi-tenant plane (ISSUE 17): heads live in the directory, the
         # TRUNK lives here. A head never touches aot_fingerprint, _jit, or
         # _exec, so mounting a tenant can never cost a trunk compile.
@@ -277,6 +284,9 @@ class ServingEngine:
             calibration=calibration,
             expected_fingerprint=gmm_fingerprint(state.gmm),
             expected_compute_dtype=trainer.cfg.model.compute_dtype,
+            # a live TrainState serves unrounded f32 weights by
+            # construction — an int8-stamped calibration must fail closed
+            expected_quant="",
             **kw,
         )
 
@@ -334,6 +344,20 @@ class ServingEngine:
         # otherwise — a calibration stamped with a DIFFERENT dtype fails
         # closed in the gate, exactly like a fingerprint mismatch
         policy = meta.get("precision_policy") or {}
+        # the quant identity the artifact's program serves under
+        # (meta.json quant_config.tag; "" for f32/pre-quant artifacts):
+        # an int8 artifact whose calibration carries a different stamp —
+        # including the empty pre-quant stamp — fails closed in the gate,
+        # and the served program's resident weight bytes land on the
+        # serving_quant_weight_bytes gauge for the planner/dashboards
+        from mgproto_tpu.engine.export import quant_tag
+
+        expected_quant = quant_tag(meta)
+        qmeta = meta.get("quant_config") or {}
+        if qmeta.get("total_weight_bytes"):
+            _m.gauge(_m.QUANT_WEIGHT_BYTES).set(
+                float(qmeta["total_weight_bytes"])
+            )
         if kw.get("aot_cache") is not None and "aot_fingerprint" not in kw:
             # the artifact face's program identity is the FILE (weights and
             # program in one hash): a re-export — even with an unchanged
@@ -352,6 +376,7 @@ class ServingEngine:
             expected_compute_dtype=(
                 policy.get("compute_dtype") or meta.get("compute_dtype")
             ),
+            expected_quant=expected_quant,
             **kw,
         )
 
@@ -361,6 +386,7 @@ class ServingEngine:
             self.aot_fingerprint,
             (bucket, self.img_size, self.img_size, 3),
             self.compute_dtype,
+            quant=self.quant_config,
         )
 
     def warmup(self) -> int:

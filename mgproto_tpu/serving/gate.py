@@ -28,6 +28,17 @@ a dtype mismatch is treated exactly like a fingerprint mismatch — degraded
 mode plus `serving_precision_mismatch_total`. Calibrations with no dtype
 stamp (pre-policy artifacts) are honored unchanged.
 
+Fail-closed QUANT discipline (ISSUE 20): an int8 weight-only artifact
+(perf/quant.py) serves weights rounded to a per-channel grid, which moves
+the p(x) distribution just like a dtype change. The calibration carries the
+quant tag its ID scores were measured under (`quant_config`, "" = f32);
+when the served program's tag disagrees — including an int8 program paired
+with an UNSTAMPED pre-quant calibration — the gate degrades and counts
+`serving_quant_mismatch_total`. Unlike the dtype rule, an empty stamp does
+NOT grandfather into a quantized program: "" is the f32 identity, so
+"" vs "int8:..." is a real mismatch, while "" vs "" (f32 artifact,
+pre-quant calibration) is honored unchanged.
+
 The trailing abstain rate is exported as the `serving_abstain_rate` gauge —
 the first dashboard signal that live traffic has drifted away from the
 calibration set.
@@ -63,9 +74,11 @@ class TrustGate:
         percentile: Optional[float] = None,
         window: int = 256,
         expected_compute_dtype: Optional[str] = None,
+        expected_quant: Optional[str] = None,
     ):
         self.fingerprint_mismatch = False
         self.precision_mismatch = False
+        self.quant_mismatch = False
         if (
             calibration is not None
             and expected_fingerprint is not None
@@ -87,6 +100,21 @@ class TrustGate:
             # with no dtype stamp ("" — pre-policy artifact) is honored.
             _m.counter(_m.PRECISION_MISMATCHES).inc()
             self.precision_mismatch = True
+            calibration = None
+        if (
+            calibration is not None
+            and expected_quant is not None
+            and (calibration.quant_config or "") != (expected_quant or "")
+        ):
+            # quant discipline (perf/quant.py): strict equality, both
+            # directions. expected_quant=None means "caller makes no quant
+            # claim" (pre-ISSUE-20 construction sites) and checks nothing;
+            # expected_quant="" is an explicit f32 claim that refuses an
+            # int8-stamped calibration, and an int8 claim refuses both f32
+            # stamps and the empty pre-quant stamp — thresholds measured
+            # on unrounded weights do not transfer to the rounded grid.
+            _m.counter(_m.QUANT_MISMATCHES).inc()
+            self.quant_mismatch = True
             calibration = None
         self.calibration = calibration
         self.threshold: Optional[float] = None

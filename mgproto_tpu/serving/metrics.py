@@ -29,6 +29,9 @@ BREAKER_STATE = "serving_breaker_state"
 BREAKER_TRANSITIONS = "serving_breaker_transitions_total"
 FINGERPRINT_MISMATCHES = "serving_fingerprint_mismatch_total"
 PRECISION_MISMATCHES = "serving_precision_mismatch_total"
+# --- int8 weight-only serving (ISSUE 20) ---
+QUANT_MISMATCHES = "serving_quant_mismatch_total"
+QUANT_WEIGHT_BYTES = "serving_quant_weight_bytes"
 DEGRADED_REQUESTS = "serving_degraded_requests_total"
 DEVICE_ERRORS = "serving_device_errors_total"
 BATCH_FILL = "serving_batch_fill_ratio"
@@ -82,6 +85,11 @@ COUNTER_HELP = {
         "calibrations rejected because the served compute dtype does not "
         "match the precision policy the thresholds were measured under "
         "(perf/precision.py; a dtype change moves the p(x) scale)",
+    QUANT_MISMATCHES:
+        "calibrations rejected because the served quant config (meta.json "
+        "quant_config.tag) does not match the one the thresholds were "
+        "measured under (perf/quant.py; int8 weight rounding moves the "
+        "p(x) scale the same way a dtype change does)",
     DEGRADED_REQUESTS: "requests answered WITHOUT OoD gating (degraded mode)",
     DEVICE_ERRORS: "inference dispatches that raised a device error",
     DISPATCHES:
@@ -140,6 +148,11 @@ GAUGE_HELP = {
     AUTOSCALE_TARGET:
         "replica count the autoscaler is currently steering toward "
         "(within its [min, max] bounds)",
+    QUANT_WEIGHT_BYTES:
+        "resident backbone weight bytes of the served program under its "
+        "quant config (int8 tensors + scale vectors + untouched f32 "
+        "leaves; 0 = unquantized or unknown — the per-replica HBM "
+        "numerator perf/planner.py budgets with)",
     TENANTS_MOUNTED: "tenant heads currently mounted in the directory",
     TENANT_QUEUE_DEPTH:
         "admission-queue entries currently held per tenant (labeled "

@@ -12,6 +12,11 @@ serving:
   * `fingerprint_mismatch`  — the calibration was measured under a
     different GMM than the artifact serves (the prune-then-serve regression
     the TrustGate exists to catch). Promoting it would silently misgate.
+  * `quant_mismatch`        — the calibration was measured under a
+    different quant config than the artifact serves (ISSUE 20: quantize-
+    then-swap without recalibrating, or swapping an f32 artifact under an
+    int8-stamped calibration). A quant-config change mid-swap is refused
+    unless the staged artifact carries its own matching recalibration.
   * `stage_failed`          — the factory or bucket warmup raised: the
     artifact cannot even serve, let alone be promoted.
 
@@ -46,6 +51,7 @@ SWAP_REJECTED = "rejected"
 
 REJECT_UNCALIBRATED = "uncalibrated"
 REJECT_FINGERPRINT = "fingerprint_mismatch"
+REJECT_QUANT = "quant_mismatch"
 REJECT_STAGE_FAILED = "stage_failed"
 REJECT_NOT_WARMED = "not_warmed"
 
@@ -75,6 +81,11 @@ def verify_head(gate, require_calibrated: bool = True) -> Optional[str]:
     operator error (stale calibration, not missing one)."""
     if gate.fingerprint_mismatch:
         return REJECT_FINGERPRINT
+    if getattr(gate, "quant_mismatch", False):
+        # same precedence argument as fingerprint: the gate degraded
+        # itself over a specific operator error (quantized without
+        # recalibrating), and 'uncalibrated' would hide it
+        return REJECT_QUANT
     if gate.degraded and require_calibrated:
         return REJECT_UNCALIBRATED
     return None

@@ -180,6 +180,7 @@ class TenantDirectory:
         class_names: Sequence[str] = (),
         expected_fingerprint: Optional[str] = None,
         expected_compute_dtype: Optional[str] = None,
+        expected_quant: Optional[str] = None,
         percentile: Optional[float] = None,
         drift_config: Optional[Any] = None,
         capture_config: Optional[Any] = None,
@@ -204,6 +205,7 @@ class TenantDirectory:
             expected_fingerprint=expected_fingerprint,
             percentile=percentile,
             expected_compute_dtype=expected_compute_dtype,
+            expected_quant=expected_quant,
         )
         slots: List[int] = []
         if class_names:
@@ -317,13 +319,18 @@ class TenantDirectory:
         calibration: Optional[Calibration],
         expected_fingerprint: Optional[str] = None,
         expected_compute_dtype: Optional[str] = None,
+        expected_quant: Optional[str] = None,
         percentile: Optional[float] = None,
     ) -> TenantSwapReport:
         """Tenant-scoped blue/green: stage a replacement head, verify it
         through the fleet swap's fail-closed contract (swap.verify_head),
         and only then replace the mounted head atomically. A rejection —
-        uncalibrated, stale fingerprint, chaos-stripped — leaves the OLD
-        head serving; no other tenant is touched either way."""
+        uncalibrated, stale fingerprint, quant-config mismatch against the
+        served trunk, chaos-stripped — leaves the OLD head serving; no
+        other tenant is touched either way. Note the head itself stays
+        full-precision by construction whatever the trunk's quant config:
+        head_nbytes counts host float64 sketch/temperature/threshold
+        payload (perf/quant.py never sees a calibration)."""
         from mgproto_tpu.serving.swap import verify_head
 
         if self.head_for(tenant) is None:
@@ -347,6 +354,7 @@ class TenantDirectory:
             expected_fingerprint=expected_fingerprint,
             percentile=percentile,
             expected_compute_dtype=expected_compute_dtype,
+            expected_quant=expected_quant,
         )
         reason = verify_head(staged)
         if reason is not None:

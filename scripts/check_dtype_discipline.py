@@ -1,34 +1,41 @@
 #!/usr/bin/env python
 """Lint: EM/bank/calibration code must never cast to or compute in half
-precision — the f32-statistics invariant, enforced statically.
+precision OR touch int8/quantized dtypes — the f32-statistics invariant,
+enforced statically.
 
 The mixed-precision policy (mgproto_tpu/perf/precision.py) runs the trunk
 in bf16 but pins everything whose ABSOLUTE SCALE carries meaning to f32:
 EM sufficient statistics, the [C, cap, d] memory bank, log p(x) scores,
-and the serving calibration math. The runtime guard (`assert_f32_stats`)
-catches a half-precision tensor arriving at the EM entry points; this lint
-catches the refactor BEFORE it runs — any `bfloat16`/`float16` reference
-appearing in the protected modules:
+and the serving calibration math. Int8 weight-only quantization
+(mgproto_tpu/perf/quant.py, ISSUE 20) adds a second boundary with the
+same shape: only backbone conv/dense kernels are ever quantized, and the
+quantize/dequantize math lives ONLY in perf/quant.py + engine/export.py.
+The runtime guard (`assert_f32_stats`) catches a half-precision tensor
+arriving at the EM entry points; this lint catches the refactor BEFORE it
+runs — any `bfloat16`/`float16` or `int8` reference appearing in the
+protected modules:
 
     mgproto_tpu/core/em.py          EM statistics + mean optimizer
     mgproto_tpu/core/memory.py      the per-class feature bank
     mgproto_tpu/serving/calibration.py  threshold/temperature math
     mgproto_tpu/online/*.py         the continual-learning EM loop
+    mgproto_tpu/trust/*.py          OoD/corruption verification math
 
 Flagged forms (AST walk, so comments/docstrings never false-positive):
   * attribute references: `jnp.bfloat16`, `np.float16`, `.half` (the
-    torch-style cast attribute);
-  * bare names `bfloat16`/`float16` (an imported dtype symbol) — NOT the
-    bare word `half`, which is an ordinary identifier (`half = n // 2`)
-    far more often than a dtype;
+    torch-style cast attribute), `jnp.int8`;
+  * bare names `bfloat16`/`float16`/`int8` (an imported dtype symbol) —
+    NOT the bare word `half`, which is an ordinary identifier
+    (`half = n // 2`) far more often than a dtype, and NOT `uint8`,
+    which is the legitimate image wire format throughout;
   * string dtype literals in CALLS or keywords: `x.astype("bfloat16")`,
-    `jnp.zeros(..., dtype="float16")` (a bare string constant elsewhere —
+    `jnp.zeros(..., dtype="int8")` (a bare string constant elsewhere —
     e.g. an error-message fragment — is fine).
 
 Run from anywhere:  python scripts/check_dtype_discipline.py [repo_root]
 Exit 0 when clean, 1 with one `path:line: finding` per offender. Wired
-into tier-1 via tests/test_precision.py (with violation-detection
-coverage, like the other check_* lints).
+into tier-1 via tests/test_precision.py and tests/test_quant.py (with
+violation-detection coverage, like the other check_* lints).
 """
 
 from __future__ import annotations
@@ -43,12 +50,18 @@ from typing import List
 # .half() cast); bare names and dtype strings flag only the unambiguous two
 HALF_ATTRS = ("bfloat16", "float16", "half")
 HALF_NAMES = ("bfloat16", "float16")
+# int8 is the quantized-weight storage dtype (perf/quant.py); it must never
+# leak into statistics/calibration/trust code. uint8 is deliberately NOT
+# flagged — it is the image wire format, not a quantization dtype.
+INT8_ATTRS = ("int8",)
+INT8_NAMES = ("int8",)
 
 PROTECTED = (
     os.path.join("mgproto_tpu", "core", "em.py"),
     os.path.join("mgproto_tpu", "core", "memory.py"),
     os.path.join("mgproto_tpu", "serving", "calibration.py"),
     os.path.join("mgproto_tpu", "online", "*.py"),
+    os.path.join("mgproto_tpu", "trust", "*.py"),
 )
 
 
@@ -64,25 +77,35 @@ def _check_file(path: str, rel: str) -> List[str]:
     def flag(node: ast.AST, what: str) -> None:
         found.append(
             f"{rel}:{getattr(node, 'lineno', '?')}: {what} — EM/bank/"
-            "calibration statistics are pinned to float32 "
-            "(perf/precision.py); route any half-precision compute through "
-            "the trunk's compute_dtype instead"
+            "calibration/trust statistics are pinned to float32 "
+            "(perf/precision.py, perf/quant.py); route half-precision "
+            "compute through the trunk's compute_dtype and keep int8 "
+            "strictly on the quantized-weight side of the export boundary"
         )
 
     for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr in HALF_ATTRS:
-            flag(node, f"half-precision dtype attribute `.{node.attr}`")
-        elif isinstance(node, ast.Name) and node.id in HALF_NAMES:
-            flag(node, f"half-precision dtype name `{node.id}`")
+        if isinstance(node, ast.Attribute):
+            if node.attr in HALF_ATTRS:
+                flag(node, f"half-precision dtype attribute `.{node.attr}`")
+            elif node.attr in INT8_ATTRS:
+                flag(node, f"quantized dtype attribute `.{node.attr}`")
+        elif isinstance(node, ast.Name):
+            if node.id in HALF_NAMES:
+                flag(node, f"half-precision dtype name `{node.id}`")
+            elif node.id in INT8_NAMES:
+                flag(node, f"quantized dtype name `{node.id}`")
         elif isinstance(node, ast.Call):
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 if (
                     isinstance(arg, ast.Constant)
                     and isinstance(arg.value, str)
-                    and arg.value in HALF_NAMES
                 ):
-                    flag(arg, f"half-precision dtype string {arg.value!r} "
-                              "passed to a call")
+                    if arg.value in HALF_NAMES:
+                        flag(arg, "half-precision dtype string "
+                                  f"{arg.value!r} passed to a call")
+                    elif arg.value in INT8_NAMES:
+                        flag(arg, "quantized dtype string "
+                                  f"{arg.value!r} passed to a call")
     return found
 
 
